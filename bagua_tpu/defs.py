@@ -1,0 +1,81 @@
+"""Shared type definitions.
+
+TPU-native analog of the reference's ``bagua/bagua_define.py:12-58``: the
+tensor declaration and tunable-hyperparameter records exchanged with the
+autotune service, plus the ``ReduceOp`` enum used by the collective API
+(reference ``bagua/torch_api/communication.py:63-75``).
+"""
+
+import enum
+from typing import Dict, List
+
+try:
+    from pydantic import BaseModel
+except ImportError:  # pragma: no cover - pydantic is expected in the image
+    BaseModel = object  # type: ignore
+
+
+class DType(str, enum.Enum):
+    F32 = "f32"
+    F16 = "f16"
+    BF16 = "bf16"
+    U8 = "u8"
+    I32 = "i32"
+    I64 = "i64"
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction ops for explicit collectives (values mirror the reference)."""
+
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+    BOR = 4
+    BAND = 5
+    BXOR = 6
+    AVG = 10
+
+
+class TensorDeclaration(BaseModel):
+    """One communicable tensor, as registered with the autotune service."""
+
+    name: str
+    num_elements: int
+    dtype: str  # DType value
+
+
+def dtype_itemsize(dtype: str) -> int:
+    return {
+        DType.F32.value: 4,
+        DType.F16.value: 2,
+        DType.BF16.value: 2,
+        DType.U8.value: 1,
+        DType.I32.value: 4,
+        DType.I64.value: 8,
+    }[dtype]
+
+
+class BaguaHyperparameter(BaseModel):
+    """The tunable hyperparameters the autotune service optimizes.
+
+    Mirrors reference ``bagua_define.py:34-50``: bucket assignment (list of
+    buckets, each a list of tensor declarations), the bucket size in bytes,
+    and whether hierarchical (intra-axis first) reduction is used.
+    """
+
+    buckets: List[List[TensorDeclaration]] = []
+    bucket_size: int = 10 * 1024 ** 2
+    is_hierarchical_reduce: bool = False
+
+    def update(self, param_dict: Dict) -> "BaguaHyperparameter":
+        tmp = self.dict()
+        for key, value in param_dict.items():
+            if key in tmp:
+                if key == "buckets":
+                    value = [
+                        [TensorDeclaration(**td) if isinstance(td, dict) else td for td in bucket]
+                        for bucket in value
+                    ]
+                setattr(self, key, value)
+        return self

@@ -1,0 +1,93 @@
+"""Environment/config accessors.
+
+TPU-native analog of the reference's ``bagua/torch_api/env.py`` (reference
+``env.py:5-134``): every runtime knob is env-var carried, with the same names
+where the concept survives the port (``BAGUA_DEFAULT_BUCKET_SIZE``,
+``BAGUA_SERVICE_PORT``, autotune knobs).  Rank/world-size come from the JAX
+distributed runtime rather than the torch launcher, but the launcher
+(``bagua_tpu.distributed.run``) still exports the familiar variables so user
+scripts can read them either way.
+"""
+
+import os
+
+
+def get_world_size() -> int:
+    """Total number of processes (hosts) in the job."""
+    if "WORLD_SIZE" in os.environ:
+        return int(os.environ["WORLD_SIZE"])
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def get_rank() -> int:
+    """Rank (process index) of this host."""
+    if "RANK" in os.environ:
+        return int(os.environ["RANK"])
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_local_rank() -> int:
+    return int(os.environ.get("LOCAL_RANK", 0))
+
+
+def get_local_size() -> int:
+    return int(os.environ.get("LOCAL_WORLD_SIZE", 1))
+
+
+def get_node_rank() -> int:
+    return int(os.environ.get("NODE_RANK", get_rank() // max(get_local_size(), 1)))
+
+
+def get_master_addr() -> str:
+    return os.environ.get("MASTER_ADDR", "127.0.0.1")
+
+
+def get_default_bucket_size() -> int:
+    """Default communication bucket size in bytes (10 MiB, like the reference)."""
+    return int(os.environ.get("BAGUA_DEFAULT_BUCKET_SIZE", 10 * 1024 ** 2))
+
+
+def get_bagua_service_port() -> int:
+    return int(os.environ.get("BAGUA_SERVICE_PORT", -1))
+
+
+def set_bagua_service_port(port: int) -> None:
+    os.environ["BAGUA_SERVICE_PORT"] = str(port)
+
+
+def get_autotune_level() -> int:
+    return int(os.environ.get("BAGUA_AUTOTUNE", 0))
+
+
+def get_autotune_max_samples() -> int:
+    return int(os.environ.get("BAGUA_AUTOTUNE_MAX_SAMPLES", 60))
+
+
+def get_autotune_warmup_time_s() -> float:
+    return float(os.environ.get("BAGUA_AUTOTUNE_WARMUP_TIME_S", 30.0))
+
+
+def get_autotune_sampling_confidence_time_s() -> float:
+    return float(os.environ.get("BAGUA_AUTOTUNE_SAMPLING_CONFIDENCE_TIME_S", 5.0))
+
+
+def get_autotune_server_wait_time_s() -> float:
+    return float(os.environ.get("BAGUA_AUTOTUNE_SERVER_WAIT_TIME", 60.0))
+
+
+def is_report_metrics_switch_on() -> bool:
+    return int(os.environ.get("BAGUA_REPORT_METRICS", 0)) == 1
+
+
+def get_autotune_logfile_path() -> str:
+    return os.environ.get("BAGUA_AUTOTUNE_LOGFILE_PATH", "/tmp/bagua_autotune.log")

@@ -1,0 +1,175 @@
+"""Small shared utilities.
+
+TPU-native analog of the reference's ``bagua/torch_api/utils.py``: flatten /
+unflatten over pytrees of jax arrays (reference uses torch
+``_flatten_dense_tensors``, ``utils.py:15-49``), dtype mapping
+(``utils.py:81``), and the ``StatisticalAverage`` exponential-window speed
+tracker (``utils.py:127-244``) used by the autotune metrics path.
+"""
+
+import math
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from bagua_tpu.defs import DType
+
+
+def to_bagua_datatype(dtype) -> str:
+    """Map a jnp dtype to the wire datatype name (reference ``utils.py:81-92``)."""
+    d = jnp.dtype(dtype)
+    if d == jnp.float32:
+        return DType.F32.value
+    if d == jnp.float16:
+        return DType.F16.value
+    if d == jnp.bfloat16:
+        return DType.BF16.value
+    if d == jnp.uint8:
+        return DType.U8.value
+    if d == jnp.int32:
+        return DType.I32.value
+    if d == jnp.int64:
+        return DType.I64.value
+    raise ValueError(f"unsupported data type {d}")
+
+
+def from_bagua_datatype(name: str):
+    return {
+        DType.F32.value: jnp.float32,
+        DType.F16.value: jnp.float16,
+        DType.BF16.value: jnp.bfloat16,
+        DType.U8.value: jnp.uint8,
+        DType.I32.value: jnp.int32,
+        DType.I64.value: jnp.int64,
+    }[name]
+
+
+def flatten(arrays: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Concatenate arrays into one flat 1-D array (same dtype required)."""
+    if len(arrays) == 0:
+        return jnp.zeros((0,))
+    return jnp.concatenate([a.reshape(-1) for a in arrays])
+
+
+def unflatten(flat: jnp.ndarray, shapes: Sequence[Tuple[int, ...]]) -> List[jnp.ndarray]:
+    """Split a flat array back into arrays of the given shapes."""
+    out = []
+    offset = 0
+    for shape in shapes:
+        n = int(np.prod(shape)) if len(shape) else 1
+        out.append(flat[offset : offset + n].reshape(shape))
+        offset += n
+    return out
+
+
+def check_contiguous(sizes: Sequence[int], total: int) -> bool:
+    return sum(sizes) == total
+
+
+def align_size(numel: int, align: int) -> int:
+    """Round ``numel`` up to a multiple of ``align``."""
+    return int(math.ceil(numel / align) * align)
+
+
+class StatisticalAverage:
+    """Power-of-two-window running mean of a value (reference ``utils.py:127-244``).
+
+    ``records[i]`` approximates the mean of the recorded value over the last
+    ``2**i`` seconds; memory stays O(log T).  ``record(v)`` states that the
+    value has been ``v`` since the previous ``record`` call.
+    """
+
+    def __init__(
+        self,
+        last_update_time: Optional[float] = None,
+        records: Optional[List[float]] = None,
+        tail: float = 0.0,
+    ):
+        self.last_update_time = last_update_time if last_update_time is not None else time.time()
+        self.records: List[float] = list(records) if records else []
+        self.tail = tail  # history (seconds) older than the largest window
+
+    def record_seconds(self) -> float:
+        return 2.0 ** (len(self.records) - 1) if self.records else 0.0
+
+    def total_recording_time(self) -> float:
+        return self.record_seconds() + self.tail
+
+    def get_records_mean(self, last_seconds: float) -> float:
+        if last_seconds <= 0 or not self.records:
+            return 0.0
+        if last_seconds >= self.record_seconds():
+            return self.records[-1]
+        # Smallest power-of-two window covering last_seconds.
+        level = max(0, int(math.ceil(math.log2(max(last_seconds, 1e-9)))))
+        level = min(level, len(self.records) - 1)
+        return self.records[level]
+
+    def record(self, value: float) -> None:
+        now = time.time()
+        elapsed = max(now - self.last_update_time, 1e-9)
+        total_time = min(elapsed + self.total_recording_time(), 2.0 ** 48)
+        new_records: List[float] = []
+        level = 0
+        while True:
+            span = 2.0 ** level
+            # Only keep windows no larger than the actual history, so
+            # record_seconds() never overclaims (level 0 always kept).
+            if level > 0 and span > total_time:
+                break
+            if span <= elapsed:
+                new_records.append(value)
+            else:
+                old = self.get_records_mean(span - elapsed)
+                new_records.append((value * elapsed + old * (span - elapsed)) / span)
+            if level >= 48:
+                break
+            level += 1
+        self.records = new_records
+        self.tail = max(0.0, total_time - self.record_seconds())
+        self.last_update_time = now
+
+    def get(self, last_seconds: float) -> float:
+        elapsed = time.time() - self.last_update_time
+        return self.get_records_mean(last_seconds + elapsed)
+
+    def __str__(self) -> str:
+        return f"StatisticalAverage(records={self.records})"
+
+
+class SpeedMeter:
+    """Units/sec meter over a sliding time window of (timestamp, total) pairs."""
+
+    def __init__(self, window_seconds: float = 300.0):
+        from collections import deque
+
+        self._window = window_seconds
+        self._events = deque()  # (timestamp, cumulative_total)
+        self._total = 0.0
+
+    def record(self, amount: float) -> None:
+        now = time.time()
+        self._start = getattr(self, "_start", now)
+        self._total += amount
+        self._events.append((now, amount))
+        while self._events and now - self._events[0][0] > self._window:
+            self._events.popleft()
+
+    def speed(self, last_seconds: float = 60.0) -> float:
+        if not self._events:
+            return 0.0
+        now = time.time()
+        cutoff = now - last_seconds
+        amount = sum(a for t, a in self._events if t >= cutoff)
+        # If history is shorter than the window, normalize by actual elapsed time.
+        span = min(last_seconds, max(now - self._start, 1e-9))
+        return amount / span
+
+
+def pytree_num_bytes(tree) -> int:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)

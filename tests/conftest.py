@@ -1,0 +1,38 @@
+"""Test fixture: simulate an 8-device TPU pod slice with CPU devices.
+
+Mirrors the reference's multi-worker-on-one-host simulation strategy
+(reference ``tests/internal/multi_process.py:9-52`` spawns N processes, one
+per CUDA device).  On TPU/JAX the analog is a single process with N virtual
+devices: we force the host platform to expose 8 CPU devices and run every
+sharded computation over a real ``jax.sharding.Mesh``, so collectives execute
+with genuine SPMD semantics.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax
+
+# The axon TPU plugin (single real chip) registers itself via sitecustomize and
+# overrides JAX_PLATFORMS; tests want the 8-device CPU simulation instead.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def group():
+    import bagua_tpu
+
+    return bagua_tpu.init_process_group(intra_size=4)

@@ -1,0 +1,56 @@
+"""Unit tests for utils: flatten/unflatten, dtype map, speed meters."""
+
+import time
+from unittest import mock
+
+import jax.numpy as jnp
+import numpy as np
+
+from bagua_tpu import utils
+from bagua_tpu.defs import dtype_itemsize
+
+
+def test_flatten_unflatten_roundtrip():
+    arrays = [jnp.arange(6.0).reshape(2, 3), jnp.ones((4,)), jnp.zeros((1, 1, 2))]
+    flat = utils.flatten(arrays)
+    assert flat.shape == (12,)
+    back = utils.unflatten(flat, [a.shape for a in arrays])
+    for a, b in zip(arrays, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dtype_roundtrip():
+    for d in [jnp.float32, jnp.float16, jnp.bfloat16, jnp.uint8, jnp.int32]:
+        name = utils.to_bagua_datatype(d)
+        assert utils.from_bagua_datatype(name) == d
+        assert dtype_itemsize(name) == jnp.dtype(d).itemsize
+
+
+def test_speed_meter_steady_rate():
+    with mock.patch("time.time") as t:
+        now = [1000.0]
+        t.side_effect = lambda: now[0]
+        m = utils.SpeedMeter()
+        for _ in range(200):
+            m.record(100.0)
+            now[0] += 1.0
+        assert abs(m.speed(60.0) - 100.0) < 5.0
+
+
+def test_statistical_average_window_bounded():
+    with mock.patch("time.time") as t:
+        now = [1000.0]
+        t.side_effect = lambda: now[0]
+        avg = utils.StatisticalAverage()
+        for _ in range(30):
+            avg.record(5.0)
+            now[0] += 1.0
+        # Window must stay near actual history (~30 s), not blow up to 2**len.
+        assert avg.total_recording_time() < 120.0
+        assert abs(avg.get(8.0) - 5.0) < 1e-6
+
+
+def test_align_size():
+    assert utils.align_size(10, 8) == 16
+    assert utils.align_size(16, 8) == 16
+    assert utils.align_size(1, 32) == 32
