@@ -1,0 +1,18 @@
+"""Algorithm registry (reference ``algorithms/__init__.py:8-33``)."""
+
+from bagua_tpu.algorithms.base import (  # noqa: F401
+    Algorithm,
+    AlgorithmImpl,
+    GlobalAlgorithmRegistry,
+    StepContext,
+)
+from bagua_tpu.algorithms.gradient_allreduce import (  # noqa: F401
+    GradientAllReduceAlgorithm,
+    GradientAllReduceAlgorithmImpl,
+)
+
+GlobalAlgorithmRegistry.register(
+    "gradient_allreduce",
+    GradientAllReduceAlgorithm,
+    "centralized synchronous full-precision gradient allreduce",
+)

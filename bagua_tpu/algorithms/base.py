@@ -1,0 +1,134 @@
+"""Algorithm plugin base: data-parallel relaxations as pure step transforms.
+
+TPU-native redesign of the reference's ``algorithms/base.py`` (``Algorithm`` /
+``AlgorithmImpl``, ``base.py:13-208``).  The reference customizes training via
+five imperative hooks (forward-pre, backward per-tensor, post-backward,
+post-optimizer-step) that drive a Rust scheduler.  Under XLA the whole train
+step is one traced function, so an algorithm is instead a set of **pure
+stages** the DDP engine composes into the step:
+
+======================  =====================================================
+reference hook          bagua_tpu stage (all traced, run inside shard_map)
+======================  =====================================================
+init_tensors            :meth:`comm_tree` — *which* leaves to communicate
+                        (grads / weights / optimizer state), the declarative
+                        replacement for proxy-tensor getter closures
+                        (reference ``tensor.py:19-34``)
+tensors_to_buckets      :meth:`tensors_to_buckets`
+init_forward_pre_hook   :meth:`on_step_start`
+init_backward_hook +    :meth:`transform_gradients` — gradients in, gradients
+init_post_backward_hook out; communication happens here (XLA overlaps it with
+                        remaining compute automatically)
+init_post_optimizer_    :meth:`on_step_end`
+step_hook
+init_operations         implicit: the collectives the stages emit
+need_reset              :meth:`need_reset` — True triggers a re-trace at a
+                        step boundary (e.g. QAdam warmup→compression switch)
+======================  =====================================================
+
+Every stage receives a :class:`StepContext` carrying the process group, the
+traced step counter, and the bucket plan.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from bagua_tpu.bucket import BucketPlan
+from bagua_tpu.communication import BaguaProcessGroup
+from bagua_tpu.env import get_default_bucket_size
+
+
+@dataclasses.dataclass
+class StepContext:
+    """Per-step info handed to every algorithm stage.
+
+    ``step`` is a traced scalar (int32) so schedules (e.g. shift_one peer
+    selection, warmup switches) compile into the step function.
+    """
+
+    group: BaguaProcessGroup
+    step: jnp.ndarray
+    plan: Optional[BucketPlan] = None
+    extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class AlgorithmImpl:
+    """A reified algorithm bound to a process group."""
+
+    #: whether gradients (True) or weights (False) are the communicated tree —
+    #: the reference expresses this via init_tensors registering either
+    #: ``param.bagua_ensure_grad`` or the param itself (``decentralized.py:44``).
+    communicate_gradients: bool = True
+
+    def __init__(self, process_group: BaguaProcessGroup, hierarchical: bool = False):
+        self.process_group = process_group
+        self.hierarchical = hierarchical
+
+    # -- structure ----------------------------------------------------------
+
+    def tensors_to_buckets(self, tree, bucket_size_bytes: Optional[int] = None) -> BucketPlan:
+        """Default: dtype-grouped greedy buckets, aligned to the group size."""
+        if bucket_size_bytes is None:
+            bucket_size_bytes = get_default_bucket_size()
+        return BucketPlan.from_tree(
+            tree, bucket_size_bytes, align_elems=self.process_group.size
+        )
+
+    def init_state(self, params) -> Any:
+        """Algorithm-private state pytree (peer weights, compression stats...)."""
+        return ()
+
+    # -- traced stages ------------------------------------------------------
+
+    def on_step_start(self, params, state, ctx: StepContext):
+        return params, state
+
+    def transform_gradients(self, grads, params, state, ctx: StepContext):
+        return grads, state
+
+    def on_step_end(self, params, state, ctx: StepContext):
+        return params, state
+
+    # -- control ------------------------------------------------------------
+
+    def need_reset(self, step: int) -> bool:
+        """Host-level: does the step function need re-tracing at this step?"""
+        return False
+
+
+class Algorithm:
+    """User-facing declarative algorithm config (reference ``base.py:13-48``)."""
+
+    def reify(self, process_group: BaguaProcessGroup) -> AlgorithmImpl:
+        raise NotImplementedError
+
+    @classmethod
+    def init(cls, name: str, **kwargs) -> "Algorithm":
+        return GlobalAlgorithmRegistry.get(name)(**kwargs)
+
+
+class _Registry:
+    """Reference ``GlobalAlgorithmRegistry`` (``base.py:211-263``)."""
+
+    def __init__(self):
+        self._algorithms: Dict[str, Tuple[Callable[..., Algorithm], str]] = {}
+
+    def register(self, name: str, factory: Callable[..., Algorithm], description: str = ""):
+        if name in self._algorithms:
+            raise ValueError(f"algorithm {name!r} already registered")
+        self._algorithms[name] = (factory, description)
+
+    def get(self, name: str) -> Callable[..., Algorithm]:
+        if name not in self._algorithms:
+            raise KeyError(
+                f"unknown algorithm {name!r}; registered: {sorted(self._algorithms)}"
+            )
+        return self._algorithms[name][0]
+
+    def keys(self) -> List[str]:
+        return sorted(self._algorithms)
+
+
+GlobalAlgorithmRegistry = _Registry()

@@ -1,0 +1,216 @@
+"""Bucketing: fuse pytree leaves into flat, dtype-homogeneous arrays.
+
+TPU-native redesign of the reference's ``BaguaBucket`` (``bucket.py:18-123``)
+and the greedy bucket-split in the autotune service
+(``autotune_task_manager.py:85-119``).  The reference flattens tensors into one
+contiguous CUDA storage so a single NCCL call covers many tensors; under XLA a
+``concatenate`` inside the jitted step achieves the same wire layout, and the
+compiler keeps it fused.  Explicit bucketing still matters for:
+
+* compressed collectives (ByteGrad quantizes per fixed-size chunk, so chunk
+  boundaries — bucket layout — are semantic);
+* the autotune service, which searches over bucket size and needs a stable
+  tensor→bucket assignment to hand back (``BaguaHyperparameter.buckets``);
+* overlap control: one collective per bucket bounds collective granularity.
+
+The reference's alignment padding tensor (``bucket.py:51-61``) becomes plain
+zero-padding of the fused array to a multiple of ``align_elems`` (set to the
+group size so every rank's scatter chunk is equal-sized).
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bagua_tpu.defs import TensorDeclaration, dtype_itemsize
+from bagua_tpu.utils import align_size, to_bagua_datatype, from_bagua_datatype
+
+
+def tree_leaf_names(tree) -> List[str]:
+    """Deterministic dotted-path names for every leaf of a pytree."""
+    paths_and_leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [jax.tree_util.keystr(path) for path, _ in paths_and_leaves]
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSlot:
+    """One tensor's position inside a fused bucket."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: str  # wire dtype name
+    offset: int  # element offset inside the bucket
+
+    @property
+    def numel(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """A fused bucket: an ordered set of slots plus padding to ``numel``."""
+
+    slots: Tuple[TensorSlot, ...]
+    numel: int  # total elements including padding
+    dtype: str
+
+    @property
+    def nbytes(self) -> int:
+        return self.numel * dtype_itemsize(self.dtype)
+
+    def declarations(self) -> List[TensorDeclaration]:
+        return [
+            TensorDeclaration(name=s.name, num_elements=s.numel, dtype=s.dtype)
+            for s in self.slots
+        ]
+
+
+class BucketPlan:
+    """A full tensor→bucket assignment for one pytree structure.
+
+    ``bucketize``/``debucketize`` are pure, traceable functions: they can be
+    called inside a jitted/shard_mapped train step.  Changing the plan (e.g.
+    when autotune proposes a new bucket size) triggers one recompilation of
+    the step function — the analog of the reference's ``_reset_buckets``
+    re-registration (``bagua_distributed.py:483-496``).
+    """
+
+    def __init__(self, specs: Sequence[BucketSpec], treedef, leaf_shapes, leaf_dtypes):
+        self.specs = list(specs)
+        self._treedef = treedef
+        self._leaf_shapes = list(leaf_shapes)
+        self._leaf_dtypes = list(leaf_dtypes)
+        # name -> (bucket_idx, slot)
+        self._index: Dict[str, Tuple[int, TensorSlot]] = {}
+        for bi, spec in enumerate(self.specs):
+            for slot in spec.slots:
+                self._index[slot.name] = (bi, slot)
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_tree(cls, tree, bucket_size_bytes: int, align_elems: int = 1) -> "BucketPlan":
+        """Greedy dtype-grouped split by byte size (reference
+        ``autotune_task_manager.py:85-119``)."""
+        paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        names = [jax.tree_util.keystr(p) for p, _ in paths_and_leaves]
+        leaves = [l for _, l in paths_and_leaves]
+        decls = [
+            TensorDeclaration(
+                name=n, num_elements=int(np.prod(l.shape)) if l.shape else 1,
+                dtype=to_bagua_datatype(l.dtype),
+            )
+            for n, l in zip(names, leaves)
+        ]
+        shapes = {n: tuple(l.shape) for n, l in zip(names, leaves)}
+        specs = split_declarations(decls, shapes, bucket_size_bytes, align_elems)
+        return cls(specs, treedef, [tuple(l.shape) for l in leaves], [l.dtype for l in leaves])
+
+    @classmethod
+    def from_declarations(
+        cls, buckets: Sequence[Sequence[TensorDeclaration]], tree, align_elems: int = 1
+    ) -> "BucketPlan":
+        """Build a plan from an autotune-provided bucket assignment."""
+        paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        names = [jax.tree_util.keystr(p) for p, _ in paths_and_leaves]
+        leaves = [l for _, l in paths_and_leaves]
+        shapes = {n: tuple(l.shape) for n, l in zip(names, leaves)}
+        specs = []
+        for bi, bucket in enumerate(buckets):
+            if not bucket:
+                raise ValueError(f"bucket {bi} in supplied assignment is empty")
+            dtypes = {td.dtype for td in bucket}
+            if len(dtypes) != 1:
+                raise ValueError(
+                    f"bucket {bi} mixes dtypes {sorted(dtypes)}; buckets must be "
+                    "dtype-homogeneous (reference datatypes/mod.rs:1135-1147)"
+                )
+            offset = 0
+            slots = []
+            for td in bucket:
+                slots.append(
+                    TensorSlot(name=td.name, shape=shapes[td.name], dtype=td.dtype, offset=offset)
+                )
+                offset += td.num_elements
+            specs.append(
+                BucketSpec(slots=tuple(slots), numel=align_size(offset, align_elems), dtype=bucket[0].dtype)
+            )
+        return cls(specs, treedef, [tuple(l.shape) for l in leaves], [l.dtype for l in leaves])
+
+    # -- traced transforms --------------------------------------------------
+
+    def bucketize(self, tree) -> List[jnp.ndarray]:
+        """Fuse pytree leaves into flat per-bucket arrays (traceable)."""
+        paths_and_leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        by_name = {jax.tree_util.keystr(p): l for p, l in paths_and_leaves}
+        flats = []
+        for spec in self.specs:
+            parts = [by_name[s.name].reshape(-1) for s in spec.slots]
+            used = sum(p.shape[0] for p in parts)
+            if used < spec.numel:
+                parts.append(jnp.zeros((spec.numel - used,), from_bagua_datatype(spec.dtype)))
+            flats.append(jnp.concatenate(parts) if len(parts) > 1 else parts[0])
+        return flats
+
+    def debucketize(self, flats: Sequence[jnp.ndarray]):
+        """Rebuild the original pytree from fused arrays (traceable)."""
+        leaves_by_name: Dict[str, jnp.ndarray] = {}
+        for spec, flat in zip(self.specs, flats):
+            for s in spec.slots:
+                leaves_by_name[s.name] = flat[s.offset : s.offset + s.numel].reshape(s.shape)
+        # Reassemble in treedef leaf order.
+        dummy = self._treedef.unflatten(range(self._treedef.num_leaves))
+        paths = [p for p, _ in jax.tree_util.tree_flatten_with_path(dummy)[0]]
+        ordered = [leaves_by_name[jax.tree_util.keystr(p)] for p in paths]
+        return self._treedef.unflatten(ordered)
+
+    # -- introspection ------------------------------------------------------
+
+    def declarations(self) -> List[List[TensorDeclaration]]:
+        return [spec.declarations() for spec in self.specs]
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.specs)
+
+    def total_bytes(self) -> int:
+        return sum(s.nbytes for s in self.specs)
+
+    def __repr__(self) -> str:
+        return f"BucketPlan(buckets={[(len(s.slots), s.numel, s.dtype) for s in self.specs]})"
+
+
+def split_declarations(
+    decls: Sequence[TensorDeclaration],
+    shapes: Dict[str, Tuple[int, ...]],
+    bucket_size_bytes: int,
+    align_elems: int = 1,
+) -> List[BucketSpec]:
+    """Greedy in-order fill, grouped by dtype, cut at ``bucket_size_bytes``
+    (reference ``autotune_task_manager.py:85-119`` groups by dtype then splits
+    by byte budget, preserving registration order within a group)."""
+    by_dtype: Dict[str, List[TensorDeclaration]] = {}
+    for td in decls:
+        by_dtype.setdefault(td.dtype, []).append(td)
+
+    specs: List[BucketSpec] = []
+    for dtype, group in by_dtype.items():
+        item = dtype_itemsize(dtype)
+        current: List[TensorSlot] = []
+        offset = 0
+        for td in group:
+            if current and (offset + td.num_elements) * item > bucket_size_bytes:
+                specs.append(
+                    BucketSpec(tuple(current), align_size(offset, align_elems), dtype)
+                )
+                current, offset = [], 0
+            current.append(
+                TensorSlot(name=td.name, shape=shapes[td.name], dtype=dtype, offset=offset)
+            )
+            offset += td.num_elements
+        if current:
+            specs.append(BucketSpec(tuple(current), align_size(offset, align_elems), dtype))
+    return specs

@@ -91,21 +91,6 @@ class BaguaProcessGroup:
             fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
         )
 
-    def spmd(self, fn: Callable):
-        """Wrap ``fn(local_view) -> local_view`` as a jitted per-rank map over
-        stacked ``(size, ...)`` arrays (the eager-collective calling convention)."""
-
-        def stacked(tree):
-            return jax.jit(
-                self.shard_map(
-                    fn,
-                    in_specs=P(ALL_AXES),
-                    out_specs=P(ALL_AXES),
-                )
-            )(tree)
-
-        return stacked
-
 
 def init_process_group(
     devices: Optional[Sequence] = None,
@@ -289,46 +274,69 @@ def hierarchical_allreduce_inplace(x: jnp.ndarray, op: ReduceOp = ReduceOp.AVG) 
 # ---------------------------------------------------------------------------
 
 
-def _eager(group: Optional[BaguaProcessGroup], fn: Callable):
-    """Lift ``fn(local_value) -> local_value`` over stacked ``(size, ...)``
-    arrays.  The stacked leading axis is sharded over the mesh, so each rank's
-    local block is ``(1, ...)``; we strip/restore that axis around ``fn``."""
+# Jitted eager-collective cache: (mesh, key) -> compiled callable.  Without
+# this every eager call would rebuild a closure and re-trace (~80x overhead).
+_EAGER_CACHE: dict = {}
+
+
+def _eager(group: Optional[BaguaProcessGroup], key: tuple, make_fn: Callable):
+    """Lift ``make_fn()(local_value) -> local_value`` over stacked
+    ``(size, ...)`` arrays.  The stacked leading axis is sharded over the
+    mesh, so each rank's local block is ``(1, ...)``; we strip/restore that
+    axis around the collective.  Compiled callables are cached per
+    ``(mesh, key)`` (jit handles shape/dtype polymorphism internally)."""
     group = group or get_default_group()
+    cache_key = (group.mesh, key)
+    cached = _EAGER_CACHE.get(cache_key)
+    if cached is None:
+        fn = make_fn()
 
-    def per_rank(x):
-        return fn(x[0])[None]
+        def per_rank(x):
+            return fn(x[0])[None]
 
-    return jax.jit(group.shard_map(per_rank, in_specs=P(ALL_AXES), out_specs=P(ALL_AXES)))
+        cached = jax.jit(
+            group.shard_map(per_rank, in_specs=P(ALL_AXES), out_specs=P(ALL_AXES))
+        )
+        _EAGER_CACHE[cache_key] = cached
+    return cached
 
 
 def allreduce(send, op: ReduceOp = ReduceOp.AVG, comm: Optional[BaguaProcessGroup] = None):
     """Eager allreduce (reference ``communication.py:848``). ``send`` is a
     stacked per-rank array of shape ``(group.size, ...)``."""
     op = ReduceOp(op)
-    return _eager(comm, functools.partial(allreduce_inplace, op=op))(send)
+    return _eager(
+        comm, ("allreduce", op), lambda: functools.partial(allreduce_inplace, op=op)
+    )(send)
 
 
 def allgather(send, comm: Optional[BaguaProcessGroup] = None):
     """Each output slice is the concatenation of every rank's slice
     (reference ``communication.py:1038``)."""
-    return _eager(comm, functools.partial(allgather_inplace, tiled=True))(send)
+    return _eager(
+        comm, ("allgather",), lambda: functools.partial(allgather_inplace, tiled=True)
+    )(send)
 
 
 def reducescatter(send, op: ReduceOp = ReduceOp.SUM, comm: Optional[BaguaProcessGroup] = None):
     op = ReduceOp(op)
-    return _eager(comm, functools.partial(reduce_scatter_inplace, op=op))(send)
+    return _eager(
+        comm, ("reducescatter", op), lambda: functools.partial(reduce_scatter_inplace, op=op)
+    )(send)
 
 
 def broadcast(send, src: int = 0, comm: Optional[BaguaProcessGroup] = None):
     """Broadcast rank ``src``'s slice to every rank
     (reference ``communication.py:573``)."""
-    return _eager(comm, functools.partial(broadcast_inplace, src_rank=src))(send)
+    return _eager(
+        comm, ("broadcast", src), lambda: functools.partial(broadcast_inplace, src_rank=src)
+    )(send)
 
 
 def alltoall(send, comm: Optional[BaguaProcessGroup] = None):
     """Reference ``communication.py:1100`` alltoall: each rank's slice is
     split into ``size`` chunks and chunk j goes to rank j."""
-    return _eager(comm, alltoall_inplace)(send)
+    return _eager(comm, ("alltoall",), lambda: alltoall_inplace)(send)
 
 
 def reduce(send, dst: int = 0, op: ReduceOp = ReduceOp.AVG, comm: Optional[BaguaProcessGroup] = None):
@@ -336,24 +344,30 @@ def reduce(send, dst: int = 0, op: ReduceOp = ReduceOp.AVG, comm: Optional[Bagua
     (reference ``communication.py:958``)."""
     op = ReduceOp(op)
 
-    def fn(x):
-        red = allreduce_inplace(x, op=op)
-        return jnp.where(rank_id() == dst, red, x)
+    def make():
+        def fn(x):
+            red = allreduce_inplace(x, op=op)
+            return jnp.where(rank_id() == dst, red, x)
 
-    return _eager(comm, fn)(send)
+        return fn
+
+    return _eager(comm, ("reduce", op, dst), make)(send)
 
 
 def scatter(send, src: int = 0, comm: Optional[BaguaProcessGroup] = None):
     """Rank ``src``'s slice is chunked across ranks; rank i's output is chunk i
     (reference ``communication.py:1155``)."""
 
-    def fn(x):
-        n = axis_size()
-        full = broadcast_inplace(x, src_rank=src)
-        chunks = jnp.reshape(full, (n, x.shape[0] // n) + x.shape[1:])
-        return jnp.take(chunks, rank_id(), axis=0)
+    def make():
+        def fn(x):
+            n = axis_size()
+            full = broadcast_inplace(x, src_rank=src)
+            chunks = jnp.reshape(full, (n, x.shape[0] // n) + x.shape[1:])
+            return jnp.take(chunks, rank_id(), axis=0)
 
-    return _eager(comm, fn)(send)
+        return fn
+
+    return _eager(comm, ("scatter", src), make)(send)
 
 
 def gather(send, dst: int = 0, comm: Optional[BaguaProcessGroup] = None):
@@ -361,13 +375,16 @@ def gather(send, dst: int = 0, comm: Optional[BaguaProcessGroup] = None):
     slice tiled (reference ``communication.py:1081`` leaves recv untouched;
     a uniform output shape requires *some* value there)."""
 
-    def fn(x):
-        g = allgather_inplace(x, tiled=True)
-        n = axis_size()
-        mine = jnp.tile(x, (n,) + (1,) * (x.ndim - 1))
-        return jnp.where(rank_id() == dst, g, mine)
+    def make():
+        def fn(x):
+            g = allgather_inplace(x, tiled=True)
+            n = axis_size()
+            mine = jnp.tile(x, (n,) + (1,) * (x.ndim - 1))
+            return jnp.where(rank_id() == dst, g, mine)
 
-    return _eager(comm, fn)(send)
+        return fn
+
+    return _eager(comm, ("gather", dst), make)(send)
 
 
 def barrier(comm: Optional[BaguaProcessGroup] = None):

@@ -9,10 +9,7 @@ autotune service, plus the ``ReduceOp`` enum used by the collective API
 import enum
 from typing import Dict, List
 
-try:
-    from pydantic import BaseModel
-except ImportError:  # pragma: no cover - pydantic is expected in the image
-    BaseModel = object  # type: ignore
+from pydantic import BaseModel
 
 
 class DType(str, enum.Enum):
@@ -69,7 +66,7 @@ class BaguaHyperparameter(BaseModel):
     is_hierarchical_reduce: bool = False
 
     def update(self, param_dict: Dict) -> "BaguaHyperparameter":
-        tmp = self.dict()
+        tmp = self.model_dump()
         for key, value in param_dict.items():
             if key in tmp:
                 if key == "buckets":

@@ -1,0 +1,3 @@
+"""Model zoo used by the examples, tests and benchmarks."""
+
+from bagua_tpu.models.mlp import init_mlp, mlp_apply  # noqa: F401

@@ -146,13 +146,13 @@ class SpeedMeter:
         from collections import deque
 
         self._window = window_seconds
-        self._events = deque()  # (timestamp, cumulative_total)
-        self._total = 0.0
+        self._events = deque()  # (timestamp, amount)
+        self._start: Optional[float] = None
 
     def record(self, amount: float) -> None:
         now = time.time()
-        self._start = getattr(self, "_start", now)
-        self._total += amount
+        if self._start is None:
+            self._start = now
         self._events.append((now, amount))
         while self._events and now - self._events[0][0] > self._window:
             self._events.popleft()
