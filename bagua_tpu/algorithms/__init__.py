@@ -11,8 +11,18 @@ from bagua_tpu.algorithms.gradient_allreduce import (  # noqa: F401
     GradientAllReduceAlgorithmImpl,
 )
 
+from bagua_tpu.algorithms.bytegrad import (  # noqa: F401
+    ByteGradAlgorithm,
+    ByteGradAlgorithmImpl,
+)
+
 GlobalAlgorithmRegistry.register(
     "gradient_allreduce",
     GradientAllReduceAlgorithm,
     "centralized synchronous full-precision gradient allreduce",
+)
+GlobalAlgorithmRegistry.register(
+    "bytegrad",
+    ByteGradAlgorithm,
+    "centralized synchronous 8-bit compressed gradient allreduce",
 )

@@ -1,0 +1,8 @@
+"""Compute kernels: XLA-level reference implementations + Pallas TPU kernels."""
+
+from bagua_tpu.kernels.minmax_uint8 import (  # noqa: F401
+    compress_minmax_uint8,
+    decompress_minmax_uint8,
+    compress_minmax_uint8_pallas,
+    decompress_minmax_uint8_pallas,
+)

@@ -1,0 +1,172 @@
+"""MinMaxUInt8 quantization: 8-bit lossy compression for collectives.
+
+TPU-native reimplementation of the reference's CUDA MinMaxUInt8 scheme
+(``kernels/bagua_kernels.cu:404-572``; pure-torch oracle
+``tests/internal/compressor.py:4-33``).  Semantics, per chunk:
+
+    scale       = 255 / (max - min + 1e-7)
+    upper_bound = rint(max * scale)
+    lower_bound = upper_bound - 255
+    q           = clip(rint(x * scale), -inf, upper_bound) - lower_bound   (uint8)
+    x'          = (q + lower_bound) / scale
+
+Differences from the reference are layout-only: the CUDA kernel packs min/max
+into a 32-byte header ahead of each chunk inside one byte buffer
+(``datatypes/mod.rs:703-777`` computes that layout); here the quantized
+payload and the per-chunk ``(min, max)`` pairs are separate arrays — XLA
+manages buffers, so byte-level packing would only obstruct fusion.
+
+Two implementations with identical semantics:
+
+* :func:`compress_minmax_uint8` — pure jnp; XLA fuses it around collectives.
+* :func:`compress_minmax_uint8_pallas` — Pallas TPU kernel, one grid step per
+  chunk (used when the chunk fits VMEM; falls back to jnp otherwise).
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-7
+LEVELS = 255.0
+
+
+# ---------------------------------------------------------------------------
+# XLA (jnp) implementation — the semantic reference
+# ---------------------------------------------------------------------------
+
+
+def _quantize(x, mn, mx):
+    scale = LEVELS / (mx - mn + EPS)
+    upper = jnp.round(mx * scale)
+    lower = upper - LEVELS
+    level = jnp.minimum(jnp.round(x * scale), upper)
+    return (level - lower).astype(jnp.uint8)
+
+
+def compress_minmax_uint8(chunks: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compress ``chunks`` of shape ``(nchunks, chunk_size)``.
+
+    Returns ``(q, minmax)`` with ``q`` uint8 of the same shape and ``minmax``
+    float32 of shape ``(nchunks, 2)``.
+    """
+    x = chunks.astype(jnp.float32)
+    mn = jnp.min(x, axis=1, keepdims=True)
+    mx = jnp.max(x, axis=1, keepdims=True)
+    q = _quantize(x, mn, mx)
+    minmax = jnp.concatenate([mn, mx], axis=1)
+    return q, minmax
+
+
+def decompress_minmax_uint8(
+    q: jnp.ndarray, minmax: jnp.ndarray, out_dtype=jnp.float32
+) -> jnp.ndarray:
+    """Inverse of :func:`compress_minmax_uint8` (lossy)."""
+    mn = minmax[:, 0:1]
+    mx = minmax[:, 1:2]
+    scale = LEVELS / (mx - mn + EPS)
+    upper = jnp.round(mx * scale)
+    lower = upper - LEVELS
+    return ((q.astype(jnp.float32) + lower) / scale).astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernels
+# ---------------------------------------------------------------------------
+
+
+# TPU tiling: blocks are (sublane, lane)-tiled, so each chunk is viewed as
+# (rows, 128) with rows a multiple of 8 (uint8 wants 32).  Chunks that don't
+# divide evenly fall back to the jnp implementation — semantics identical.
+_LANE = 128
+_ROW_ALIGN = 32  # uint8 min sublane tile
+
+
+def pallas_chunk_supported(chunk: int) -> bool:
+    return chunk % (_LANE * _ROW_ALIGN) == 0
+
+
+def _compress_kernel(x_ref, q_ref, mm_ref):
+    x = x_ref[0].astype(jnp.float32)  # (rows, 128)
+    mn = jnp.min(x)
+    mx = jnp.max(x)
+    scale = LEVELS / (mx - mn + EPS)
+    upper = jnp.round(mx * scale)
+    lower = upper - LEVELS
+    level = jnp.minimum(jnp.round(x * scale), upper)
+    # Mosaic has no direct f32->u8 cast; go through i32.
+    q_ref[0] = (level - lower).astype(jnp.int32).astype(jnp.uint8)
+    # VMEM refuses scalar stores; write (1, 2) as one vector store.
+    mm_ref[0] = jnp.stack([mn, mx]).reshape(1, 2)
+
+
+def _decompress_kernel(q_ref, mm_ref, x_ref):
+    mm = mm_ref[0]
+    mn = mm[0, 0]
+    mx = mm[0, 1]
+    scale = LEVELS / (mx - mn + EPS)
+    upper = jnp.round(mx * scale)
+    lower = upper - LEVELS
+    q = q_ref[0].astype(jnp.int32).astype(jnp.float32)
+    x_ref[0] = ((q + lower) / scale).astype(x_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def compress_minmax_uint8_pallas(
+    chunks: jnp.ndarray, interpret: bool = False
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pallas version of :func:`compress_minmax_uint8`: grid over chunks, one
+    VMEM-resident chunk per step.  Falls back to the jnp implementation when
+    the chunk size doesn't satisfy TPU tiling."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nchunks, chunk = chunks.shape
+    if not pallas_chunk_supported(chunk):
+        return compress_minmax_uint8(chunks)
+    rows = chunk // _LANE
+    x3 = chunks.reshape(nchunks, rows, _LANE)
+    q, mm = pl.pallas_call(
+        _compress_kernel,
+        grid=(nchunks,),
+        in_specs=[
+            pl.BlockSpec((1, rows, _LANE), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=[
+            pl.BlockSpec((1, rows, _LANE), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 2), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nchunks, rows, _LANE), jnp.uint8),
+            jax.ShapeDtypeStruct((nchunks, 1, 2), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x3)
+    return q.reshape(nchunks, chunk), mm.reshape(nchunks, 2)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decompress_minmax_uint8_pallas(
+    q: jnp.ndarray, minmax: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nchunks, chunk = q.shape
+    if not pallas_chunk_supported(chunk):
+        return decompress_minmax_uint8(q, minmax)
+    rows = chunk // _LANE
+    out = pl.pallas_call(
+        _decompress_kernel,
+        grid=(nchunks,),
+        in_specs=[
+            pl.BlockSpec((1, rows, _LANE), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 2), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, rows, _LANE), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nchunks, rows, _LANE), jnp.float32),
+        interpret=interpret,
+    )(q.reshape(nchunks, rows, _LANE), minmax.reshape(nchunks, 1, 2))
+    return out.reshape(nchunks, chunk)
