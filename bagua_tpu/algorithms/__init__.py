@@ -15,6 +15,12 @@ from bagua_tpu.algorithms.bytegrad import (  # noqa: F401
     ByteGradAlgorithm,
     ByteGradAlgorithmImpl,
 )
+from bagua_tpu.algorithms.decentralized import (  # noqa: F401
+    DecentralizedAlgorithm,
+    DecentralizedAlgorithmImpl,
+    LowPrecisionDecentralizedAlgorithm,
+    LowPrecisionDecentralizedAlgorithmImpl,
+)
 
 GlobalAlgorithmRegistry.register(
     "gradient_allreduce",
@@ -25,4 +31,37 @@ GlobalAlgorithmRegistry.register(
     "bytegrad",
     ByteGradAlgorithm,
     "centralized synchronous 8-bit compressed gradient allreduce",
+)
+GlobalAlgorithmRegistry.register(
+    "decentralized",
+    DecentralizedAlgorithm,
+    "decentralized synchronous full-precision weight averaging",
+)
+GlobalAlgorithmRegistry.register(
+    "low_precision_decentralized",
+    LowPrecisionDecentralizedAlgorithm,
+    "decentralized synchronous 8-bit compressed ring weight-diff exchange",
+)
+
+from bagua_tpu.algorithms.q_adam import (  # noqa: F401,E402
+    QAdamAlgorithm,
+    QAdamAlgorithmImpl,
+    QAdamOptimizer,
+)
+
+GlobalAlgorithmRegistry.register(
+    "qadam",
+    QAdamAlgorithm,
+    "centralized synchronous quantized-momentum Adam",
+)
+
+from bagua_tpu.algorithms.async_model_average import (  # noqa: F401,E402
+    AsyncModelAverageAlgorithm,
+    AsyncModelAverageAlgorithmImpl,
+)
+
+GlobalAlgorithmRegistry.register(
+    "async",
+    AsyncModelAverageAlgorithm,
+    "asynchronous model averaging with host-armed time-scheduled sync",
 )

@@ -10,8 +10,11 @@ stages** the DDP engine composes into the step:
 ======================  =====================================================
 reference hook          bagua_tpu stage (all traced, run inside shard_map)
 ======================  =====================================================
-init_tensors            :meth:`comm_tree` — *which* leaves to communicate
-                        (grads / weights / optimizer state), the declarative
+init_tensors            implicit: the stage an algorithm communicates in
+                        determines *which* leaves travel (grads in
+                        ``transform_gradients``, weights in
+                        ``on_step_start``/``on_step_end``, optimizer state
+                        held in the algorithm's own state) — the declarative
                         replacement for proxy-tensor getter closures
                         (reference ``tensor.py:19-34``)
 tensors_to_buckets      :meth:`tensors_to_buckets`
@@ -57,11 +60,6 @@ class StepContext:
 class AlgorithmImpl:
     """A reified algorithm bound to a process group."""
 
-    #: whether gradients (True) or weights (False) are the communicated tree —
-    #: the reference expresses this via init_tensors registering either
-    #: ``param.bagua_ensure_grad`` or the param itself (``decentralized.py:44``).
-    communicate_gradients: bool = True
-
     def __init__(self, process_group: BaguaProcessGroup, hierarchical: bool = False):
         self.process_group = process_group
         self.hierarchical = hierarchical
@@ -86,7 +84,12 @@ class AlgorithmImpl:
         return params, state
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
-        return grads, state
+        """Runs between backward and the optimizer update.  May transform the
+        gradients (centralized algorithms) and/or replace the parameters the
+        update is applied to (decentralized algorithms copy back the averaged
+        peer weights here, the analog of ``copy_back_peer_weight``,
+        ``decentralized_full_precision_synchronous.rs:106-124``)."""
+        return grads, params, state
 
     def on_step_end(self, params, state, ctx: StepContext):
         return params, state
@@ -96,6 +99,11 @@ class AlgorithmImpl:
     def need_reset(self, step: int) -> bool:
         """Host-level: does the step function need re-tracing at this step?"""
         return False
+
+    def step_variant(self, step: int) -> str:
+        """Host-level choice among compiled step variants (cached per key).
+        The async algorithm uses this to arm a time-scheduled sync step."""
+        return "default"
 
 
 class Algorithm:

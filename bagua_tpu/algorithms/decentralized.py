@@ -1,0 +1,247 @@
+"""Decentralized SGD: full-precision and low-precision (ring) variants.
+
+TPU-native analog of the reference's ``decentralized.py`` and the Rust ops
+``decentralized_full_precision_synchronous.rs`` /
+``decentralized_low_precision_synchronous.rs``.
+
+**Full precision** (reference ``decentralized.py:12-110``): each step the
+*weights* (one fused bucket, ``decentralized.py:52-61``) are exchanged with
+peers — either ``all`` (allreduce-AVG into a peer buffer) or ``shift_one``
+(symmetric pairing that cycles with the step counter,
+``decentralized_full_precision_synchronous.rs:80-86``) — and the averaged
+peer weights replace the parameters before the optimizer update (the
+reference starts the exchange at forward-pre and copies back post-backward;
+dataflow-wise that is exactly "grads at w_t, update applied to avg(w_t)",
+and XLA overlaps the exchange with the backward pass on its own).
+
+**Low precision** (reference ``decentralized.py:112-214``, Rust op above):
+runs *after* the optimizer step.  Each rank keeps three replicas per bucket —
+``weight`` (own weights at last sync), ``left``/``right`` (ring neighbors'),
+— compresses the mixed difference
+
+    diff = (t - w) + (L - w)/3 + (R - w)/3      [t = fresh post-optimizer]
+
+with MinMaxUInt8 (whole bucket = one chunk), exchanges it both ways around
+the ring, accumulates the received diffs into the neighbor replicas, and
+overwrites both ``w`` and the live parameters with ``w + dequant(own diff)``
+so every rank's view of every replica stays bitwise-consistent.
+
+``hierarchical=True`` (the reference default) averages over the ``intra``
+axis first and runs the decentralized exchange over the ``inter`` axis only,
+so "peers" are machines, not chips.
+"""
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
+from bagua_tpu.communication import (
+    ALL_AXES,
+    INTER_AXIS,
+    INTRA_AXIS,
+    ReduceOp,
+    allreduce_inplace,
+    axis_size,
+    ppermute_apply,
+    ppermute_shift,
+)
+from bagua_tpu.kernels.minmax_uint8 import (
+    compress_minmax_uint8,
+    decompress_minmax_uint8,
+)
+
+
+def _shift_one_perm(step: int, n: int) -> List[Tuple[int, int]]:
+    """The reference's step-indexed symmetric pairing
+    (``decentralized_full_precision_synchronous.rs:80-86``): rank < n/2 pairs
+    with ``((step + rank) % (n/2)) + n/2``."""
+    h = n // 2
+    perm = []
+    for r in range(n):
+        if r < h:
+            peer = ((step + r) % h) + h
+        else:
+            peer = (r - h - step) % h
+        perm.append((r, peer))
+    return perm
+
+
+def _exchange(flat: jnp.ndarray, step, mode: str, axes) -> jnp.ndarray:
+    """One decentralized exchange returning the averaged peer weight."""
+    n = axis_size(axes)
+    if n == 1:
+        return flat
+    if mode == "all":
+        return allreduce_inplace(flat, op=ReduceOp.AVG, axis=axes)
+    if mode == "shift_one":
+        if n % 2 != 0:
+            raise ValueError(
+                "shift_one requires an even number of peers "
+                f"(got {n}); see reference decentralized_full_precision_synchronous.rs:71-79"
+            )
+        h = n // 2
+        branches = [
+            (lambda x, perm=_shift_one_perm(s, n): ppermute_apply(x, perm, axes))
+            for s in range(h)
+        ]
+        recv = jax.lax.switch(step % h, branches, flat)
+        return (flat + recv) * 0.5
+    raise ValueError(f"unknown peer_selection_mode {mode!r}")
+
+
+class DecentralizedAlgorithmImpl(AlgorithmImpl):
+
+    def __init__(
+        self,
+        process_group,
+        hierarchical: bool = True,
+        peer_selection_mode: str = "all",
+        communication_interval: int = 1,
+    ):
+        super().__init__(process_group, hierarchical=hierarchical)
+        self.peer_selection_mode = peer_selection_mode
+        self.communication_interval = communication_interval
+
+    def tensors_to_buckets(self, tree, bucket_size_bytes=None):
+        # The reference puts ALL weights in one bucket (``decentralized.py:52-61``).
+        return super().tensors_to_buckets(tree, bucket_size_bytes=1 << 62)
+
+    def transform_gradients(self, grads, params, state, ctx: StepContext):
+        # The reference op keeps its own counter incremented once per executed
+        # exchange (the `step` Mutex in decentralized_full_precision_
+        # synchronous.rs), so the shift_one schedule cycles through every peer
+        # even when communication_interval skips steps.
+        comm_round = ctx.step // self.communication_interval
+
+        def communicate(params):
+            flats = ctx.plan.bucketize(params)
+            out = []
+            for flat in flats:
+                if self.hierarchical and self.process_group.intra_size > 1:
+                    flat = allreduce_inplace(flat, op=ReduceOp.AVG, axis=INTRA_AXIS)
+                    out.append(
+                        _exchange(flat, comm_round, self.peer_selection_mode, (INTER_AXIS,))
+                    )
+                else:
+                    out.append(_exchange(flat, comm_round, self.peer_selection_mode, ALL_AXES))
+            return ctx.plan.debucketize(out)
+
+        if self.communication_interval > 1:
+            params = jax.lax.cond(
+                ctx.step % self.communication_interval == 0, communicate, lambda p: p, params
+            )
+        else:
+            params = communicate(params)
+        return grads, params, state
+
+
+class DecentralizedAlgorithm(Algorithm):
+    def __init__(
+        self,
+        hierarchical: bool = True,
+        peer_selection_mode: str = "all",
+        communication_interval: int = 1,
+    ):
+        self.hierarchical = hierarchical
+        self.peer_selection_mode = peer_selection_mode
+        self.communication_interval = communication_interval
+
+    def reify(self, process_group) -> DecentralizedAlgorithmImpl:
+        return DecentralizedAlgorithmImpl(
+            process_group,
+            hierarchical=self.hierarchical,
+            peer_selection_mode=self.peer_selection_mode,
+            communication_interval=self.communication_interval,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Low-precision (ring, compressed weight diffs)
+# ---------------------------------------------------------------------------
+
+
+class LowPrecisionDecentralizedAlgorithmImpl(AlgorithmImpl):
+
+    def __init__(self, process_group, hierarchical: bool = True, communication_interval: int = 1):
+        super().__init__(process_group, hierarchical=hierarchical)
+        self.communication_interval = communication_interval
+
+    def tensors_to_buckets(self, tree, bucket_size_bytes=None):
+        return super().tensors_to_buckets(tree, bucket_size_bytes=1 << 62)
+
+    def _axes(self):
+        if self.hierarchical and self.process_group.intra_size > 1:
+            return (INTER_AXIS,)
+        return ALL_AXES
+
+    def init_state(self, params):
+        # weight / left / right replicas, one flat array per bucket
+        # (reference ``decentralized.py:186-197`` initializes the replicas
+        # from the freshly-broadcast weights, so all three start equal).
+        plan = self.tensors_to_buckets(params)
+        flats = plan.bucketize(params)
+        return {
+            "weight": [f for f in flats],
+            "left": [f for f in flats],
+            "right": [f for f in flats],
+        }
+
+    def on_step_end(self, params, state, ctx: StepContext):
+        axes = self._axes()
+
+        def communicate(operand):
+            params, state = operand
+            flats = ctx.plan.bucketize(params)
+            if self.hierarchical and self.process_group.intra_size > 1:
+                flats = [
+                    allreduce_inplace(f, op=ReduceOp.AVG, axis=INTRA_AXIS) for f in flats
+                ]
+            new_flats, new_w, new_l, new_r = [], [], [], []
+            for t, w, left, right in zip(
+                flats, state["weight"], state["left"], state["right"]
+            ):
+                # diff = t + L/3 + R/3 - 5w/3, the reference's addmul sequence
+                diff = t + left / 3.0 + right / 3.0 - w * (5.0 / 3.0)
+                q, mm = compress_minmax_uint8(diff[None])
+                # ring exchange both directions: send to left & right, recv
+                # from left & right (shift +1 receives from the left peer)
+                lq = ppermute_shift(q, 1, axes)
+                lmm = ppermute_shift(mm, 1, axes)
+                rq = ppermute_shift(q, -1, axes)
+                rmm = ppermute_shift(mm, -1, axes)
+                left = left + decompress_minmax_uint8(lq, lmm)[0]
+                right = right + decompress_minmax_uint8(rq, rmm)[0]
+                own = decompress_minmax_uint8(q, mm)[0]
+                t_new = own + w
+                new_flats.append(t_new.astype(t.dtype))
+                new_w.append(t_new.astype(t.dtype))
+                new_l.append(left.astype(t.dtype))
+                new_r.append(right.astype(t.dtype))
+            params = ctx.plan.debucketize(new_flats)
+            return params, {"weight": new_w, "left": new_l, "right": new_r}
+
+        if self.communication_interval > 1:
+            params, state = jax.lax.cond(
+                ctx.step % self.communication_interval == 0,
+                communicate,
+                lambda o: o,
+                (params, state),
+            )
+        else:
+            params, state = communicate((params, state))
+        return params, state
+
+
+class LowPrecisionDecentralizedAlgorithm(Algorithm):
+    def __init__(self, hierarchical: bool = True, communication_interval: int = 1):
+        self.hierarchical = hierarchical
+        self.communication_interval = communication_interval
+
+    def reify(self, process_group) -> LowPrecisionDecentralizedAlgorithmImpl:
+        return LowPrecisionDecentralizedAlgorithmImpl(
+            process_group,
+            hierarchical=self.hierarchical,
+            communication_interval=self.communication_interval,
+        )

@@ -1,0 +1,155 @@
+"""QAdam: quantized-momentum Adam (centralized, synchronous).
+
+TPU-native analog of the reference's ``q_adam.py``.  Two phases:
+
+* **warmup** (``step_id < warmup_steps``): gradients are allreduce-averaged
+  (flat, like the reference's warmup op ``q_adam.py:205-212``) and both Adam
+  moments update normally.
+* **compression**: the *first momentum* is updated locally from the raw
+  gradient (the reference's ``calculate_momentum`` python op,
+  ``q_adam.py:214-221``), then exchanged with the MinMaxUInt8 scatter-gather
+  pipeline (hierarchical by default); the second moment is frozen
+  (``q_adam.py:88-96`` only updates moments during warmup).
+
+The reference rebuilds bucket ops at the warmup boundary via ``need_reset``
+(``q_adam.py:136-143``); here the boundary is a ``lax.cond`` on the traced
+step counter, so there is no recompilation.
+
+Faithful quirk: ``weight_decay`` only affects the update during warmup — in
+the reference's compression phase the momentum op reads ``tensor.grad``
+directly and the optimizer's decayed gradient is never consumed.
+
+The Adam update itself (``q_adam.py:97-103``):
+
+    denom = sqrt(v) / sqrt(1 - b2^t) + eps
+    param -= lr / (1 - b1^t) * m / denom
+
+which the engine applies by returning ``m / ((1 - b1^t) * denom)`` as the
+transformed gradient and pairing the algorithm with plain ``optax.sgd(lr)``
+(exposed via :meth:`QAdamOptimizer.to_optax`).
+"""
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
+from bagua_tpu.algorithms.bytegrad import compressed_allreduce
+from bagua_tpu.communication import (
+    ALL_AXES,
+    INTER_AXIS,
+    INTRA_AXIS,
+    ReduceOp,
+    allreduce_inplace,
+)
+
+
+@dataclasses.dataclass
+class QAdamOptimizer:
+    """Hyperparameter bundle mirroring the reference ``QAdamOptimizer``
+    constructor (``q_adam.py:14-56``)."""
+
+    lr: float = 1e-3
+    warmup_steps: int = 100
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+
+    def __post_init__(self):
+        if self.lr < 0:
+            raise ValueError(f"Invalid learning rate: {self.lr}")
+        if self.eps < 0:
+            raise ValueError(f"Invalid epsilon value: {self.eps}")
+        for i, b in enumerate(self.betas):
+            if not 0.0 <= b < 1.0:
+                raise ValueError(f"Invalid beta parameter at index {i}: {b}")
+        if self.warmup_steps <= 0:
+            raise ValueError(
+                f"Invalid warmup_steps parameter, must be larger than 0: {self.warmup_steps}"
+            )
+
+    def to_optax(self) -> optax.GradientTransformation:
+        """The engine-side update rule: plain SGD consuming the
+        algorithm-preconditioned direction."""
+        return optax.sgd(self.lr)
+
+
+class QAdamAlgorithmImpl(AlgorithmImpl):
+    def __init__(self, process_group, q_adam_optimizer: QAdamOptimizer, hierarchical: bool = True):
+        super().__init__(process_group, hierarchical=hierarchical)
+        self.optimizer = q_adam_optimizer
+        self.warmup_steps = q_adam_optimizer.warmup_steps
+
+    def init_state(self, params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return {"exp_avg": zeros, "exp_avg_sq": jax.tree.map(jnp.zeros_like, params)}
+
+    def _allreduce_tree(self, tree, ctx, compressed: bool):
+        flats = ctx.plan.bucketize(tree)
+        out = []
+        for flat in flats:
+            if compressed:
+                if self.hierarchical and self.process_group.intra_size > 1:
+                    intra = allreduce_inplace(flat, op=ReduceOp.SUM, axis=INTRA_AXIS)
+                    red = compressed_allreduce(intra, (INTER_AXIS,), average=False)
+                    out.append(red / self.process_group.size)
+                else:
+                    out.append(compressed_allreduce(flat, ALL_AXES, average=True))
+            else:
+                out.append(allreduce_inplace(flat, op=ReduceOp.AVG))
+        return ctx.plan.debucketize(out)
+
+    def transform_gradients(self, grads, params, state, ctx: StepContext):
+        b1, b2 = self.optimizer.betas
+        wd = self.optimizer.weight_decay
+        step_id = (ctx.step + 1).astype(jnp.float32)
+        m, v = state["exp_avg"], state["exp_avg_sq"]
+
+        def warmup(operand):
+            grads, params, m, v = operand
+            g = self._allreduce_tree(grads, ctx, compressed=False)
+            if wd != 0.0:
+                g = jax.tree.map(lambda gg, p: gg + wd * p, g, params)
+            m2 = jax.tree.map(lambda mm, gg: b1 * mm + (1 - b1) * gg, m, g)
+            v2 = jax.tree.map(lambda vv, gg: b2 * vv + (1 - b2) * gg * gg, v, g)
+            # Reference quirk: the optimizer only updates the moments while
+            # ``step_id < warmup_steps`` (``q_adam.py:88-96``), while the comm
+            # phase switches one step later (``optimizer_step_id < warmup``,
+            # ``q_adam.py:205``) — so the last warmup step allreduces grads
+            # but leaves the moments untouched.
+            moments_pred = ctx.step + 1 < self.warmup_steps
+            m2 = jax.tree.map(lambda a, b: jnp.where(moments_pred, a, b), m2, m)
+            v2 = jax.tree.map(lambda a, b: jnp.where(moments_pred, a, b), v2, v)
+            return m2, v2
+
+        def compression(operand):
+            grads, params, m, v = operand
+            m = jax.tree.map(lambda mm, gg: b1 * mm + (1 - b1) * gg, m, grads)
+            m = self._allreduce_tree(m, ctx, compressed=True)
+            return m, v
+
+        m, v = jax.lax.cond(
+            ctx.step < self.warmup_steps, warmup, compression, (grads, params, m, v)
+        )
+
+        bc1 = 1.0 - jnp.power(b1, step_id)
+        bc2 = 1.0 - jnp.power(b2, step_id)
+        eps = self.optimizer.eps
+        direction = jax.tree.map(
+            lambda mm, vv: mm / (bc1 * (jnp.sqrt(vv) / jnp.sqrt(bc2) + eps)), m, v
+        )
+        return direction, params, {"exp_avg": m, "exp_avg_sq": v}
+
+
+class QAdamAlgorithm(Algorithm):
+    def __init__(self, q_adam_optimizer: QAdamOptimizer, hierarchical: bool = True):
+        self.optimizer = q_adam_optimizer
+        self.hierarchical = hierarchical
+
+    def reify(self, process_group) -> QAdamAlgorithmImpl:
+        return QAdamAlgorithmImpl(
+            process_group, q_adam_optimizer=self.optimizer, hierarchical=self.hierarchical
+        )

@@ -237,21 +237,59 @@ def alltoall_inplace(x: jnp.ndarray, axis=None) -> jnp.ndarray:
     return jax.lax.all_to_all(x, axes, split_axis=0, concat_axis=0, tiled=True)
 
 
-def ppermute_shift(x: jnp.ndarray, shift: int, axis=None) -> jnp.ndarray:
-    """Ring shift: rank i receives rank (i - shift) mod n's value."""
+def ppermute_apply(x: jnp.ndarray, perm, axis=None) -> jnp.ndarray:
+    """Apply an explicit (src, dst) permutation over the (possibly combined)
+    group axes.  Single axis lowers to ``lax.ppermute``; combined axes fall
+    back to all_gather + select (bandwidth-heavy — ring *shifts* should use
+    :func:`ppermute_shift`, which stays point-to-point).  Like
+    ``lax.ppermute``, destinations absent from ``perm`` receive zeros."""
     axes = _axes(axis)
     if len(axes) == 1:
-        n = jax.lax.axis_size(axes[0])
-        perm = [(i, (i + shift) % n) for i in range(n)]
         return jax.lax.ppermute(x, axes[0], perm)
-    # Multi-axis ring: flatten ranks row-major over axes. Implement by
-    # permuting over a combined axis via two ppermutes is messy; instead use
-    # gather + static roll (fine for small groups, collectives stay on ICI).
     n = axis_size(axes)
     gathered = jax.lax.all_gather(x, axes, tiled=False).reshape((n,) + x.shape)
+    src_for_dst = np.full((n,), -1, np.int32)
+    for src, dst in perm:
+        src_for_dst[dst] = src
     me = rank_id(axes)
-    src = (me - shift) % n
-    return jnp.take(gathered, src, axis=0)
+    src = jnp.take(jnp.asarray(src_for_dst), me)
+    value = jnp.take(gathered, jnp.maximum(src, 0), axis=0)
+    return jnp.where(src >= 0, value, jnp.zeros_like(x))
+
+
+def ppermute_shift(x: jnp.ndarray, shift: int, axis=None) -> jnp.ndarray:
+    """Ring shift: rank i receives rank (i - shift) mod n's value (ranks
+    row-major over the combined axes).
+
+    Over combined ``(inter, intra)`` axes this stays point-to-point: a shift
+    within the row is one intra-axis ppermute; entries that wrap a row edge
+    additionally hop one step along the inter axis, and the two candidates
+    are merged by position — two cheap collectives instead of an all_gather.
+    Requires ``|shift| < intra_size`` on the combined-axes path (the ring
+    algorithms use ±1); larger shifts fall back to :func:`ppermute_apply`.
+    """
+    axes = _axes(axis)
+    n = axis_size(axes)
+    shift = shift % n
+    if len(axes) == 1 or shift == 0:
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return ppermute_apply(x, perm, axes)
+    inter_axis, intra_axis = axes
+    h = jax.lax.axis_size(intra_axis)
+    n_inter = jax.lax.axis_size(inter_axis)
+    if shift >= h and n - shift >= h:
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return ppermute_apply(x, perm, axes)
+    s, carry = (shift, 1) if shift < h else (shift - n, -1)  # s in (-h, h)
+    # Within-row candidate: from (inter, intra - s).
+    intra_perm = [(i, (i + s) % h) for i in range(h)]
+    within = jax.lax.ppermute(x, intra_axis, intra_perm)
+    # Wrapped candidate additionally comes from the neighboring inter row.
+    inter_perm = [(i, (i + carry) % n_inter) for i in range(n_inter)]
+    wrapped = jax.lax.ppermute(within, inter_axis, inter_perm)
+    me_intra = jax.lax.axis_index(intra_axis)
+    wraps = (me_intra - s < 0) if s > 0 else (me_intra - s >= h)
+    return jnp.where(wraps, wrapped, within)
 
 
 def hierarchical_allreduce_inplace(x: jnp.ndarray, op: ReduceOp = ReduceOp.AVG) -> jnp.ndarray:

@@ -79,15 +79,25 @@ class DistributedDataParallel:
         bucket_size_bytes: Optional[int] = None,
     ):
         self.loss_fn = loss_fn
-        self.optimizer = optimizer
         self.group = process_group or get_default_group()
         self.impl: AlgorithmImpl = (
             algorithm.reify(self.group) if isinstance(algorithm, Algorithm) else algorithm
         )
+        if optimizer is None:
+            # Algorithms that bundle their own optimizer (QAdam) supply the
+            # engine-side update rule themselves.
+            bundled = getattr(self.impl, "optimizer", None)
+            if bundled is None or not hasattr(bundled, "to_optax"):
+                raise ValueError(
+                    "optimizer is required unless the algorithm bundles one "
+                    "(e.g. QAdamAlgorithm)"
+                )
+            optimizer = bundled.to_optax()
+        self.optimizer = optimizer
         self.bucket_size_bytes = bucket_size_bytes or get_default_bucket_size()
         self.plan: Optional[BucketPlan] = None
-        self._step_fn = None
-        self._host_step = 0
+        self._step_fns = {}
+        self._host_step: Optional[int] = None  # seeded from state on first step
         self.speed_meter = SpeedMeter()
 
     # -- initialization -----------------------------------------------------
@@ -112,11 +122,11 @@ class DistributedDataParallel:
         """Adopt a new bucket plan; next step re-jits (reference
         ``_reset_buckets``)."""
         self.plan = plan
-        self._step_fn = None
+        self._step_fns = {}
 
     # -- the step -----------------------------------------------------------
 
-    def _build_step(self):
+    def _build_step(self, variant: str):
         impl, plan, group = self.impl, self.plan, self.group
 
         def local_step(state: TrainState, batch):
@@ -126,11 +136,13 @@ class DistributedDataParallel:
                 _local(state.algo_state),
                 state.step[0],
             )
-            ctx = StepContext(group=group, step=step, plan=plan)
+            ctx = StepContext(group=group, step=step, plan=plan, extras={"variant": variant})
 
             params, algo_state = impl.on_step_start(params, algo_state, ctx)
             loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
-            grads, algo_state = impl.transform_gradients(grads, params, algo_state, ctx)
+            grads, params, algo_state = impl.transform_gradients(
+                grads, params, algo_state, ctx
+            )
             updates, opt_state = self.optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             params, algo_state = impl.on_step_end(params, algo_state, ctx)
@@ -154,10 +166,29 @@ class DistributedDataParallel:
         """One training step.  ``batch`` leaves have a leading global-batch
         dim divisible by ``group.size``.  Returns ``(new_state, losses)``
         where ``losses`` is the per-rank local loss, shape ``(size,)``."""
-        if self._step_fn is None or self.impl.need_reset(self._host_step):
-            self._step_fn = self._build_step()
+        if self._host_step is None:
+            # Seed the host-side mirror of the traced counter from the state,
+            # so resuming from a checkpoint keeps step_variant/need_reset in
+            # sync with the traced schedule (one device fetch, once).
+            self._host_step = int(state.step[0])
+        if self.impl.need_reset(self._host_step):
+            self._step_fns = {}
+        variant = self.impl.step_variant(self._host_step)
+        fn = self._step_fns.get(variant)
+        if fn is None:
+            fn = self._step_fns[variant] = self._build_step(variant)
         self._host_step += 1
-        return self._step_fn(state, batch)
+        return fn(state, batch)
+
+    def abort(self):
+        """Pause background/async behavior (reference
+        ``async_model_average.py:232-270``)."""
+        if hasattr(self.impl, "abort"):
+            self.impl.abort()
+
+    def resume(self):
+        if hasattr(self.impl, "resume"):
+            self.impl.resume()
 
     # -- convenience --------------------------------------------------------
 

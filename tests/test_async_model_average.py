@@ -1,0 +1,89 @@
+"""Async model average: warmup allreduce, time-armed sync, abort/resume."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from bagua_tpu.algorithms.async_model_average import AsyncModelAverageAlgorithm
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+N = 8
+DIM_IN, DIM_OUT = 10, 3
+
+
+def make_data(n_steps, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n_steps, N * 4, DIM_IN).astype(np.float32)
+    ys = rng.randn(n_steps, N * 4, DIM_OUT).astype(np.float32)
+    return xs, ys
+
+
+def ranks_equal(state):
+    return all(
+        all(np.array_equal(np.asarray(l)[0], np.asarray(l)[r]) for r in range(1, N))
+        for l in jax.tree.leaves(state.params)
+    )
+
+
+def max_spread(state):
+    leaves = jax.tree.leaves(jax.tree.map(np.asarray, state.params))
+    return max(np.abs(l.max(axis=0) - l.min(axis=0)).max() for l in leaves)
+
+
+def test_sync_every_step_keeps_ranks_close(group):
+    params = init_mlp(jax.random.PRNGKey(0), [DIM_IN, 8, DIM_OUT])
+    xs, ys = make_data(6, seed=1)
+
+    def run(sync: bool):
+        ddp = DistributedDataParallel(
+            mse_loss,
+            optax.sgd(0.05),
+            AsyncModelAverageAlgorithm(sync_interval_ms=0),  # arm sync every step
+            process_group=group,
+        )
+        state = ddp.init(params)
+        if not sync:
+            ddp.abort()
+        for i in range(6):
+            state, _ = ddp.train_step(state, (jnp.asarray(xs[i]), jnp.asarray(ys[i])))
+        return state
+
+    # With averaging armed every step, ranks differ by a single local update;
+    # without it, the divergence accumulates and must be clearly larger.
+    assert max_spread(run(sync=True)) < 0.5 * max_spread(run(sync=False))
+
+
+def test_no_sync_when_aborted(group):
+    params = init_mlp(jax.random.PRNGKey(1), [DIM_IN, 8, DIM_OUT])
+    xs, ys = make_data(3, seed=2)
+    algo = AsyncModelAverageAlgorithm(sync_interval_ms=0)
+    ddp = DistributedDataParallel(mse_loss, optax.sgd(0.05), algo, process_group=group)
+    state = ddp.init(params)
+    ddp.abort()
+    for i in range(3):
+        state, _ = ddp.train_step(state, (jnp.asarray(xs[i]), jnp.asarray(ys[i])))
+    assert not ranks_equal(state)  # ranks diverged: no averaging happened
+    spread_before = max_spread(state)
+
+    # resume: next step syncs again, collapsing the divergence to one local update
+    ddp.resume()
+    state, _ = ddp.train_step(state, (jnp.asarray(xs[0]), jnp.asarray(ys[0])))
+    assert max_spread(state) < spread_before
+
+
+def test_warmup_gradient_allreduce(group):
+    """During warmup the grads are averaged, so ranks stay bitwise equal."""
+    params = init_mlp(jax.random.PRNGKey(2), [DIM_IN, 8, DIM_OUT])
+    xs, ys = make_data(3, seed=3)
+    ddp = DistributedDataParallel(
+        mse_loss,
+        optax.sgd(0.05),
+        AsyncModelAverageAlgorithm(sync_interval_ms=10 ** 9, warmup_steps=100),
+        process_group=group,
+    )
+    state = ddp.init(params)
+    for i in range(3):
+        state, _ = ddp.train_step(state, (jnp.asarray(xs[i]), jnp.asarray(ys[i])))
+    assert ranks_equal(state)

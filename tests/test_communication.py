@@ -122,19 +122,39 @@ def test_hierarchical_allreduce_matches_flat(group):
     np.testing.assert_allclose(np.asarray(flat), np.asarray(hier), rtol=1e-5)
 
 
-def test_ppermute_shift(group):
+@pytest.mark.parametrize("shift", [1, -1, 3, 5, -5, 4, 7])
+def test_ppermute_shift(group, shift):
+    """Ring shifts over the combined (inter=2, intra=4) axes: both the
+    two-stage point-to-point path (|shift| < intra) and the gather fallback."""
     x = stacked_input(seed=4)
     fn = jax.jit(
         group.shard_map(
-            lambda v: C.ppermute_shift(v[0], shift=1)[None],
+            lambda v: C.ppermute_shift(v[0], shift=shift)[None],
             in_specs=P(C.ALL_AXES),
             out_specs=P(C.ALL_AXES),
         )
     )
     out = np.asarray(fn(jnp.asarray(x)))
-    # rank i receives rank (i-1) mod 8's value
-    expect = np.roll(x, 1, axis=0)
+    # rank i receives rank (i-shift) mod 8's value
+    expect = np.roll(x, shift, axis=0)
     np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_ppermute_apply_missing_dst_zero(group):
+    """Destinations absent from the permutation receive zeros, matching
+    lax.ppermute semantics, on the combined-axes fallback path too."""
+    x = stacked_input(seed=6)
+    fn = jax.jit(
+        group.shard_map(
+            lambda v: C.ppermute_apply(v[0], [(0, 1)])[None],
+            in_specs=P(C.ALL_AXES),
+            out_specs=P(C.ALL_AXES),
+        )
+    )
+    out = np.asarray(fn(jnp.asarray(x)))
+    np.testing.assert_allclose(out[1], x[0], rtol=1e-6)
+    for r in [0, 2, 3, 4, 5, 6, 7]:
+        np.testing.assert_array_equal(out[r], np.zeros_like(out[r]))
 
 
 def test_new_group_subset(group):

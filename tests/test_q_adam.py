@@ -1,0 +1,170 @@
+"""QAdam correctness: warmup == Adam on the global batch; compression phase
+matches a numpy oracle of the reference semantics (momentum from raw local
+grads, MinMaxUInt8 scatter-gather exchange, frozen second moment)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bagua_tpu.algorithms.q_adam import QAdamAlgorithm, QAdamOptimizer
+from bagua_tpu.bucket import BucketPlan
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+N = 8
+DIM_IN, DIM_OUT = 10, 3
+LR = 0.01
+B1, B2 = 0.9, 0.999
+EPS_ADAM = 1e-8
+EPS_Q = 1e-7
+
+
+def make_problem(n_steps, seed=0):
+    params = init_mlp(jax.random.PRNGKey(seed), [DIM_IN, 8, DIM_OUT])
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n_steps, N * 4, DIM_IN).astype(np.float32)
+    ys = rng.randn(n_steps, N * 4, DIM_OUT).astype(np.float32)
+    return params, xs, ys
+
+
+def test_invalid_hyperparams():
+    with pytest.raises(ValueError):
+        QAdamOptimizer(lr=-1.0)
+    with pytest.raises(ValueError):
+        QAdamOptimizer(warmup_steps=0)
+    with pytest.raises(ValueError):
+        QAdamOptimizer(betas=(1.0, 0.999))
+
+
+def test_warmup_matches_adam_oracle(group):
+    """During warmup QAdam == Adam (reference formulation) on the global batch."""
+    n_steps = 5
+    params, xs, ys = make_problem(n_steps, seed=1)
+    qopt = QAdamOptimizer(lr=LR, warmup_steps=100, betas=(B1, B2), eps=EPS_ADAM)
+    ddp = DistributedDataParallel(
+        mse_loss, None, QAdamAlgorithm(qopt), process_group=group
+    )
+    state = ddp.init(params)
+    for i in range(n_steps):
+        state, _ = ddp.train_step(state, (jnp.asarray(xs[i]), jnp.asarray(ys[i])))
+
+    # Oracle: reference Adam on global-batch gradients.
+    w = {k: {kk: np.asarray(v) for kk, v in d.items()} for k, d in params.items()}
+    flat_keys = [(k, kk) for k in sorted(w) for kk in sorted(w[k])]
+    m = {key: np.zeros_like(w[key[0]][key[1]]) for key in flat_keys}
+    v = {key: np.zeros_like(w[key[0]][key[1]]) for key in flat_keys}
+    gradf = jax.jit(jax.grad(mse_loss))
+    for t in range(n_steps):
+        tree = {k: {kk: jnp.asarray(w[k][kk]) for kk in w[k]} for k in w}
+        g = jax.tree.map(np.asarray, gradf(tree, (jnp.asarray(xs[t]), jnp.asarray(ys[t]))))
+        step_id = t + 1
+        for k, kk in flat_keys:
+            gg = g[k][kk]
+            if step_id < 100:
+                m[(k, kk)] = B1 * m[(k, kk)] + (1 - B1) * gg
+                v[(k, kk)] = B2 * v[(k, kk)] + (1 - B2) * gg * gg
+            bc1 = 1 - B1 ** step_id
+            bc2 = 1 - B2 ** step_id
+            denom = np.sqrt(v[(k, kk)]) / np.sqrt(bc2) + EPS_ADAM
+            w[k][kk] = w[k][kk] - (LR / bc1) * m[(k, kk)] / denom
+
+    got = ddp.params_unstacked(state)
+    for k in w:
+        for kk in w[k]:
+            np.testing.assert_allclose(
+                np.asarray(got[k][kk]), w[k][kk], rtol=5e-4, atol=1e-5
+            )
+
+
+def oracle_compress(chunks):
+    mn = chunks.min(axis=1, keepdims=True)
+    mx = chunks.max(axis=1, keepdims=True)
+    scale = 255.0 / (mx - mn + EPS_Q)
+    upper = np.rint(mx * scale)
+    lower = upper - 255.0
+    q = (np.minimum(np.rint(chunks * scale), upper) - lower).astype(np.uint8)
+    return q, np.concatenate([mn, mx], axis=1)
+
+
+def oracle_decompress(q, minmax):
+    mn, mx = minmax[:, 0:1], minmax[:, 1:2]
+    scale = 255.0 / (mx - mn + EPS_Q)
+    lower = np.rint(mx * scale) - 255.0
+    return (q.astype(np.float32) + lower) / scale
+
+
+def oracle_compressed_allreduce(per_rank, average=True):
+    n, numel = per_rank.shape
+    chunk = numel // n
+    qs, mms = [], []
+    for r in range(n):
+        q, mm = oracle_compress(per_rank[r].reshape(n, chunk))
+        qs.append(q)
+        mms.append(mm)
+    reduced = []
+    for r in range(n):
+        acc = np.zeros((chunk,), np.float32)
+        for s in range(n):
+            acc += oracle_decompress(qs[s][r : r + 1], mms[s][r : r + 1])[0]
+        if average:
+            acc /= n
+        reduced.append(acc)
+    out = []
+    for r in range(n):
+        q, mm = oracle_compress(reduced[r][None])
+        out.append(oracle_decompress(q, mm)[0])
+    return np.concatenate(out)
+
+
+def test_compression_phase_matches_oracle(group):
+    warmup = 2
+    n_steps = 5
+    params, xs, ys = make_problem(n_steps, seed=2)
+    qopt = QAdamOptimizer(lr=LR, warmup_steps=warmup, betas=(B1, B2), eps=EPS_ADAM)
+    ddp = DistributedDataParallel(
+        mse_loss, None, QAdamAlgorithm(qopt, hierarchical=False), process_group=group
+    )
+    state = ddp.init(params)
+    for i in range(n_steps):
+        state, _ = ddp.train_step(state, (jnp.asarray(xs[i]), jnp.asarray(ys[i])))
+
+    # cross-rank bitwise equality (centralized algorithm)
+    for leaf in jax.tree.leaves(jax.tree.map(np.asarray, state.params)):
+        for r in range(1, N):
+            np.testing.assert_array_equal(leaf[0], leaf[r])
+
+    # ---- flat numpy oracle ----
+    plan = BucketPlan.from_tree(params, ddp.bucket_size_bytes, align_elems=N)
+
+    def flat_grad(flat, x, y):
+        p = plan.debucketize([flat])
+        return plan.bucketize(jax.grad(mse_loss)(p, (x, y)))[0]
+
+    gradf = jax.jit(flat_grad)
+    w = np.asarray(plan.bucketize(params)[0])  # identical across ranks
+    m = np.zeros_like(w)
+    vv = np.zeros_like(w)
+    for t in range(n_steps):
+        x = xs[t].reshape(N, -1, DIM_IN)
+        y = ys[t].reshape(N, -1, DIM_OUT)
+        g = np.stack(
+            [np.asarray(gradf(jnp.asarray(w), x[r], y[r])) for r in range(N)]
+        )
+        step_id = t + 1
+        if t < warmup:  # warmup comm phase: grads averaged
+            gavg = g.mean(axis=0)
+            if step_id < warmup:  # moments update one step shorter
+                m = B1 * m + (1 - B1) * gavg
+                vv = B2 * vv + (1 - B2) * gavg * gavg
+        else:  # compression phase
+            per_rank_m = np.stack([B1 * m + (1 - B1) * g[r] for r in range(N)])
+            m = oracle_compressed_allreduce(per_rank_m, average=True)
+        bc1 = 1 - B1 ** step_id
+        bc2 = 1 - B2 ** step_id
+        denom = np.sqrt(vv) / np.sqrt(bc2) + EPS_ADAM
+        w = w - (LR / bc1) * m / denom
+
+    got = np.asarray(ddp.plan.bucketize(ddp.params_unstacked(state))[0])
+    np.testing.assert_allclose(got, w, rtol=5e-4, atol=1e-5)
