@@ -163,6 +163,9 @@ class DecentralizedAlgorithm(Algorithm):
 
 
 class LowPrecisionDecentralizedAlgorithmImpl(AlgorithmImpl):
+    #: replicas in algo_state are laid out per-bucket; re-bucketing would
+    #: desync them (DistributedDataParallel.rebucket refuses).
+    holds_bucketized_state = True
 
     def __init__(self, process_group, hierarchical: bool = True, communication_interval: int = 1):
         super().__init__(process_group, hierarchical=hierarchical)

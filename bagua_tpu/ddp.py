@@ -64,7 +64,9 @@ class DistributedDataParallel:
 
     Args:
         loss_fn: ``loss_fn(params, batch) -> scalar`` on the *local* batch.
-        optimizer: an ``optax.GradientTransformation``.
+        optimizer: an ``optax.GradientTransformation``, or ``None`` when the
+            algorithm bundles its own optimizer (QAdam supplies the update
+            rule itself, mirroring the reference's mandatory QAdamOptimizer).
         algorithm: a :class:`~bagua_tpu.algorithms.base.Algorithm` (or impl).
         process_group: defaults to the global group.
         bucket_size_bytes: communication bucket size (autotune overwrites it).
@@ -73,7 +75,7 @@ class DistributedDataParallel:
     def __init__(
         self,
         loss_fn: Callable,
-        optimizer: optax.GradientTransformation,
+        optimizer: Optional[optax.GradientTransformation],
         algorithm: Algorithm,
         process_group: Optional[BaguaProcessGroup] = None,
         bucket_size_bytes: Optional[int] = None,
@@ -121,6 +123,12 @@ class DistributedDataParallel:
     def rebucket(self, plan: BucketPlan) -> None:
         """Adopt a new bucket plan; next step re-jits (reference
         ``_reset_buckets``)."""
+        if getattr(self.impl, "holds_bucketized_state", False):
+            raise ValueError(
+                f"{type(self.impl).__name__} keeps per-bucket state; "
+                "re-bucketing mid-training would desync it (the reference "
+                "likewise excludes such algorithms from autotune re-bucketing)"
+            )
         self.plan = plan
         self._step_fns = {}
 

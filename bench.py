@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""Benchmark: VGG16 synthetic training throughput per chip.
+
+Mirrors the reference's ``examples/benchmark/synthetic_benchmark.py`` (VGG16,
+batch 32 per worker, synthetic ImageNet-shaped data) whose CI floor is
+185 img/sec/GPU for gradient_allreduce
+(``.buildkite/scripts/benchmark_master.sh:81-83``).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N/185}
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 185.0  # reference gradient_allreduce floor
+
+
+def main():
+    import bagua_tpu
+    from bagua_tpu.algorithms import Algorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.models.vgg import init_vgg16, vgg_loss_fn
+
+    group = bagua_tpu.init_process_group()
+    n = group.size
+    per_chip_batch = 32
+    global_batch = per_chip_batch * n
+
+    model, params = init_vgg16(
+        jax.random.PRNGKey(0), image_size=224, num_classes=1000,
+        compute_dtype=jnp.bfloat16,
+    )
+    ddp = DistributedDataParallel(
+        vgg_loss_fn(model),
+        optax.sgd(0.01, momentum=0.9),
+        Algorithm.init("gradient_allreduce"),
+        process_group=group,
+    )
+    state = ddp.init(params)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(global_batch, 224, 224, 3).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, size=(global_batch,)).astype(np.int32))
+
+    # warmup (compile + first steps)
+    for _ in range(3):
+        state, losses = ddp.train_step(state, (x, y))
+    jax.block_until_ready(losses)
+
+    n_iters = 20
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        state, losses = ddp.train_step(state, (x, y))
+    jax.block_until_ready(losses)
+    elapsed = time.perf_counter() - t0
+
+    img_per_sec_per_chip = global_batch * n_iters / elapsed / n
+    print(
+        json.dumps(
+            {
+                "metric": "vgg16_img_per_sec_per_chip",
+                "value": round(img_per_sec_per_chip, 2),
+                "unit": "img/s/chip",
+                "vs_baseline": round(img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

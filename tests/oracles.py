@@ -1,0 +1,47 @@
+"""Shared numpy oracles for MinMaxUInt8 compression (reference semantics:
+``tests/internal/compressor.py:4-33`` / ``bagua_kernels.cu:404-480``)."""
+
+import numpy as np
+
+EPS = 1e-7
+
+
+def oracle_compress(chunks: np.ndarray):
+    mn = chunks.min(axis=1, keepdims=True)
+    mx = chunks.max(axis=1, keepdims=True)
+    scale = 255.0 / (mx - mn + EPS)
+    upper = np.rint(mx * scale)
+    lower = upper - 255.0
+    q = (np.minimum(np.rint(chunks * scale), upper) - lower).astype(np.uint8)
+    return q, np.concatenate([mn, mx], axis=1)
+
+
+def oracle_decompress(q: np.ndarray, minmax: np.ndarray):
+    mn, mx = minmax[:, 0:1], minmax[:, 1:2]
+    scale = 255.0 / (mx - mn + EPS)
+    lower = np.rint(mx * scale) - 255.0
+    return (q.astype(np.float32) + lower) / scale
+
+
+def oracle_compressed_allreduce(per_rank: np.ndarray, average: bool = True):
+    """Numpy simulation of compress→a2a→decompress→reduce→compress→allgather."""
+    n, numel = per_rank.shape
+    chunk = numel // n
+    qs, mms = [], []
+    for r in range(n):
+        q, mm = oracle_compress(per_rank[r].reshape(n, chunk))
+        qs.append(q)
+        mms.append(mm)
+    reduced = []
+    for r in range(n):
+        acc = np.zeros((chunk,), np.float32)
+        for s in range(n):
+            acc += oracle_decompress(qs[s][r : r + 1], mms[s][r : r + 1])[0]
+        if average:
+            acc /= n
+        reduced.append(acc)
+    out = []
+    for r in range(n):
+        q, mm = oracle_compress(reduced[r][None])
+        out.append(oracle_decompress(q, mm)[0])
+    return np.concatenate(out)

@@ -25,27 +25,7 @@ from bagua_tpu.kernels.minmax_uint8 import (
 from bagua_tpu.models.mlp import init_mlp, mse_loss
 from jax.sharding import PartitionSpec as P
 
-EPS = 1e-7
-
-
-def oracle_compress(chunks: np.ndarray):
-    mn = chunks.min(axis=1, keepdims=True)
-    mx = chunks.max(axis=1, keepdims=True)
-    scale = 255.0 / (mx - mn + EPS)
-    upper = np.rint(mx * scale)
-    lower = upper - 255.0
-    level = np.minimum(np.rint(chunks * scale), upper)
-    q = (level - lower).astype(np.uint8)
-    return q, np.concatenate([mn, mx], axis=1)
-
-
-def oracle_decompress(q, minmax):
-    mn = minmax[:, 0:1]
-    mx = minmax[:, 1:2]
-    scale = 255.0 / (mx - mn + EPS)
-    upper = np.rint(mx * scale)
-    lower = upper - 255.0
-    return (q.astype(np.float32) + lower) / scale
+from tests.oracles import oracle_compress, oracle_decompress
 
 
 def test_compress_matches_oracle():

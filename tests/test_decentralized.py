@@ -22,28 +22,12 @@ from bagua_tpu.bucket import BucketPlan
 from bagua_tpu.ddp import DistributedDataParallel
 from bagua_tpu.models.mlp import init_mlp, mse_loss
 
+from tests.oracles import oracle_compress, oracle_decompress
+
 N = 8
 N_STEPS = 6
 LR = 0.05
 DIM_IN, DIM_OUT = 10, 3
-EPS = 1e-7
-
-
-def oracle_compress(chunks):
-    mn = chunks.min(axis=1, keepdims=True)
-    mx = chunks.max(axis=1, keepdims=True)
-    scale = 255.0 / (mx - mn + EPS)
-    upper = np.rint(mx * scale)
-    lower = upper - 255.0
-    q = (np.minimum(np.rint(chunks * scale), upper) - lower).astype(np.uint8)
-    return q, np.concatenate([mn, mx], axis=1)
-
-
-def oracle_decompress(q, minmax):
-    mn, mx = minmax[:, 0:1], minmax[:, 1:2]
-    scale = 255.0 / (mx - mn + EPS)
-    lower = np.rint(mx * scale) - 255.0
-    return (q.astype(np.float32) + lower) / scale
 
 
 def make_problem(seed=0):
@@ -112,9 +96,9 @@ def test_decentralized_matches_oracle(group, mode):
     np.testing.assert_allclose(got, w, rtol=2e-4, atol=1e-5)
 
 
-def test_decentralized_hierarchical_all_converges_to_equal(group):
-    """hierarchical all-mode: intra average + inter average == global average,
-    so all ranks should agree after one communication step."""
+def test_decentralized_hierarchical_all_matches_oracle(group):
+    """hierarchical all-mode: intra average then inter average == global
+    average, so the run must match the flat-mode numpy oracle exactly."""
     params, xs, ys = make_problem(seed=3)
     ddp = DistributedDataParallel(
         mse_loss,
@@ -123,14 +107,21 @@ def test_decentralized_hierarchical_all_converges_to_equal(group):
         process_group=group,
     )
     state = ddp.init(params)
-    state, _ = ddp.train_step(state, (jnp.asarray(xs[0]), jnp.asarray(ys[0])))
-    # After the exchange the pre-update weights were equal; post-update they
-    # differ only by the local gradients. Run a second step and compare the
-    # peer-averaged part: exchange(w) must be identical across ranks.
-    state, _ = ddp.train_step(state, (jnp.asarray(xs[1]), jnp.asarray(ys[1])))
-    # final check: weights differ across ranks (decentralized!) but are finite
-    leaves = jax.tree.leaves(jax.tree.map(np.asarray, state.params))
-    assert all(np.isfinite(l).all() for l in leaves)
+    for i in range(2):
+        state, _ = ddp.train_step(state, (jnp.asarray(xs[i]), jnp.asarray(ys[i])))
+
+    plan = BucketPlan.from_tree(params, 1 << 62, align_elems=N)
+    grad = flat_grad_fn(plan, params)
+    w = np.tile(np.asarray(plan.bucketize(params)[0])[None], (N, 1))
+    for step in range(2):
+        x = xs[step].reshape(N, -1, DIM_IN)
+        y = ys[step].reshape(N, -1, DIM_OUT)
+        g = np.stack([np.asarray(grad(jnp.asarray(w[r]), x[r], y[r])) for r in range(N)])
+        w = np.tile(w.mean(axis=0, keepdims=True), (N, 1)) - LR * g
+    got = np.stack(
+        [np.asarray(ddp.plan.bucketize(ddp.params_unstacked(state, r))[0]) for r in range(N)]
+    )
+    np.testing.assert_allclose(got, w, rtol=2e-4, atol=1e-5)
 
 
 def test_communication_interval_skips_steps(group):

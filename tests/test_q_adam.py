@@ -12,6 +12,11 @@ from bagua_tpu.algorithms.q_adam import QAdamAlgorithm, QAdamOptimizer
 from bagua_tpu.bucket import BucketPlan
 from bagua_tpu.ddp import DistributedDataParallel
 from bagua_tpu.models.mlp import init_mlp, mse_loss
+from tests.oracles import (
+    oracle_compress,
+    oracle_decompress,
+    oracle_compressed_allreduce,
+)
 
 N = 8
 DIM_IN, DIM_OUT = 10, 3
@@ -76,46 +81,6 @@ def test_warmup_matches_adam_oracle(group):
             np.testing.assert_allclose(
                 np.asarray(got[k][kk]), w[k][kk], rtol=5e-4, atol=1e-5
             )
-
-
-def oracle_compress(chunks):
-    mn = chunks.min(axis=1, keepdims=True)
-    mx = chunks.max(axis=1, keepdims=True)
-    scale = 255.0 / (mx - mn + EPS_Q)
-    upper = np.rint(mx * scale)
-    lower = upper - 255.0
-    q = (np.minimum(np.rint(chunks * scale), upper) - lower).astype(np.uint8)
-    return q, np.concatenate([mn, mx], axis=1)
-
-
-def oracle_decompress(q, minmax):
-    mn, mx = minmax[:, 0:1], minmax[:, 1:2]
-    scale = 255.0 / (mx - mn + EPS_Q)
-    lower = np.rint(mx * scale) - 255.0
-    return (q.astype(np.float32) + lower) / scale
-
-
-def oracle_compressed_allreduce(per_rank, average=True):
-    n, numel = per_rank.shape
-    chunk = numel // n
-    qs, mms = [], []
-    for r in range(n):
-        q, mm = oracle_compress(per_rank[r].reshape(n, chunk))
-        qs.append(q)
-        mms.append(mm)
-    reduced = []
-    for r in range(n):
-        acc = np.zeros((chunk,), np.float32)
-        for s in range(n):
-            acc += oracle_decompress(qs[s][r : r + 1], mms[s][r : r + 1])[0]
-        if average:
-            acc /= n
-        reduced.append(acc)
-    out = []
-    for r in range(n):
-        q, mm = oracle_compress(reduced[r][None])
-        out.append(oracle_decompress(q, mm)[0])
-    return np.concatenate(out)
 
 
 def test_compression_phase_matches_oracle(group):
