@@ -111,6 +111,9 @@ class DistributedDataParallel:
         algo_state = self.impl.init_state(params)
         # Bucket plan is computed from the (unstacked) communicated tree.
         self.plan = self.impl.tensors_to_buckets(params, self.bucket_size_bytes)
+        self._tree_template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+        )
         return TrainState(
             params=_stack(params, n),
             opt_state=_stack(opt_state, n),
@@ -206,3 +209,54 @@ class DistributedDataParallel:
     def params_unstacked(self, state: TrainState, rank: int = 0):
         """Extract one rank's parameter copy (host-side convenience)."""
         return jax.tree.map(lambda x: x[rank], state.params)
+
+
+class AutotuneSession:
+    """Drives the autotune register/report/re-bucket cycle for one DDP engine
+    (reference ``bagua_distributed.py:325-391``: register at init, report
+    speed + ask every ``interval`` steps, re-bucket on change)."""
+
+    def __init__(self, ddp: DistributedDataParallel, model_name: str, client=None, interval: int = 100):
+        from bagua_tpu.service.autotune_client import get_hyperparameters_service_client
+
+        self.ddp = ddp
+        self.model_name = model_name
+        self.client = client or get_hyperparameters_service_client()
+        self.interval = interval
+        self._step = 0
+        self.completed = False
+        # register the current plan's tensors
+        decls = [td for bucket in ddp.plan.declarations() for td in bucket]
+        self.client.register_tensors(model_name, decls)
+
+    def tick(self, n_samples: int) -> None:
+        """Call once per training step with the number of samples processed."""
+        self.ddp.record_speed(n_samples)
+        self._step += 1
+        if self.completed or self._step % self.interval != 0:
+            return
+        rank = 0  # single-controller: one report covers the group
+        self.client.report_metrics(
+            self.model_name, rank, self._step, self.ddp.speed_meter.speed(60.0)
+        )
+        hp, self.completed = self.client.ask_hyperparameters(
+            self.model_name, rank, self._step
+        )
+        self._apply(hp)
+
+    def _apply(self, hp) -> None:
+        if getattr(self.ddp.impl, "holds_bucketized_state", False):
+            return  # cannot re-bucket this algorithm
+        current = self.ddp.plan.declarations()
+        proposed = [[td for td in bucket] for bucket in hp.buckets]
+        changed_hier = hp.is_hierarchical_reduce != self.ddp.impl.hierarchical
+        if proposed and [
+            [td.name for td in b] for b in proposed
+        ] != [[td.name for td in b] for b in current]:
+            plan = BucketPlan.from_declarations(
+                proposed, self.ddp._tree_template, align_elems=self.ddp.group.size
+            )
+            self.ddp.rebucket(plan)
+        if changed_hier:
+            self.ddp.impl.hierarchical = hp.is_hierarchical_reduce
+            self.ddp._step_fns = {}

@@ -1,0 +1,25 @@
+"""Hyperparameter (autotune) service.
+
+TPU-native analog of the reference's ``bagua/service/`` tier: a rank-0 HTTP
+service searching over communication hyperparameters (bucket size,
+hierarchical reduction) to maximize reported training speed.  The reference
+uses Flask + gevent + scikit-optimize; this build uses the Python stdlib
+HTTP server and a small numpy Gaussian-process Bayesian optimizer, keeping
+the same REST API surface (``register_tensors`` / ``report_metrics`` /
+``ask_hyperparameters`` / ``report_tensor_execution_order`` /
+``health_check``, reference ``service/autotune_service.py:154-298``).
+"""
+
+from bagua_tpu.service.autotune_service import (  # noqa: F401
+    AutotuneService,
+    start_autotune_server,
+)
+from bagua_tpu.service.autotune_client import (  # noqa: F401
+    AutotuneClient,
+    get_hyperparameters_service_client,
+)
+from bagua_tpu.service.bayesian_optimizer import (  # noqa: F401
+    IntParam,
+    BoolParam,
+    BayesianOptimizer,
+)

@@ -1,0 +1,91 @@
+"""Client for the autotune service (reference ``AutotuneClient``,
+``service/autotune_service.py:325``) — stdlib urllib, no requests dependency."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from bagua_tpu.defs import BaguaHyperparameter, TensorDeclaration
+from bagua_tpu.env import get_bagua_service_port
+
+
+class AutotuneClient:
+    def __init__(self, host: str = "127.0.0.1", port: Optional[int] = None, timeout: float = 10.0):
+        port = port if port is not None else get_bagua_service_port()
+        self.base = f"http://{host}:{port}"
+        self.timeout = timeout
+
+    def _post(self, path: str, payload: Dict) -> Dict:
+        req = urllib.request.Request(
+            self.base + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def health_check(self) -> bool:
+        try:
+            req = urllib.request.Request(self.base + "/api/v1/health_check")
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status == 200
+        except (urllib.error.URLError, ConnectionError, OSError):
+            return False
+
+    def wait_until_ready(self, max_wait_s: float = 60.0) -> bool:
+        deadline = time.time() + max_wait_s
+        while time.time() < deadline:
+            if self.health_check():
+                return True
+            time.sleep(0.2)
+        return False
+
+    def register_tensors(
+        self, model_name: str, tensor_list: List[TensorDeclaration]
+    ) -> BaguaHyperparameter:
+        resp = self._post(
+            "/api/v1/register_tensors",
+            {
+                "model_name": model_name,
+                "tensor_list": [td.model_dump() for td in tensor_list],
+            },
+        )
+        return BaguaHyperparameter(**resp["recommended_hyperparameters"])
+
+    def report_metrics(
+        self, model_name: str, rank: int, train_iter: int, speed: float
+    ) -> None:
+        self._post(
+            "/api/v1/report_metrics",
+            {
+                "model_name": model_name,
+                "rank": rank,
+                "train_iter": train_iter,
+                "speed": speed,
+            },
+        )
+
+    def ask_hyperparameters(
+        self, model_name: str, rank: int, train_iter: int
+    ):
+        resp = self._post(
+            "/api/v1/ask_hyperparameters",
+            {"model_name": model_name, "rank": rank, "train_iter": train_iter},
+        )
+        return (
+            BaguaHyperparameter(**resp["recommended_hyperparameters"]),
+            bool(resp["is_autotune_completed"]),
+        )
+
+    def report_tensor_execution_order(self, model_name: str, spans: List[Dict]) -> None:
+        self._post(
+            "/api/v1/report_tensor_execution_order",
+            {"model_name": model_name, "spans": spans},
+        )
+
+
+def get_hyperparameters_service_client() -> AutotuneClient:
+    return AutotuneClient()

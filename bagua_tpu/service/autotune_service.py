@@ -1,0 +1,187 @@
+"""The autotune HTTP service (rank-0 hosted).
+
+Analog of the reference's Flask app (``service/autotune_service.py:154-298``)
+on the stdlib ``ThreadingHTTPServer``.  Endpoints (same paths):
+
+    POST /api/v1/register_tensors
+    POST /api/v1/report_metrics
+    POST /api/v1/ask_hyperparameters
+    POST /api/v1/report_tensor_execution_order
+    GET  /api/v1/health_check
+
+Gating mirrors the reference: no tuning during the warmup window, at most one
+sample per ``sampling_confidence_time``, and after ``max_samples`` the service
+locks to the best observed hyperparameters
+(``autotune_service.py:102-152``).
+"""
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from bagua_tpu.defs import BaguaHyperparameter, TensorDeclaration
+from bagua_tpu.service.autotune_task_manager import AutotuneTaskManager
+
+logger = logging.getLogger(__name__)
+
+
+class AutotuneService:
+    def __init__(
+        self,
+        world_size: int,
+        autotune_level: int = 0,
+        max_samples: int = 60,
+        sampling_confidence_time_s: float = 5.0,
+        warmup_time_s: float = 30.0,
+        is_output_autotune_log: bool = False,
+        default_bucket_size: int = 10 * 1024 ** 2,
+    ):
+        self.world_size = world_size
+        self.autotune_level = autotune_level
+        self.max_samples = max_samples
+        self.sampling_confidence_time_s = sampling_confidence_time_s
+        self.warmup_time_s = warmup_time_s
+        self.is_output_autotune_log = is_output_autotune_log
+        self.default_bucket_size = default_bucket_size
+
+        self._lock = threading.Lock()
+        self._managers: Dict[str, AutotuneTaskManager] = {}
+        self._start_time: Dict[str, float] = {}
+        self._last_sample_time: Dict[str, float] = {}
+        # per-model, per-rank latest reported speed (averaged when sampling,
+        # reference keeps a check board per rank, autotune_service.py:35-45)
+        self._speeds: Dict[str, Dict[int, float]] = {}
+
+    def _manager(self, model_name: str) -> AutotuneTaskManager:
+        if model_name not in self._managers:
+            self._managers[model_name] = AutotuneTaskManager(
+                model_name, self.is_output_autotune_log
+            )
+            self._start_time[model_name] = time.time()
+            self._last_sample_time[model_name] = 0.0
+            self._speeds[model_name] = {}
+        return self._managers[model_name]
+
+    # -- endpoint logic ------------------------------------------------------
+
+    def register_tensors(self, payload: Dict) -> Dict:
+        model_name = payload["model_name"]
+        decls = [TensorDeclaration(**td) for td in payload["tensor_list"]]
+        with self._lock:
+            mgr = self._manager(model_name)
+            mgr.tensor_list = decls
+            if not mgr.hyperparameter.buckets:
+                mgr.hyperparameter = mgr.recommended_from_param_dict(
+                    {
+                        "bucket_size_2p": max(10, self.default_bucket_size.bit_length() - 1),
+                        "is_hierarchical_reduce": 0,
+                    }
+                )
+                mgr.hyperparameter.bucket_size = self.default_bucket_size
+            return {"recommended_hyperparameters": mgr.hyperparameter.model_dump()}
+
+    def report_metrics(self, payload: Dict) -> Dict:
+        model_name = payload["model_name"]
+        rank = int(payload["rank"])
+        speed = float(payload["speed"])
+        with self._lock:
+            self._manager(model_name)
+            self._speeds[model_name][rank] = speed
+        return {"status": "ok"}
+
+    def ask_hyperparameters(self, payload: Dict) -> Dict:
+        model_name = payload["model_name"]
+        train_iter = int(payload.get("train_iter", 0))
+        with self._lock:
+            mgr = self._manager(model_name)
+            now = time.time()
+            completed = mgr.sampling_counter >= self.max_samples
+            if self.autotune_level >= 1 and not completed:
+                in_warmup = now - self._start_time[model_name] < self.warmup_time_s
+                confident = (
+                    now - self._last_sample_time[model_name]
+                    >= self.sampling_confidence_time_s
+                )
+                speeds = self._speeds[model_name]
+                if not in_warmup and confident and len(speeds) >= self.world_size:
+                    score = sum(speeds.values()) / len(speeds)
+                    mgr.tell_and_ask(score, train_iter)
+                    self._last_sample_time[model_name] = now
+                    self._speeds[model_name] = {}
+                    if mgr.sampling_counter >= self.max_samples:
+                        mgr.lock_best()
+                        completed = True
+            return {
+                "recommended_hyperparameters": mgr.hyperparameter.model_dump(),
+                "is_autotune_completed": completed,
+            }
+
+    def report_tensor_execution_order(self, payload: Dict) -> Dict:
+        model_name = payload["model_name"]
+        with self._lock:
+            self._manager(model_name).report_spans(payload.get("spans", []))
+        return {"status": "ok"}
+
+    # -- HTTP plumbing ---------------------------------------------------------
+
+    def make_handler(self):
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # silence
+                logger.debug(fmt, *args)
+
+            def _send(self, obj, code=200):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/api/v1/health_check":
+                    self._send({"status": "ok"})
+                else:
+                    self._send({"error": "not found"}, 404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError:
+                    self._send({"error": "bad json"}, 400)
+                    return
+                routes = {
+                    "/api/v1/register_tensors": service.register_tensors,
+                    "/api/v1/report_metrics": service.report_metrics,
+                    "/api/v1/ask_hyperparameters": service.ask_hyperparameters,
+                    "/api/v1/report_tensor_execution_order": service.report_tensor_execution_order,
+                }
+                fn = routes.get(self.path)
+                if fn is None:
+                    self._send({"error": "not found"}, 404)
+                    return
+                try:
+                    self._send(fn(payload))
+                except Exception as e:  # surface errors to the client
+                    logger.exception("autotune endpoint error")
+                    self._send({"error": str(e)}, 500)
+
+        return Handler
+
+
+def start_autotune_server(
+    service: AutotuneService, port: int = 0
+) -> ThreadingHTTPServer:
+    """Start the service in a daemon thread; returns the live server (its
+    ``server_address[1]`` is the bound port).  Analog of the reference
+    spawning a Flask process from ``init_process_group``
+    (``communication.py:384-420``)."""
+    server = ThreadingHTTPServer(("127.0.0.1", port), service.make_handler())
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server

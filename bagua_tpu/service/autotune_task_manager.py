@@ -1,0 +1,95 @@
+"""Per-model autotune state machine.
+
+Analog of the reference's ``AutotuneServiceTaskManager``
+(``service/autotune_task_manager.py``): owns the Bayesian optimizer over
+``bucket_size_2p ∈ [10, 31]`` × ``is_hierarchical_reduce``, the greedy
+dtype-grouped bucket split, and the tensor re-ordering learned from reported
+execution order.
+"""
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from bagua_tpu.bucket import split_declarations
+from bagua_tpu.defs import BaguaHyperparameter, TensorDeclaration
+from bagua_tpu.service.bayesian_optimizer import BayesianOptimizer, BoolParam, IntParam
+
+logger = logging.getLogger(__name__)
+
+
+class AutotuneTaskManager:
+    def __init__(self, model_name: str, is_output_autotune_log: bool = False):
+        self.model_name = model_name
+        self.tensor_list: List[TensorDeclaration] = []
+        self.hyperparameter = BaguaHyperparameter()
+        self.optimizer = BayesianOptimizer(
+            [IntParam("bucket_size_2p", 10, 31), BoolParam("is_hierarchical_reduce")]
+        )
+        self.sampling_counter = 0
+        self.best_score = float("-inf")
+        self.best_hyperparameter = self.hyperparameter
+        self.tensor_partial_order: Dict[str, int] = {}
+        self._log_path = (
+            f"/tmp/bagua_autotune_{model_name}_{int(time.time())}.log"
+            if is_output_autotune_log
+            else None
+        )
+
+    # -- bucket computation ---------------------------------------------
+
+    def ordered_tensor_list(self) -> List[TensorDeclaration]:
+        if not self.tensor_partial_order:
+            return self.tensor_list
+        order = self.tensor_partial_order
+        return sorted(self.tensor_list, key=lambda td: order.get(td.name, 1 << 30))
+
+    def recommended_from_param_dict(self, param_dict: Dict[str, int]) -> BaguaHyperparameter:
+        bucket_size = (1 << int(param_dict["bucket_size_2p"]))
+        decls = self.ordered_tensor_list()
+        shapes = {td.name: (td.num_elements,) for td in decls}
+        specs = split_declarations(decls, shapes, bucket_size)
+        buckets = [spec.declarations() for spec in specs]
+        return BaguaHyperparameter(
+            buckets=buckets,
+            bucket_size=bucket_size,
+            is_hierarchical_reduce=bool(param_dict["is_hierarchical_reduce"]),
+        )
+
+    # -- optimizer loop ----------------------------------------------------
+
+    def tell_and_ask(self, score: float, train_iter: int) -> BaguaHyperparameter:
+        """Record the score of the current hyperparameters and propose new ones."""
+        current = {
+            "bucket_size_2p": max(10, self.hyperparameter.bucket_size.bit_length() - 1),
+            "is_hierarchical_reduce": int(self.hyperparameter.is_hierarchical_reduce),
+        }
+        self.optimizer.tell(current, score)
+        self.sampling_counter += 1
+        if score > self.best_score:
+            self.best_score = score
+            self.best_hyperparameter = self.hyperparameter
+        if self._log_path:
+            with open(self._log_path, "a") as f:
+                f.write(f"{train_iter},{current},{score}\n")
+        proposal = self.optimizer.ask()
+        self.hyperparameter = self.recommended_from_param_dict(proposal)
+        return self.hyperparameter
+
+    def lock_best(self) -> BaguaHyperparameter:
+        self.hyperparameter = self.best_hyperparameter
+        return self.hyperparameter
+
+    # -- execution-order learning -------------------------------------------
+
+    def report_spans(self, spans: List[Dict]) -> None:
+        """Distill a tensor partial order from (tensor_name, start_time) spans
+        (reference ``autotune_service.py:274-294`` consumes OTel spans; here
+        any ordered (name, start) record works)."""
+        ready = [
+            (s["start_time"], s["tensor_name"])
+            for s in spans
+            if s.get("action") == "tensor_ready" and "tensor_name" in s
+        ]
+        for i, (_, name) in enumerate(sorted(ready)):
+            self.tensor_partial_order[name] = i

@@ -1,0 +1,158 @@
+"""Autotune service tests (CPU-only tier, like reference ``tests/service``).
+
+The main test mirrors the reference's ``MockBaguaProcess`` pattern
+(``tests/service/test_autotune_service.py:29-102``): register fake tensor
+declarations, report a synthetic concave score peaking at 20 MB buckets, and
+assert the optimizer converges near the peak.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from bagua_tpu.defs import BaguaHyperparameter, TensorDeclaration
+from bagua_tpu.service.autotune_client import AutotuneClient
+from bagua_tpu.service.autotune_service import AutotuneService, start_autotune_server
+from bagua_tpu.service.bayesian_optimizer import BayesianOptimizer, BoolParam, IntParam
+
+
+def synthetic_score(bucket_size_bytes: int, hierarchical: bool) -> float:
+    """Concave in log2(bucket size), peak at 2^21 * 10 ≈ 20 MB; hierarchy
+    adds a small bonus (reference test peaks near 20MB too)."""
+    p = np.log2(bucket_size_bytes)
+    return float(100.0 - (p - np.log2(20 * 1024 ** 2)) ** 2 + (1.0 if hierarchical else 0.0))
+
+
+def test_bayesian_optimizer_converges():
+    opt = BayesianOptimizer(
+        [IntParam("bucket_size_2p", 10, 31), BoolParam("is_hierarchical_reduce")],
+        n_initial_points=5,
+        seed=1,
+    )
+    for _ in range(40):
+        params = opt.ask()
+        score = synthetic_score(1 << params["bucket_size_2p"], bool(params["is_hierarchical_reduce"]))
+        opt.tell(params, score)
+    best, best_score = opt.best()
+    # peak at log2(20 MiB) = 24.32
+    assert abs(best["bucket_size_2p"] - 24.32) <= 1.5, best
+    assert best["is_hierarchical_reduce"] == 1
+
+
+def fake_decls(n=6):
+    return [
+        TensorDeclaration(name=f"t{i}", num_elements=1 << 18, dtype="f32")
+        for i in range(n)
+    ]
+
+
+@pytest.fixture()
+def server():
+    service = AutotuneService(
+        world_size=1,
+        autotune_level=1,
+        max_samples=30,
+        sampling_confidence_time_s=0.0,
+        warmup_time_s=0.0,
+    )
+    srv = start_autotune_server(service, port=0)
+    client = AutotuneClient(port=srv.server_address[1])
+    yield service, client
+    srv.shutdown()
+
+
+def test_service_end_to_end_converges(server):
+    service, client = server
+    assert client.wait_until_ready(5.0)
+    hp = client.register_tensors("mock_model", fake_decls())
+    assert hp.buckets, "initial bucket assignment expected"
+
+    for it in range(35):
+        score = synthetic_score(hp.bucket_size, hp.is_hierarchical_reduce)
+        client.report_metrics("mock_model", 0, it, score)
+        hp, completed = client.ask_hyperparameters("mock_model", 0, it)
+        if completed:
+            break
+    assert completed
+    # locked to the best seen: near the 20 MiB peak (log2 = 24.32)
+    assert abs(np.log2(hp.bucket_size) - 24.32) <= 2.5
+
+
+def test_warmup_gating():
+    service = AutotuneService(
+        world_size=1, autotune_level=1, max_samples=10,
+        sampling_confidence_time_s=0.0, warmup_time_s=3600.0,
+    )
+    srv = start_autotune_server(service, port=0)
+    try:
+        client = AutotuneClient(port=srv.server_address[1])
+        assert client.wait_until_ready(5.0)
+        hp0 = client.register_tensors("m", fake_decls())
+        client.report_metrics("m", 0, 1, 10.0)
+        hp1, completed = client.ask_hyperparameters("m", 0, 1)
+        # still in warmup: nothing sampled, hyperparameters unchanged
+        assert not completed
+        assert hp1.bucket_size == hp0.bucket_size
+        assert service._managers["m"].sampling_counter == 0
+    finally:
+        srv.shutdown()
+
+
+def test_execution_order_reorders_buckets(server):
+    service, client = server
+    client.register_tensors("om", fake_decls(3))
+    spans = [
+        {"action": "tensor_ready", "tensor_name": "t2", "start_time": 1},
+        {"action": "tensor_ready", "tensor_name": "t0", "start_time": 2},
+        {"action": "tensor_ready", "tensor_name": "t1", "start_time": 3},
+    ]
+    client.report_tensor_execution_order("om", spans)
+    mgr = service._managers["om"]
+    ordered = [td.name for td in mgr.ordered_tensor_list()]
+    assert ordered == ["t2", "t0", "t1"]
+
+
+def test_autotune_session_rebuckets(group):
+    """End-to-end: DDP + AutotuneSession against a live service re-buckets."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.ddp import AutotuneSession, DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+    service = AutotuneService(
+        world_size=1, autotune_level=1, max_samples=5,
+        sampling_confidence_time_s=0.0, warmup_time_s=0.0,
+    )
+    srv = start_autotune_server(service, port=0)
+    try:
+        client = AutotuneClient(port=srv.server_address[1])
+        params = init_mlp(jax.random.PRNGKey(0), [16, 64, 64, 4])
+        ddp = DistributedDataParallel(
+            mse_loss, optax.sgd(0.05), GradientAllReduceAlgorithm(), process_group=group,
+            bucket_size_bytes=1 << 10,  # tiny start: several buckets
+        )
+        state = ddp.init(params)
+        session = AutotuneSession(ddp, "ddp_model", client=client, interval=2)
+        n0 = ddp.plan.num_buckets
+        rng = np.random.RandomState(0)
+        for i in range(8):
+            batch = (
+                jnp.asarray(rng.randn(16, 16), np.float32),
+                jnp.asarray(rng.randn(16, 4), np.float32),
+            )
+            state, _ = ddp.train_step(state, batch)
+            session.tick(16)
+        # service proposes >=1MB buckets -> single bucket; plan must change
+        assert ddp.plan.num_buckets != n0
+        # training still works after re-bucketing
+        state, losses = ddp.train_step(
+            state,
+            (jnp.asarray(rng.randn(16, 16), np.float32), jnp.asarray(rng.randn(16, 4), np.float32)),
+        )
+        assert np.isfinite(np.asarray(losses)).all()
+    finally:
+        srv.shutdown()
