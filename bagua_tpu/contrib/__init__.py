@@ -1,0 +1,17 @@
+"""Contrib tier: fused optimizer, cached dataset, load-balanced sampling,
+synchronized batch norm (reference ``bagua/torch_api/contrib/``)."""
+
+from bagua_tpu.contrib.fuse_optimizer import fuse_optimizer  # noqa: F401
+from bagua_tpu.contrib.store import (  # noqa: F401
+    Store,
+    InMemoryStore,
+    FileStore,
+    ClusterStore,
+)
+from bagua_tpu.contrib.cache_loader import CacheLoader  # noqa: F401
+from bagua_tpu.contrib.cached_dataset import CachedDataset  # noqa: F401
+from bagua_tpu.contrib.load_balancing_data_loader import (  # noqa: F401
+    LoadBalancingDistributedSampler,
+    LoadBalancingDistributedBatchSampler,
+)
+from bagua_tpu.contrib.sync_batchnorm import SyncBatchNorm  # noqa: F401

@@ -1,0 +1,169 @@
+"""Load-balancing distributed samplers.
+
+Framework-agnostic reimplementation of the reference's
+``contrib/load_balancing_data_loader.py``: sort samples by a user
+``complexity_fn``, chunk the sorted order into ``num_replicas``-sized groups
+(so one chunk = one per-rank batch row of similar complexity), shuffle whole
+chunks, and hand rank ``r`` the r-th element of each chunk.  ``random_level``
+∈ [0, 1] perturbs complexities before sorting to trade balance for
+randomness (0 = best balance).  numpy RNG replaces torch.Generator; the
+chunking/padding/drop-last arithmetic matches the reference.
+"""
+
+import math
+from typing import Callable, Iterator, List, Optional
+
+import numpy as np
+
+
+class LoadBalancingDistributedSampler:
+    def __init__(
+        self,
+        dataset,
+        complexity_fn: Callable[..., int],
+        num_replicas: Optional[int] = None,
+        rank: Optional[int] = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+        random_level: float = 0.0,
+    ):
+        if num_replicas is None:
+            from bagua_tpu.env import get_world_size
+
+            num_replicas = get_world_size()
+        if rank is None:
+            from bagua_tpu.env import get_rank
+
+            rank = get_rank()
+        if rank >= num_replicas or rank < 0:
+            raise ValueError(
+                f"Invalid rank {rank}, rank should be in the interval [0, {num_replicas - 1}]"
+            )
+        self.dataset = dataset
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.epoch = 0
+        self.drop_last = drop_last
+
+        dataset_len = len(dataset)
+        if self.drop_last and dataset_len % self.num_replicas != 0:
+            self.num_samples = math.ceil((dataset_len - self.num_replicas) / self.num_replicas)
+        else:
+            self.num_samples = math.ceil(dataset_len / self.num_replicas)
+        self.total_size = self.num_samples * self.num_replicas
+        self.shuffle = shuffle
+        self.seed = seed
+
+        self.item_complexity_map = {
+            i: complexity_fn(dataset[i]) for i in range(dataset_len)
+        }
+        self.ordered_item_complexity_map = dict(
+            sorted(self.item_complexity_map.items(), key=lambda t: t[1])
+        )
+        if random_level < 0.0 or random_level > 1.0:
+            raise ValueError(
+                f"Invalid random level {random_level}, should be in the range [0.0, 1.0]"
+            )
+        max_c = max(self.item_complexity_map.values())
+        min_c = min(self.item_complexity_map.values())
+        self.random_number = int((max_c - min_c) * random_level + 1)
+
+    def shuffle_chunks(self):
+        def chunks_wrap_padding(lst: List[int], n: int):
+            num_chunks = max(1, self.num_samples)
+            num_elements = num_chunks * n
+            current = []
+            for i in range(num_elements):
+                current.append(lst[i % len(lst)])
+                if len(current) == n:
+                    yield current
+                    current = []
+
+        if self.shuffle:
+            g = np.random.RandomState(self.seed + self.epoch)
+            if self.random_number > 0:
+                perturbed = dict(self.item_complexity_map)
+                noise = g.randint(0, self.random_number, size=len(perturbed))
+                for k, dv in zip(perturbed, noise):
+                    perturbed[k] += int(dv)
+                ordered = dict(sorted(perturbed.items(), key=lambda t: t[1]))
+            else:
+                ordered = self.ordered_item_complexity_map
+            index_chunks = list(chunks_wrap_padding(list(ordered.keys()), self.num_replicas))
+            chunk_indices = list(g.permutation(len(index_chunks)))
+        else:
+            index_chunks = list(
+                chunks_wrap_padding(
+                    list(self.ordered_item_complexity_map.keys()), self.num_replicas
+                )
+            )
+            chunk_indices = list(range(len(index_chunks)))
+
+        if not self.drop_last:
+            padding_size = self.num_samples - len(chunk_indices)
+            if padding_size <= len(chunk_indices):
+                chunk_indices += chunk_indices[:padding_size]
+            else:
+                chunk_indices += (
+                    chunk_indices * math.ceil(padding_size / len(chunk_indices))
+                )[:padding_size]
+        else:
+            chunk_indices = chunk_indices[: self.num_samples]
+        assert len(chunk_indices) == self.num_samples
+        return index_chunks, chunk_indices
+
+    def __iter__(self) -> Iterator[int]:
+        index_chunks, chunk_indices = self.shuffle_chunks()
+        indices = [index_chunks[i][self.rank] for i in chunk_indices]
+        assert len(indices) == self.num_samples
+        return iter(indices)
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+
+class LoadBalancingDistributedBatchSampler:
+    """Variable-size mini-batches on top of the load-balancing sampler
+    (reference ``load_balancing_data_loader.py:202+``); ``batch_fn`` maps a
+    rank's sample indices to a list of batches."""
+
+    def __init__(self, sampler: LoadBalancingDistributedSampler, batch_fn, drop_last: bool = False):
+        if not isinstance(sampler, LoadBalancingDistributedSampler):
+            raise ValueError("sampler should be of LoadBalancingDistributedSampler type.")
+        if sampler.drop_last:
+            raise ValueError("drop_last of sampler should be False")
+        self.sampler = sampler
+        self.batch_fn = batch_fn
+        self.drop_last = drop_last
+        self.num_replicas = sampler.num_replicas
+        self.rank = sampler.rank
+        self.generate_batches()
+
+    def generate_batches(self) -> None:
+        index_chunks, chunk_indices = self.sampler.shuffle_chunks()
+        batches = []
+        for rank in range(self.num_replicas):
+            sub_indices = [index_chunks[i][rank] for i in chunk_indices]
+            batches.append(self.batch_fn(sub_indices))
+        self.total_batch = (
+            max(len(b) for b in batches)
+            if not self.drop_last
+            else min(len(b) for b in batches)
+        )
+        self.padded_batches = [
+            batch + batch[: self.total_batch - len(batch)] for batch in batches
+        ]
+
+    def __iter__(self):
+        return iter(self.padded_batches[self.rank])
+
+    def __len__(self):
+        return self.total_batch
+
+    def set_epoch(self, epoch: int) -> None:
+        self.sampler.set_epoch(epoch)
+        self.generate_batches()

@@ -1,0 +1,159 @@
+"""Key-value stores backing the cached-dataset tier.
+
+TPU-native analog of the reference's ``contrib/utils/store.py`` (``Store`` /
+``ClusterStore`` ABCs with xxhash key routing, ``store.py:56-143``) and
+``redis_store.py``.  Redis isn't available in this image, so the concrete
+backends are:
+
+* :class:`InMemoryStore` — plain dict, single process.
+* :class:`FileStore` — directory of pickled blobs, usable across processes on
+  one host (and across hosts on shared filesystems).
+* ``bagua_tpu.contrib.shm_store.ShmStore`` — C++ shared-memory store (the
+  native-runtime equivalent of the reference bootstrapping local redis
+  servers), provided separately.
+
+``ClusterStore`` shards keys over multiple backends with xxhash, exactly like
+the reference routes keys across redis instances.
+"""
+
+import os
+import pickle
+import tempfile
+from typing import Dict, List, Optional
+
+try:
+    import xxhash
+
+    def _hash(key: bytes) -> int:
+        return xxhash.xxh64(key).intdigest()
+
+except ImportError:  # pragma: no cover
+    import hashlib
+
+    def _hash(key: bytes) -> int:
+        return int.from_bytes(hashlib.md5(key).digest()[:8], "little")
+
+
+class Store:
+    """Abstract KV store (reference ``store.py:56-107``)."""
+
+    def set(self, key: str, value) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str):
+        raise NotImplementedError
+
+    def num_keys(self) -> int:
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        raise NotImplementedError
+
+    def mset(self, mapping: Dict[str, object]) -> None:
+        for k, v in mapping.items():
+            self.set(k, v)
+
+    def mget(self, keys: List[str]) -> List[Optional[object]]:
+        return [self.get(k) for k in keys]
+
+    def status(self) -> bool:
+        return True
+
+    def shutdown(self) -> None:
+        pass
+
+
+class InMemoryStore(Store):
+    def __init__(self):
+        self._data: Dict[str, object] = {}
+
+    def set(self, key, value):
+        self._data[key] = value
+
+    def get(self, key):
+        return self._data.get(key)
+
+    def num_keys(self):
+        return len(self._data)
+
+    def clear(self):
+        self._data.clear()
+
+
+class FileStore(Store):
+    """Pickled-blob-per-key store under a directory; safe for concurrent
+    readers and single-writer-per-key patterns (atomic rename)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or tempfile.mkdtemp(prefix="bagua_store_")
+        os.makedirs(self.path, exist_ok=True)
+
+    def _file(self, key: str) -> str:
+        return os.path.join(self.path, f"{_hash(key.encode()):016x}.blob")
+
+    def set(self, key, value):
+        target = self._file(key)
+        fd, tmp = tempfile.mkstemp(dir=self.path)
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump((key, value), f)
+        os.replace(tmp, target)
+
+    def get(self, key):
+        try:
+            with open(self._file(key), "rb") as f:
+                stored_key, value = pickle.load(f)
+                return value if stored_key == key else None
+        except FileNotFoundError:
+            return None
+
+    def num_keys(self):
+        return len([f for f in os.listdir(self.path) if f.endswith(".blob")])
+
+    def clear(self):
+        for f in os.listdir(self.path):
+            if f.endswith(".blob"):
+                os.unlink(os.path.join(self.path, f))
+
+
+class ClusterStore(Store):
+    """Shards keys across backend stores by xxhash
+    (reference ``store.py:109-143``)."""
+
+    def __init__(self, stores: List[Store]):
+        if not stores:
+            raise ValueError("ClusterStore needs at least one backend store")
+        self.stores = list(stores)
+
+    def _route(self, key: str) -> Store:
+        return self.stores[_hash(key.encode()) % len(self.stores)]
+
+    def set(self, key, value):
+        self._route(key).set(key, value)
+
+    def get(self, key):
+        return self._route(key).get(key)
+
+    def mset(self, mapping):
+        by_store: Dict[int, Dict[str, object]] = {}
+        for k, v in mapping.items():
+            idx = _hash(k.encode()) % len(self.stores)
+            by_store.setdefault(idx, {})[k] = v
+        for idx, sub in by_store.items():
+            self.stores[idx].mset(sub)
+
+    def mget(self, keys):
+        return [self.get(k) for k in keys]
+
+    def num_keys(self):
+        return sum(s.num_keys() for s in self.stores)
+
+    def clear(self):
+        for s in self.stores:
+            s.clear()
+
+    def status(self):
+        return all(s.status() for s in self.stores)
+
+    def shutdown(self):
+        for s in self.stores:
+            s.shutdown()

@@ -1,0 +1,89 @@
+"""Synchronized batch normalization across data-parallel ranks.
+
+TPU-native analog of the reference's Horovod-derived ``SyncBatchNorm``
+(``contrib/sync_batchnorm.py:31+``), which allgathers per-rank moments and
+runs a hand-written backward.  Under JAX the backward comes from autodiff, so
+the entire implementation is: compute batch moments with ``psum`` over the
+data-parallel mesh axes and normalize — the gradient of ``psum`` is correct
+by construction (no version-gated custom backward needed).
+
+A flax.linen module; use inside a model that runs under ``shard_map`` (the
+DDP engine) with ``axis_name`` matching the group axes.  Outside shard_map
+(single device, no named axes) it degrades to ordinary BatchNorm.
+"""
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _bound_axes(axis_name) -> Tuple[str, ...]:
+    """The subset of requested axes actually bound in the current trace —
+    per-axis, so running under a mesh that binds only one of the default
+    axes still synchronizes over that axis."""
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    bound = []
+    for a in axes:
+        try:
+            jax.lax.axis_size(a)
+            bound.append(a)
+        except NameError:
+            pass
+    return tuple(bound)
+
+
+class SyncBatchNorm(nn.Module):
+    """Cross-replica batch norm.
+
+    Attributes:
+        axis_name: mesh axis (or tuple) to synchronize over; defaults to the
+            DDP group axes ``("inter", "intra")``.
+        momentum: running-stats EMA momentum.
+        epsilon: numerical stability constant.
+        use_running_average: if True, normalize with the stored running stats
+            (eval mode).
+    """
+
+    axis_name: Union[str, Tuple[str, ...]] = ("inter", "intra")
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    use_running_average: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_running_average = nn.merge_param(
+            "use_running_average", self.use_running_average, use_running_average
+        )
+        features = x.shape[-1]
+        reduce_axes = tuple(range(x.ndim - 1))
+
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), self.dtype)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), self.dtype)
+        )
+        scale = self.param("scale", nn.initializers.ones, (features,), self.dtype)
+        bias = self.param("bias", nn.initializers.zeros, (features,), self.dtype)
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            mean = jnp.mean(x, axis=reduce_axes)
+            mean2 = jnp.mean(x * x, axis=reduce_axes)
+            bound = _bound_axes(self.axis_name)
+            if bound:
+                mean = jax.lax.pmean(mean, bound)
+                mean2 = jax.lax.pmean(mean2, bound)
+            # E[x^2]-E[x]^2 can go slightly negative in float32; clamp like
+            # flax BatchNorm does to keep sqrt finite.
+            var = jnp.maximum(mean2 - mean * mean, 0.0)
+            if not self.is_initializing():
+                ra_mean.value = self.momentum * ra_mean.value + (1 - self.momentum) * mean
+                ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
+
+        y = (x - mean) / jnp.sqrt(var + self.epsilon)
+        return y * scale + bias

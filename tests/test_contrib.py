@@ -1,0 +1,327 @@
+"""Contrib tier tests: fused optimizer equivalence, stores, cache loader,
+cached dataset, load-balancing samplers, sync batch norm, shm store
+(reference ``tests/contrib/``)."""
+
+import multiprocessing
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bagua_tpu.contrib import (
+    CacheLoader,
+    CachedDataset,
+    ClusterStore,
+    FileStore,
+    InMemoryStore,
+    LoadBalancingDistributedBatchSampler,
+    LoadBalancingDistributedSampler,
+    SyncBatchNorm,
+    fuse_optimizer,
+)
+from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+
+# ---------------- fused optimizer (reference test_fused_optimizer.py) -------
+
+
+@pytest.mark.parametrize("make_opt", [lambda: optax.sgd(0.1, momentum=0.9), lambda: optax.adam(1e-2)])
+def test_fused_optimizer_matches_unfused(make_opt):
+    params = init_mlp(jax.random.PRNGKey(0), [8, 16, 4])
+    grads = jax.tree.map(lambda p: jnp.ones_like(p) * 0.1, params)
+
+    plain, fused = make_opt(), fuse_optimizer(make_opt())
+    ps, fs = plain.init(params), fused.init(params)
+    p1, f1 = dict(params), dict(params)
+    for _ in range(5):
+        up, ps = plain.update(grads, ps, p1)
+        p1 = optax.apply_updates(p1, up)
+        uf, fs = fused.update(grads, fs, f1)
+        f1 = optax.apply_updates(f1, uf)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(f1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+
+
+def test_fused_optimizer_in_ddp(group):
+    """fuse_optimizer composes with the DDP engine."""
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+
+    params = init_mlp(jax.random.PRNGKey(1), [8, 16, 4])
+    ddp = DistributedDataParallel(
+        mse_loss, fuse_optimizer(optax.adam(1e-3)), GradientAllReduceAlgorithm(),
+        process_group=group,
+    )
+    state = ddp.init(params)
+    rng = np.random.RandomState(0)
+    state, losses = ddp.train_step(
+        state, (jnp.asarray(rng.randn(16, 8), np.float32), jnp.asarray(rng.randn(16, 4), np.float32))
+    )
+    assert np.isfinite(np.asarray(losses)).all()
+
+
+# ---------------- stores ----------------------------------------------------
+
+
+@pytest.mark.parametrize("make_store", [InMemoryStore, FileStore])
+def test_store_basic(make_store):
+    s = make_store()
+    s.clear()
+    assert s.get("a") is None
+    s.set("a", {"x": 1})
+    s.set("b", [1, 2, 3])
+    assert s.get("a") == {"x": 1}
+    assert s.get("b") == [1, 2, 3]
+    assert s.num_keys() == 2
+    s.mset({"c": 1, "d": 2})
+    assert s.mget(["c", "d", "nope"]) == [1, 2, None]
+    s.clear()
+    assert s.num_keys() == 0
+
+
+def test_cluster_store_routing():
+    backends = [InMemoryStore() for _ in range(3)]
+    cs = ClusterStore(backends)
+    for i in range(50):
+        cs.set(f"key{i}", i)
+    assert cs.num_keys() == 50
+    assert all(cs.get(f"key{i}") == i for i in range(50))
+    # keys actually spread over backends
+    assert sum(1 for b in backends if b.num_keys() > 0) >= 2
+    cs.clear()
+    assert cs.num_keys() == 0
+
+
+# ---------------- cache loader / cached dataset ------------------------------
+
+
+def test_cache_loader_batching_and_hits():
+    loads = []
+
+    def load(k):
+        loads.append(k)
+        return int(k) * 2
+
+    cl = CacheLoader(backend="memory", dataset_name="d", writer_buffer_size=4)
+    for i in range(8):
+        assert cl.get(str(i), load) == i * 2
+    assert len(loads) == 8
+    for i in range(8):
+        assert cl.get(str(i), load) == i * 2
+    assert len(loads) == 8  # all hits
+    assert cl.num_keys() == 8
+    assert cl.hit_rate == 0.5
+
+
+class SlowDataset:
+    def __init__(self, n=10):
+        self.n = n
+        self.calls = 0
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        self.calls += 1
+        return np.full((3,), i)
+
+
+def test_cached_dataset():
+    ds = SlowDataset()
+    cds = CachedDataset(ds, backend="memory", dataset_name="sd")
+    for _ in range(3):
+        for i in range(len(cds)):
+            np.testing.assert_array_equal(cds[i], np.full((3,), i))
+    assert ds.calls == 10  # each sample materialized exactly once
+
+
+# ---------------- load balancing sampler -------------------------------------
+
+
+def test_lb_sampler_balances_complexity():
+    data = list(np.random.RandomState(0).randint(1, 100, size=64))
+    n_replicas = 4
+    per_rank = []
+    for rank in range(n_replicas):
+        s = LoadBalancingDistributedSampler(
+            data, complexity_fn=lambda x: int(x), num_replicas=n_replicas, rank=rank,
+            shuffle=True, seed=7,
+        )
+        s.set_epoch(0)
+        idx = list(iter(s))
+        assert len(idx) == len(s) == 16
+        per_rank.append(sum(data[i] for i in idx))
+    # balanced: per-rank total complexity within 15% of each other
+    assert (max(per_rank) - min(per_rank)) / max(per_rank) < 0.15
+
+    # every chunk groups samples of similar complexity: disjoint coverage
+    all_idx = set()
+    for rank in range(n_replicas):
+        s = LoadBalancingDistributedSampler(
+            data, complexity_fn=lambda x: int(x), num_replicas=n_replicas, rank=rank,
+            shuffle=False,
+        )
+        all_idx.update(iter(s))
+    assert len(all_idx) == 64
+
+
+def test_lb_sampler_epoch_changes_order():
+    data = list(range(32))
+    s = LoadBalancingDistributedSampler(
+        data, complexity_fn=lambda x: x, num_replicas=2, rank=0, shuffle=True, seed=0,
+        random_level=0.5,
+    )
+    s.set_epoch(0)
+    a = list(iter(s))
+    s.set_epoch(1)
+    b = list(iter(s))
+    assert a != b
+
+
+def test_lb_sampler_invalid_args():
+    with pytest.raises(ValueError):
+        LoadBalancingDistributedSampler([1, 2], lambda x: x, num_replicas=2, rank=5)
+    with pytest.raises(ValueError):
+        LoadBalancingDistributedSampler(
+            [1, 2], lambda x: x, num_replicas=2, rank=0, random_level=1.5
+        )
+
+
+def test_lb_batch_sampler():
+    data = list(np.random.RandomState(1).randint(1, 50, size=40))
+    sampler = LoadBalancingDistributedSampler(
+        data, complexity_fn=lambda x: int(x), num_replicas=2, rank=0, shuffle=True, seed=3
+    )
+
+    def batch_fn(indices):
+        # dynamic batches capped at total complexity 100
+        batches, cur, total = [], [], 0
+        for i in indices:
+            if cur and total + data[i] > 100:
+                batches.append(cur)
+                cur, total = [], 0
+            cur.append(i)
+            total += data[i]
+        if cur:
+            batches.append(cur)
+        return batches
+
+    bs = LoadBalancingDistributedBatchSampler(sampler, batch_fn=batch_fn)
+    batches = list(iter(bs))
+    assert len(batches) == len(bs)
+    assert all(isinstance(b, list) and b for b in batches)
+
+
+# ---------------- sync batch norm -------------------------------------------
+
+
+def test_sync_batchnorm_matches_global_bn(group):
+    """Per-rank SyncBatchNorm under shard_map == ordinary BN on the global
+    batch (the defining property; reference tests/contrib sync BN)."""
+    from jax.sharding import PartitionSpec as P
+    from bagua_tpu.communication import ALL_AXES
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 6).astype(np.float32) * 3 + 1.5
+
+    bn = SyncBatchNorm(axis_name=ALL_AXES, use_running_average=False)
+    variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x[:4]))
+
+    def local_apply(xl):
+        y, _ = bn.apply(variables, xl, mutable=["batch_stats"])
+        return y
+
+    fn = jax.jit(group.shard_map(local_apply, in_specs=P(ALL_AXES), out_specs=P(ALL_AXES)))
+    y_sync = np.asarray(fn(jnp.asarray(x)))
+
+    mean = x.mean(0)
+    var = x.var(0)
+    y_ref = (x - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(y_sync, y_ref, rtol=2e-3, atol=2e-4)
+
+
+def test_sync_batchnorm_single_device_fallback():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+    bn = SyncBatchNorm(axis_name="nonexistent_axis")
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    y, _ = bn.apply(variables, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y).mean(0), np.zeros(4), atol=1e-5)
+
+
+# ---------------- shm store (C++ native) ------------------------------------
+
+
+def _shm_child(name, q):
+    try:
+        from bagua_tpu.contrib.shm_store import ShmStore
+
+        s = ShmStore(name=name, capacity_bytes=1 << 20, create=False)
+        q.put(("ok", s.get("hello")))
+        s.set("from_child", [4, 5, 6])
+        s.shutdown()
+    except Exception as e:  # pragma: no cover
+        q.put(("err", repr(e)))
+
+
+def test_shm_store_cross_process():
+    pytest.importorskip("ctypes")
+    from bagua_tpu.contrib.shm_store import ShmStore
+
+    name = f"/bagua_test_{os.getpid()}"
+    s = ShmStore(name=name, capacity_bytes=1 << 20)
+    try:
+        s.clear()
+        s.set("hello", {"a": np.arange(3)})
+        got = s.get("hello")
+        np.testing.assert_array_equal(got["a"], np.arange(3))
+        assert s.get("missing") is None
+        assert s.num_keys() == 1
+
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_shm_child, args=(name, q))
+        p.start()
+        status, value = q.get(timeout=60)
+        p.join(timeout=30)
+        assert status == "ok", value
+        np.testing.assert_array_equal(value["a"], np.arange(3))
+        assert s.get("from_child") == [4, 5, 6]
+    finally:
+        s.shutdown()
+        ShmStore(name=name, capacity_bytes=1 << 20).unlink()
+
+
+def test_cache_loader_degrades_when_store_full():
+    """A bounded backend filling up disables caching instead of crashing."""
+
+    class TinyStore(InMemoryStore):
+        def mset(self, mapping):
+            raise MemoryError("full")
+
+    cl = CacheLoader(store=TinyStore(), writer_buffer_size=1)
+    assert cl.get("a", lambda k: 1) == 1  # triggers a failing flush
+    assert cl._cache_full
+    assert cl.get("b", lambda k: 2) == 2  # still serves, no crash
+
+
+def test_shm_store_overwrite_and_clear():
+    from bagua_tpu.contrib.shm_store import ShmStore
+
+    name = f"/bagua_test2_{os.getpid()}"
+    s = ShmStore(name=name, capacity_bytes=1 << 20)
+    try:
+        s.clear()
+        s.set("k", 1)
+        s.set("k", 2)
+        assert s.get("k") == 2
+        assert s.num_keys() == 1
+        s.clear()
+        assert s.num_keys() == 0
+        assert s.get("k") is None
+    finally:
+        s.shutdown()
+        ShmStore(name=name, capacity_bytes=1 << 20).unlink()
