@@ -81,7 +81,7 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
         if ctx.extras.get("variant") == "sync":
             flats = ctx.plan.bucketize(params)
             flats = [allreduce_inplace(f, op=ReduceOp.AVG) for f in flats]
-            params = ctx.plan.debucketize(flats)
+            params = ctx.plan.debucketize(flats, params)
         return params, state
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
@@ -91,7 +91,7 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
             def avg(g):
                 flats = ctx.plan.bucketize(g)
                 return ctx.plan.debucketize(
-                    [allreduce_inplace(f, op=ReduceOp.AVG) for f in flats]
+                    [allreduce_inplace(f, op=ReduceOp.AVG) for f in flats], g
                 )
 
             grads = jax.lax.cond(

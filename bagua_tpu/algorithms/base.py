@@ -66,13 +66,24 @@ class AlgorithmImpl:
 
     # -- structure ----------------------------------------------------------
 
-    def tensors_to_buckets(self, tree, bucket_size_bytes: Optional[int] = None) -> BucketPlan:
-        """Default: dtype-grouped greedy buckets, aligned to the group size."""
+    def tensors_to_buckets(
+        self, tree, bucket_size_bytes: Optional[int] = None, filter_fn=None
+    ) -> BucketPlan:
+        """Default: dtype-grouped greedy buckets, aligned to the group size.
+        ``filter_fn(name)`` excludes leaves from communication (MoE expert
+        params, reference ``bagua_distributed.py:172``)."""
         if bucket_size_bytes is None:
             bucket_size_bytes = get_default_bucket_size()
         return BucketPlan.from_tree(
-            tree, bucket_size_bytes, align_elems=self.process_group.size
+            tree, bucket_size_bytes, align_elems=self.process_group.size,
+            filter_fn=filter_fn,
         )
+
+    def bind_plan(self, plan: BucketPlan) -> None:
+        """Called by the engine whenever the active bucket plan changes (init
+        and every rebucket), so algorithms that lay state out per-bucket see
+        a consistent plan."""
+        self._bound_plan = plan
 
     def init_state(self, params) -> Any:
         """Algorithm-private state pytree (peer weights, compression stats...)."""

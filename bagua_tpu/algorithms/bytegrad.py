@@ -84,7 +84,7 @@ class ByteGradAlgorithmImpl(AlgorithmImpl):
                 out.append(red.astype(flat.dtype))
             else:
                 out.append(compressed_allreduce(flat, (INTER_AXIS, INTRA_AXIS), self.average))
-        return ctx.plan.debucketize(out), params, state
+        return ctx.plan.debucketize(out, grads), params, state
 
 
 class ByteGradAlgorithm(Algorithm):

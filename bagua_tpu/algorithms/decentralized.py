@@ -104,9 +104,9 @@ class DecentralizedAlgorithmImpl(AlgorithmImpl):
         self.peer_selection_mode = peer_selection_mode
         self.communication_interval = communication_interval
 
-    def tensors_to_buckets(self, tree, bucket_size_bytes=None):
+    def tensors_to_buckets(self, tree, bucket_size_bytes=None, filter_fn=None):
         # The reference puts ALL weights in one bucket (``decentralized.py:52-61``).
-        return super().tensors_to_buckets(tree, bucket_size_bytes=1 << 62)
+        return super().tensors_to_buckets(tree, bucket_size_bytes=1 << 62, filter_fn=filter_fn)
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
         # The reference op keeps its own counter incremented once per executed
@@ -126,7 +126,7 @@ class DecentralizedAlgorithmImpl(AlgorithmImpl):
                     )
                 else:
                     out.append(_exchange(flat, comm_round, self.peer_selection_mode, ALL_AXES))
-            return ctx.plan.debucketize(out)
+            return ctx.plan.debucketize(out, params)
 
         if self.communication_interval > 1:
             params = jax.lax.cond(
@@ -171,8 +171,8 @@ class LowPrecisionDecentralizedAlgorithmImpl(AlgorithmImpl):
         super().__init__(process_group, hierarchical=hierarchical)
         self.communication_interval = communication_interval
 
-    def tensors_to_buckets(self, tree, bucket_size_bytes=None):
-        return super().tensors_to_buckets(tree, bucket_size_bytes=1 << 62)
+    def tensors_to_buckets(self, tree, bucket_size_bytes=None, filter_fn=None):
+        return super().tensors_to_buckets(tree, bucket_size_bytes=1 << 62, filter_fn=filter_fn)
 
     def _axes(self):
         if self.hierarchical and self.process_group.intra_size > 1:
@@ -183,7 +183,8 @@ class LowPrecisionDecentralizedAlgorithmImpl(AlgorithmImpl):
         # weight / left / right replicas, one flat array per bucket
         # (reference ``decentralized.py:186-197`` initializes the replicas
         # from the freshly-broadcast weights, so all three start equal).
-        plan = self.tensors_to_buckets(params)
+        # Use the engine's plan when bound so any dp_filter is respected.
+        plan = getattr(self, "_bound_plan", None) or self.tensors_to_buckets(params)
         flats = plan.bucketize(params)
         return {
             "weight": [f for f in flats],
@@ -222,7 +223,7 @@ class LowPrecisionDecentralizedAlgorithmImpl(AlgorithmImpl):
                 new_w.append(t_new.astype(t.dtype))
                 new_l.append(left.astype(t.dtype))
                 new_r.append(right.astype(t.dtype))
-            params = ctx.plan.debucketize(new_flats)
+            params = ctx.plan.debucketize(new_flats, params)
             return params, {"weight": new_w, "left": new_l, "right": new_r}
 
         if self.communication_interval > 1:

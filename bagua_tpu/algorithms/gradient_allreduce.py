@@ -33,7 +33,7 @@ class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
                 out.append(hierarchical_allreduce_inplace(flat, op=op))
             else:
                 out.append(allreduce_inplace(flat, op=op))
-        return ctx.plan.debucketize(out), params, state
+        return ctx.plan.debucketize(out, grads), params, state
 
 
 class GradientAllReduceAlgorithm(Algorithm):

@@ -100,7 +100,7 @@ class QAdamAlgorithmImpl(AlgorithmImpl):
                     out.append(compressed_allreduce(flat, ALL_AXES, average=True))
             else:
                 out.append(allreduce_inplace(flat, op=ReduceOp.AVG))
-        return ctx.plan.debucketize(out)
+        return ctx.plan.debucketize(out, tree)
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
         b1, b2 = self.optimizer.betas

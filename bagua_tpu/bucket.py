@@ -92,9 +92,15 @@ class BucketPlan:
     # -- construction -------------------------------------------------------
 
     @classmethod
-    def from_tree(cls, tree, bucket_size_bytes: int, align_elems: int = 1) -> "BucketPlan":
+    def from_tree(
+        cls, tree, bucket_size_bytes: int, align_elems: int = 1, filter_fn=None
+    ) -> "BucketPlan":
         """Greedy dtype-grouped split by byte size (reference
-        ``autotune_task_manager.py:85-119``)."""
+        ``autotune_task_manager.py:85-119``).  ``filter_fn(name) -> bool``
+        restricts which leaves are communicated (the analog of the reference
+        excluding MoE expert params from DP bucketing,
+        ``bagua_distributed.py:172``); excluded leaves pass through
+        ``debucketize`` untouched via its ``fallback`` tree."""
         paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
         names = [jax.tree_util.keystr(p) for p, _ in paths_and_leaves]
         leaves = [l for _, l in paths_and_leaves]
@@ -104,6 +110,7 @@ class BucketPlan:
                 dtype=to_bagua_datatype(l.dtype),
             )
             for n, l in zip(names, leaves)
+            if filter_fn is None or filter_fn(n)
         ]
         shapes = {n: tuple(l.shape) for n, l in zip(names, leaves)}
         specs = split_declarations(decls, shapes, bucket_size_bytes, align_elems)
@@ -155,16 +162,33 @@ class BucketPlan:
             flats.append(jnp.concatenate(parts) if len(parts) > 1 else parts[0])
         return flats
 
-    def debucketize(self, flats: Sequence[jnp.ndarray]):
-        """Rebuild the original pytree from fused arrays (traceable)."""
+    def debucketize(self, flats: Sequence[jnp.ndarray], fallback=None):
+        """Rebuild the original pytree from fused arrays (traceable).
+
+        Leaves not covered by any bucket (excluded by a ``filter_fn``) are
+        taken from ``fallback`` — normally the tree that was bucketized."""
         leaves_by_name: Dict[str, jnp.ndarray] = {}
         for spec, flat in zip(self.specs, flats):
             for s in spec.slots:
                 leaves_by_name[s.name] = flat[s.offset : s.offset + s.numel].reshape(s.shape)
+        fallback_by_name: Dict[str, jnp.ndarray] = {}
+        if fallback is not None:
+            for p, l in jax.tree_util.tree_flatten_with_path(fallback)[0]:
+                fallback_by_name[jax.tree_util.keystr(p)] = l
         # Reassemble in treedef leaf order.
         dummy = self._treedef.unflatten(range(self._treedef.num_leaves))
         paths = [p for p, _ in jax.tree_util.tree_flatten_with_path(dummy)[0]]
-        ordered = [leaves_by_name[jax.tree_util.keystr(p)] for p in paths]
+        ordered = []
+        for p in paths:
+            name = jax.tree_util.keystr(p)
+            if name in leaves_by_name:
+                ordered.append(leaves_by_name[name])
+            elif name in fallback_by_name:
+                ordered.append(fallback_by_name[name])
+            else:
+                raise KeyError(
+                    f"leaf {name} is not in any bucket and no fallback was given"
+                )
         return self._treedef.unflatten(ordered)
 
     # -- introspection ------------------------------------------------------

@@ -1,0 +1,7 @@
+"""Checkpoint / resume (reference ``bagua/torch_api/checkpoint/``)."""
+
+from bagua_tpu.checkpoint.checkpointing import (  # noqa: F401
+    save_checkpoint,
+    load_checkpoint,
+    get_latest_iteration,
+)

@@ -1,0 +1,133 @@
+"""Checkpoint save/load with MoE-aware layout.
+
+TPU-native analog of the reference's ``checkpoint/checkpointing.py``:
+
+* a tracker file ``latest_checkpointed_iteration.txt`` at the checkpoint root
+  names the newest complete checkpoint (reference ``:87-109``);
+* non-expert ("model") state and expert state are stored separately, so a
+  job restarted with a different expert-parallel layout can remap experts
+  (reference saves per-expert model states + per-expert-parallel-rank
+  optimizer states, ``:34-84``).
+
+Arrays are serialized with Orbax (the JAX-native checkpointing library —
+replacing ``torch.save``); the train state is any pytree, typically a
+:class:`~bagua_tpu.ddp.TrainState`.
+"""
+
+import os
+from typing import Optional, Tuple
+
+import jax
+
+TRACKER_FILENAME = "latest_checkpointed_iteration.txt"
+
+
+def _ckpt_path(ckpt_dir: str, iteration: int) -> str:
+    return os.path.join(ckpt_dir, f"iter_{iteration:07d}")
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def _default_expert_filter(path: str) -> bool:
+    from bagua_tpu.parallel.moe.utils import is_moe_param_path
+
+    return is_moe_param_path(path)
+
+
+def _split_expert(tree, expert_filter=_default_expert_filter):
+    """Partition a pytree into (non-expert, expert) with None placeholders so
+    both halves keep the full tree structure.  ``expert_filter`` decides which
+    leaf paths are per-rank expert state (defaults to the MoE convention)."""
+    is_expert = lambda path: expert_filter(jax.tree_util.keystr(path))
+    non_expert = jax.tree_util.tree_map_with_path(
+        lambda p, x: None if is_expert(p) else x, tree
+    )
+    expert = jax.tree_util.tree_map_with_path(
+        lambda p, x: x if is_expert(p) else None, tree
+    )
+    return non_expert, expert
+
+
+def _merge(non_expert, expert):
+    return jax.tree.map(
+        lambda a, b: a if a is not None else b,
+        non_expert,
+        expert,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def save_checkpoint(
+    iteration: int,
+    ckpt_dir: str,
+    state,
+    moe_split: bool = True,
+    expert_filter=_default_expert_filter,
+) -> str:
+    """Save ``state`` under ``ckpt_dir/iter_XXXXXXX`` and update the tracker
+    (reference ``save_checkpoint``, ``checkpointing.py:112``).
+
+    ``expert_filter(leaf_path) -> bool`` names the per-rank (expert) leaves;
+    keep it the complement of the engine's ``dp_filter`` if you customized
+    expert naming."""
+    path = _ckpt_path(ckpt_dir, iteration)
+    os.makedirs(path, exist_ok=True)
+    ckpt = _checkpointer()
+    if moe_split:
+        non_expert, expert = _split_expert(state, expert_filter)
+        ckpt.save(os.path.join(path, "model_states"), non_expert, force=True)
+        if any(l is not None for l in jax.tree.leaves(expert, is_leaf=lambda x: x is None)):
+            ckpt.save(os.path.join(path, "expert_states"), expert, force=True)
+    else:
+        ckpt.save(os.path.join(path, "model_states"), state, force=True)
+    # Tracker last: its presence certifies a complete checkpoint.
+    with open(os.path.join(ckpt_dir, TRACKER_FILENAME), "w") as f:
+        f.write(str(iteration))
+    return path
+
+
+def get_latest_iteration(ckpt_dir: str) -> Optional[int]:
+    tracker = os.path.join(ckpt_dir, TRACKER_FILENAME)
+    if not os.path.exists(tracker):
+        return None
+    with open(tracker) as f:
+        return int(f.read().strip())
+
+
+def load_checkpoint(
+    ckpt_dir: str,
+    iteration: Optional[int] = None,
+    target=None,
+    expert_filter=_default_expert_filter,
+) -> Tuple[object, int]:
+    """Load the checkpoint named by the tracker (or an explicit iteration).
+    Returns ``(state, iteration)`` (reference ``load_checkpoint``,
+    ``checkpointing.py:165+``).
+
+    Pass ``target`` (a pytree of the same structure, e.g. a freshly built
+    ``TrainState``) to restore exact container types — Orbax otherwise
+    returns plain dicts/lists, which breaks optax NamedTuple states."""
+    if iteration is None:
+        iteration = get_latest_iteration(ckpt_dir)
+        if iteration is None:
+            raise FileNotFoundError(f"no tracker file in {ckpt_dir}")
+    path = _ckpt_path(ckpt_dir, iteration)
+    ckpt = _checkpointer()
+    expert_path = os.path.join(path, "expert_states")
+    has_expert = os.path.exists(expert_path)
+    target_non_expert = target_expert = None
+    if target is not None and has_expert:
+        target_non_expert, target_expert = _split_expert(target, expert_filter)
+    elif target is not None:
+        target_non_expert = target
+    non_expert = ckpt.restore(os.path.join(path, "model_states"), item=target_non_expert)
+    if has_expert:
+        expert = ckpt.restore(expert_path, item=target_expert)
+        state = _merge(non_expert, expert)
+    else:
+        state = non_expert
+    return state, iteration

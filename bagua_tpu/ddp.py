@@ -70,6 +70,11 @@ class DistributedDataParallel:
         algorithm: a :class:`~bagua_tpu.algorithms.base.Algorithm` (or impl).
         process_group: defaults to the global group.
         bucket_size_bytes: communication bucket size (autotune overwrites it).
+        dp_filter: ``filter(leaf_name) -> bool``; leaves for which it returns
+            False are NOT communicated (their gradients stay local).  The MoE
+            integration passes ``lambda name: "experts" not in name`` — the
+            analog of the reference excluding expert params from DP bucketing
+            (``bagua_distributed.py:172``, ``moe/utils.py:4-7``).
     """
 
     def __init__(
@@ -79,6 +84,7 @@ class DistributedDataParallel:
         algorithm: Algorithm,
         process_group: Optional[BaguaProcessGroup] = None,
         bucket_size_bytes: Optional[int] = None,
+        dp_filter: Optional[Callable[[str], bool]] = None,
     ):
         self.loss_fn = loss_fn
         self.group = process_group or get_default_group()
@@ -97,6 +103,7 @@ class DistributedDataParallel:
             optimizer = bundled.to_optax()
         self.optimizer = optimizer
         self.bucket_size_bytes = bucket_size_bytes or get_default_bucket_size()
+        self.dp_filter = dp_filter
         self.plan: Optional[BucketPlan] = None
         self._step_fns = {}
         self._host_step: Optional[int] = None  # seeded from state on first step
@@ -104,20 +111,43 @@ class DistributedDataParallel:
 
     # -- initialization -----------------------------------------------------
 
-    def init(self, params) -> TrainState:
-        """Build the rank-stacked train state from a single parameter copy."""
+    def init(self, params=None, stacked_params=None) -> TrainState:
+        """Build the rank-stacked train state.
+
+        Pass ``params`` (one copy, replicated to every rank — the analog of
+        the reference broadcasting from rank 0) OR ``stacked_params`` with a
+        leading ``group.size`` axis when ranks must start with *different*
+        values (e.g. independently initialized MoE experts)."""
         n = self.group.size
-        opt_state = self.optimizer.init(params)
-        algo_state = self.impl.init_state(params)
-        # Bucket plan is computed from the (unstacked) communicated tree.
-        self.plan = self.impl.tensors_to_buckets(params, self.bucket_size_bytes)
-        self._tree_template = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+        if stacked_params is not None and params is not None:
+            raise ValueError("pass either params or stacked_params, not both")
+        if stacked_params is not None:
+            template = jax.tree.map(lambda x: x[0], stacked_params)
+        else:
+            if params is None:
+                raise ValueError("pass params or stacked_params")
+            template = params
+        # Bucket plan is computed from the (unstacked) communicated tree;
+        # algorithms holding per-bucket state read it during init_state.
+        self.plan = self.impl.tensors_to_buckets(
+            template, self.bucket_size_bytes, filter_fn=self.dp_filter
         )
+        self.impl.bind_plan(self.plan)
+        self._tree_template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template
+        )
+        if stacked_params is not None:
+            stacked = stacked_params
+            opt_state = jax.vmap(self.optimizer.init)(stacked)
+            algo_state = jax.vmap(self.impl.init_state)(stacked)
+        else:
+            stacked = _stack(params, n)
+            opt_state = _stack(self.optimizer.init(params), n)
+            algo_state = _stack(self.impl.init_state(params), n)
         return TrainState(
-            params=_stack(params, n),
-            opt_state=_stack(opt_state, n),
-            algo_state=_stack(algo_state, n),
+            params=stacked,
+            opt_state=opt_state,
+            algo_state=algo_state,
             step=jnp.zeros((n,), jnp.int32),
         )
 
@@ -133,6 +163,7 @@ class DistributedDataParallel:
                 "likewise excludes such algorithms from autotune re-bucketing)"
             )
         self.plan = plan
+        self.impl.bind_plan(plan)
         self._step_fns = {}
 
     # -- the step -----------------------------------------------------------

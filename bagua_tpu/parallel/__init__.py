@@ -1,0 +1,2 @@
+"""Parallelism strategies beyond data parallel: expert (MoE), tensor,
+sequence/context parallelism over named mesh axes."""

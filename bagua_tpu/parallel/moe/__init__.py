@@ -1,0 +1,11 @@
+"""Expert-parallel Mixture of Experts (reference ``model_parallel/moe/``)."""
+
+from bagua_tpu.parallel.moe.sharded_moe import (  # noqa: F401
+    top1gating,
+    top2gating,
+    TopKGate,
+    MOELayer,
+    Experts,
+)
+from bagua_tpu.parallel.moe.layer import MoE  # noqa: F401
+from bagua_tpu.parallel.moe.utils import is_moe_param  # noqa: F401

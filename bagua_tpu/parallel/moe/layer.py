@@ -1,0 +1,42 @@
+"""User-facing MoE module (reference ``model_parallel/moe/layer.py:22``)."""
+
+from typing import Optional, Tuple, Union
+
+import flax.linen as nn
+
+from bagua_tpu.parallel.moe.sharded_moe import MOELayer
+
+
+class MoE(nn.Module):
+    """A mixture-of-experts FFN block: ``out, l_aux = MoE(...)(x)``.
+
+    Mirrors the reference constructor (``layer.py:22``): ``num_experts`` total
+    experts sharded over the expert-parallel axes, top-``k`` gating with
+    capacity factors.  Add ``l_aux`` (scaled by your chosen coefficient) to
+    the training loss for load balancing.
+    """
+
+    hidden_size: int
+    num_experts: int = 1
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    ep_size: int = 1
+    ep_axis: Union[str, Tuple[str, ...], None] = ("inter", "intra")
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, rng=None):
+        return MOELayer(
+            num_experts=self.num_experts,
+            hidden_dim=self.hidden_size,
+            k=self.k,
+            capacity_factor=self.capacity_factor,
+            eval_capacity_factor=self.eval_capacity_factor,
+            min_capacity=self.min_capacity,
+            noisy_gate_policy=self.noisy_gate_policy,
+            ep_size=self.ep_size,
+            ep_axis=self.ep_axis,
+            name="moe_layer",
+        )(x, train=train, rng=rng)

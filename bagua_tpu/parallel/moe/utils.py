@@ -1,0 +1,29 @@
+"""MoE parameter classification (reference ``moe/utils.py:4-7`` tags expert
+params with ``allreduce=False`` so DP excludes them; here expert leaves are
+identified by path)."""
+
+from typing import List, Tuple
+
+import jax
+
+
+def is_moe_param_path(path: str) -> bool:
+    return "experts" in path
+
+
+def is_moe_param(path_or_leaf) -> bool:
+    if isinstance(path_or_leaf, str):
+        return is_moe_param_path(path_or_leaf)
+    return False
+
+
+def split_moe_params(params) -> Tuple[dict, dict]:
+    """Split a param tree into (non-expert, expert) trees by leaf path —
+    the analog of the reference excluding MoE params from DP bucketing
+    (``bagua_distributed.py:172``)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    non_expert, expert = {}, {}
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        (expert if is_moe_param_path(key) else non_expert)[key] = leaf
+    return non_expert, expert

@@ -1,0 +1,201 @@
+"""MoE expert parallelism: gating invariants, EP all_to_all correctness,
+end-to-end DDP training with experts excluded from DP sync."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from bagua_tpu.communication import ALL_AXES
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.parallel.moe import MoE, top1gating, top2gating
+from bagua_tpu.parallel.moe.utils import split_moe_params
+
+N = 8
+MODEL_DIM = 8
+NUM_EXPERTS = 8
+
+
+def test_top1gating_invariants():
+    rng = np.random.RandomState(0)
+    S, E = 16, 4
+    logits = jnp.asarray(rng.randn(S, E).astype(np.float32))
+    l_aux, combine, dispatch, exp_counts = top1gating(logits, capacity_factor=1.0, min_capacity=2)
+    C = combine.shape[-1]
+    assert combine.shape == (S, E, C) and dispatch.shape == (S, E, C)
+    # each token goes to at most one (expert, slot)
+    assert int(jnp.sum(dispatch, axis=(1, 2)).max()) <= 1
+    # each (expert, slot) holds at most one token
+    assert int(jnp.sum(dispatch, axis=0).max()) <= 1
+    # capacity respected
+    assert int(jnp.sum(dispatch, axis=(0, 2)).max()) <= C
+    # l_aux formula: sum(me*ce)*E
+    gates = jax.nn.softmax(logits, axis=1)
+    mask1 = jax.nn.one_hot(jnp.argmax(gates, axis=1), E)
+    expect = jnp.sum(jnp.mean(gates, 0) * jnp.mean(mask1, 0)) * E
+    np.testing.assert_allclose(float(l_aux), float(expect), rtol=1e-5)
+    # exp_counts = tokens per expert pre-capacity
+    np.testing.assert_array_equal(np.asarray(exp_counts), np.asarray(mask1.sum(0), np.int32))
+
+
+def test_top2gating_invariants():
+    rng = np.random.RandomState(1)
+    S, E = 16, 4
+    logits = jnp.asarray(rng.randn(S, E).astype(np.float32))
+    l_aux, combine, dispatch, exp_counts = top2gating(logits, capacity_factor=1.0)
+    # each token dispatched to at most 2 slots, combine weights sum to ~1
+    per_token = jnp.sum(dispatch, axis=(1, 2))
+    assert int(per_token.max()) <= 2
+    sums = jnp.sum(combine, axis=(1, 2))
+    kept = per_token > 0
+    np.testing.assert_allclose(
+        np.asarray(sums)[np.asarray(kept)], 1.0, rtol=1e-5
+    )
+
+
+def test_top1_capacity_truncation():
+    # all tokens pick expert 0: capacity must cut the tail
+    logits = jnp.tile(jnp.asarray([[10.0, 0.0]]), (8, 1))
+    l_aux, combine, dispatch, exp_counts = top1gating(logits, capacity_factor=1.0, min_capacity=2)
+    C = combine.shape[-1]
+    assert int(jnp.sum(dispatch)) == min(8, C)
+    assert int(exp_counts[0]) == 8  # pre-capacity count
+
+
+class MoEModel(nn.Module):
+    num_experts: int
+    ep_size: int
+    k: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(MODEL_DIM)(x)
+        h = jax.nn.relu(h)
+        out, l_aux = MoE(
+            hidden_size=MODEL_DIM * 2,
+            num_experts=self.num_experts,
+            k=self.k,
+            capacity_factor=2.0,
+            ep_size=self.ep_size,
+            ep_axis=ALL_AXES,
+        )(h)
+        out = nn.Dense(4)(out)
+        return out, l_aux
+
+
+def moe_loss_fn(model):
+    def loss_fn(params, batch):
+        x, y = batch
+        logits, l_aux = model.apply({"params": params}, x)
+        mse = jnp.mean((logits - y) ** 2)
+        return mse + 0.01 * l_aux
+
+    return loss_fn
+
+
+def test_ep_matches_local_when_experts_tiled(group):
+    """With identical (tiled) expert params, the distributed EP dispatch must
+    produce the same per-rank output as running all experts locally."""
+    rng = np.random.RandomState(2)
+    x = rng.randn(N, 16, MODEL_DIM).astype(np.float32)  # per-rank tokens
+
+    # local model: all experts on every rank
+    local_model = MoEModel(num_experts=NUM_EXPERTS, ep_size=1)
+    params = local_model.init(jax.random.PRNGKey(0), jnp.asarray(x[0]))["params"]
+
+    local_out = np.stack(
+        [np.asarray(local_model.apply({"params": params}, jnp.asarray(x[r]))[0]) for r in range(N)]
+    )
+
+    # EP model: same math, experts sharded over 8 ranks (1 expert each).
+    ep_model = MoEModel(num_experts=NUM_EXPERTS, ep_size=N)
+    ep_params = ep_model.init(jax.random.PRNGKey(0), jnp.asarray(x[0]))["params"]
+
+    # Map the local model's expert e params to EP rank e's single local expert.
+    def to_rank(r, tree_local, tree_ep):
+        return jax.tree.map(
+            lambda le, ee: le[r : r + 1] if le.shape[:1] == (NUM_EXPERTS,) and ee.shape[:1] == (1,) else le,
+            tree_local, tree_ep,
+        )
+
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[to_rank(r, params, ep_params) for r in range(N)],
+    )
+
+    fn = jax.jit(
+        group.shard_map(
+            lambda p, xx: ep_model.apply({"params": jax.tree.map(lambda q: q[0], p)}, xx[0])[0][None],
+            in_specs=(P(ALL_AXES), P(ALL_AXES)),
+            out_specs=P(ALL_AXES),
+        )
+    )
+    ep_out = np.asarray(fn(stacked, jnp.asarray(x)))
+    np.testing.assert_allclose(ep_out, local_out, rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_moe_ddp_training(group, k):
+    """End-to-end: DDP + MoE with experts excluded from DP; expert params
+    diverge across ranks, non-expert params stay bitwise equal
+    (reference CI MoE benchmark, benchmark_master.sh:109-144)."""
+    model = MoEModel(num_experts=NUM_EXPERTS, ep_size=N, k=k)
+    rng = np.random.RandomState(3)
+    x0 = jnp.asarray(rng.randn(16, MODEL_DIM).astype(np.float32))
+    # per-rank independent expert init
+    per_rank = [
+        model.init(jax.random.PRNGKey(100 + r), x0)["params"] for r in range(N)
+    ]
+    # non-expert params must start equal: take rank 0's everywhere
+    base = per_rank[0]
+
+    def merge(r):
+        def pick(path, b, pr):
+            return pr if "experts" in jax.tree_util.keystr(path) else b
+
+        return jax.tree_util.tree_map_with_path(pick, base, per_rank[r])
+
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *[merge(r) for r in range(N)])
+
+    ddp = DistributedDataParallel(
+        moe_loss_fn(model),
+        optax.adam(1e-2),
+        __import__("bagua_tpu.algorithms", fromlist=["x"]).GradientAllReduceAlgorithm(),
+        process_group=group,
+        dp_filter=lambda name: "experts" not in name,
+    )
+    state = ddp.init(stacked_params=stacked)
+
+    losses_hist = []
+    for i in range(8):
+        batch = (
+            jnp.asarray(rng.randn(N * 16, MODEL_DIM), np.float32),
+            jnp.asarray(rng.randn(N * 16, 4), np.float32),
+        )
+        state, losses = ddp.train_step(state, batch)
+        losses_hist.append(float(losses.mean()))
+
+    assert all(np.isfinite(losses_hist))
+    flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    for path, leaf in flat:
+        name = jax.tree_util.keystr(path)
+        arr = np.asarray(leaf)
+        if "experts" in name:
+            assert not all(
+                np.array_equal(arr[0], arr[r]) for r in range(1, N)
+            ), f"expert param {name} should differ across ranks"
+        else:
+            for r in range(1, N):
+                np.testing.assert_array_equal(arr[0], arr[r], err_msg=name)
+
+
+def test_split_moe_params():
+    model = MoEModel(num_experts=4, ep_size=1)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((4, MODEL_DIM)))["params"]
+    non_expert, expert = split_moe_params(params)
+    assert expert and non_expert
+    assert all("experts" in k for k in expert)
+    assert all("experts" not in k for k in non_expert)
