@@ -259,6 +259,14 @@ class AutotuneSession:
         # register the current plan's tensors
         decls = [td for bucket in ddp.plan.declarations() for td in bucket]
         self.client.register_tensors(model_name, decls)
+        # report the execution order implied by the plan (reference learns it
+        # from OTel tensor_ready spans; here the jitted step executes slots in
+        # plan order by construction)
+        from bagua_tpu.observability import SpanRecorder
+
+        self.spans = SpanRecorder()
+        self.spans.record_plan_order(ddp.plan)
+        self.spans.report_to_autotune(self.client, model_name)
 
     def tick(self, n_samples: int) -> None:
         """Call once per training step with the number of samples processed."""

@@ -1,0 +1,142 @@
+"""Elastic launcher: ``python -m bagua_tpu.distributed.run ... script.py``.
+
+TPU-native analog of the reference's torchelastic-derived launcher
+(``bagua/distributed/run.py``): sets up the distributed env, spawns one
+worker process per local replica, monitors them, and on any failure tears the
+whole gang down and restarts it (restart-all semantics, reference behavior
+doc ``run.py:116-148``) up to ``--max_restarts`` times.  Workers are expected
+to checkpoint and resume via ``bagua_tpu.checkpoint`` (the pattern the
+reference documents at ``run.py:149-159``); on TPU, slices are
+gang-scheduled, so elasticity *is* checkpoint-restart.
+
+Env exported to workers (reference ``set_bagua_env``, ``run.py:578-603``):
+``RANK``, ``WORLD_SIZE``, ``LOCAL_RANK``, ``LOCAL_WORLD_SIZE``, ``NODE_RANK``,
+``MASTER_ADDR``, ``MASTER_PORT``, ``BAGUA_SERVICE_PORT``, autotune knobs.
+Rank 0's launcher also hosts the autotune service when ``--autotune_level >= 1``.
+"""
+
+import argparse
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+logger = logging.getLogger("bagua_tpu.launcher")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        "bagua_tpu.distributed.run", description="bagua_tpu elastic launcher"
+    )
+    p.add_argument("--nnodes", type=int, default=1, help="number of nodes (hosts)")
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument(
+        "--nproc_per_node", type=int, default=1,
+        help="worker processes per node (on TPU usually 1 process drives all local chips)",
+    )
+    p.add_argument("--master_addr", default="127.0.0.1")
+    p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--monitor_interval", type=float, default=1.0)
+    p.add_argument("--autotune_level", type=int, default=0)
+    p.add_argument("--bagua_service_port", type=int, default=29501)
+    p.add_argument("--no_python", action="store_true")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def worker_env(args, local_rank: int) -> dict:
+    env = dict(os.environ)
+    world_size = args.nnodes * args.nproc_per_node
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env.update(
+        RANK=str(rank),
+        WORLD_SIZE=str(world_size),
+        LOCAL_RANK=str(local_rank),
+        LOCAL_WORLD_SIZE=str(args.nproc_per_node),
+        NODE_RANK=str(args.node_rank),
+        MASTER_ADDR=args.master_addr,
+        MASTER_PORT=str(args.master_port),
+        BAGUA_SERVICE_PORT=str(args.bagua_service_port),
+        BAGUA_AUTOTUNE=str(args.autotune_level),
+        AUTO_TUNE_SERVER_ADDR=f"{args.master_addr}:{args.bagua_service_port}",
+    )
+    return env
+
+
+def spawn_workers(args) -> List[subprocess.Popen]:
+    procs = []
+    for local_rank in range(args.nproc_per_node):
+        if args.no_python:
+            cmd = [args.training_script] + args.training_script_args
+        else:
+            cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
+        procs.append(subprocess.Popen(cmd, env=worker_env(args, local_rank)))
+    return procs
+
+
+def kill_all(procs: List[subprocess.Popen]) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.time() + 10
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def monitor(procs: List[subprocess.Popen], interval: float) -> Optional[int]:
+    """Wait until all workers exit cleanly (return None) or any fails
+    (return its exit code)."""
+    while True:
+        states = [p.poll() for p in procs]
+        for code in states:
+            if code is not None and code != 0:
+                return code
+        if all(code == 0 for code in states):
+            return None
+        time.sleep(interval)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO, format="[bagua_tpu.launcher] %(message)s")
+    args = parse_args(argv)
+
+    autotune_server = None
+    if args.autotune_level >= 1 and args.node_rank == 0:
+        from bagua_tpu.service import AutotuneService, start_autotune_server
+
+        service = AutotuneService(
+            world_size=args.nnodes * args.nproc_per_node,
+            autotune_level=args.autotune_level,
+        )
+        autotune_server = start_autotune_server(service, port=args.bagua_service_port)
+        logger.info("autotune service on port %d", args.bagua_service_port)
+
+    try:
+        for attempt in range(args.max_restarts + 1):
+            procs = spawn_workers(args)
+            failed = monitor(procs, args.monitor_interval)
+            if failed is None:
+                logger.info("all workers finished")
+                return 0
+            logger.warning(
+                "worker failed with exit code %d (attempt %d/%d); restarting all",
+                failed, attempt + 1, args.max_restarts + 1,
+            )
+            kill_all(procs)
+        logger.error("exceeded max_restarts=%d", args.max_restarts)
+        return 1
+    finally:
+        if autotune_server is not None:
+            autotune_server.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

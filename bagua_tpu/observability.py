@@ -1,0 +1,161 @@
+"""Tracing, metrics and hang detection.
+
+TPU-native analog of the reference's auxiliary subsystems (SURVEY §5.1-5.2):
+
+* **Spans** (reference: Rust OTel ``tensor_ready`` spans POSTed to the
+  autotune server, ``bagua-opentelemetry/src/exporter/mod.rs:15-62``): a
+  host-side :class:`SpanRecorder` collects ``(action, tensor_name, start,
+  end)`` records — e.g. bucket execution order derived from the jitted step —
+  and ships them to the autotune service to learn tensor ordering.
+* **Step timing** (reference: CUDA-event pairs + ``StatisticalAverage``,
+  ``bagua_distributed.py:113-131``): :class:`StepTimer` wraps
+  ``block_until_ready`` wall-time into the engine's ``SpeedMeter``.
+* **Hang watchdog** (reference: comm monitor thread panicking after 300 s,
+  ``src/lib.rs:255-265``, and the panic→process-exit hook,
+  ``bagua-core-py/src/lib.rs:547-553``): :class:`Watchdog` kills the process
+  with a full thread dump if no heartbeat arrives within the timeout, so a
+  wedged worker can't hang a gang-scheduled job.
+"""
+
+import faulthandler
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class SpanRecorder:
+    """Collects spans; thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.spans: List[Dict] = []
+
+    def record(self, action: str, tensor_name: str, start_time: float, end_time: float):
+        with self._lock:
+            self.spans.append(
+                {
+                    "action": action,
+                    "tensor_name": tensor_name,
+                    "start_time": start_time,
+                    "end_time": end_time,
+                }
+            )
+
+    def record_plan_order(self, plan) -> None:
+        """Derive a tensor partial order from the active bucket plan: slots
+        execute in bucket-then-offset order inside the jitted step (the
+        analog of learning order from backward-hook spans)."""
+        t = time.time()
+        i = 0
+        for spec in plan.specs:
+            for slot in spec.slots:
+                self.record("tensor_ready", slot.name, t + i * 1e-6, t + (i + 1) * 1e-6)
+                i += 1
+
+    def drain(self) -> List[Dict]:
+        with self._lock:
+            out, self.spans = self.spans, []
+        return out
+
+    def report_to_autotune(self, client, model_name: str) -> None:
+        spans = self.drain()
+        if spans:
+            client.report_tensor_execution_order(model_name, spans)
+
+
+class StepTimer:
+    """Times jitted steps; feeds a SpeedMeter and keeps simple aggregates.
+
+    Use ``with timer.step(n_samples): ...`` around dispatch+wait, or call
+    ``tick`` manually.
+    """
+
+    def __init__(self, speed_meter=None):
+        self.speed_meter = speed_meter
+        self.n_steps = 0
+        self.total_time = 0.0
+        self.last_step_time = 0.0
+
+    class _Ctx:
+        def __init__(self, timer, n_samples):
+            self.timer = timer
+            self.n_samples = n_samples
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.timer.tick(time.perf_counter() - self.t0, self.n_samples)
+            return False
+
+    def step(self, n_samples: int = 0) -> "_Ctx":
+        return StepTimer._Ctx(self, n_samples)
+
+    def tick(self, elapsed: float, n_samples: int = 0) -> None:
+        self.n_steps += 1
+        self.total_time += elapsed
+        self.last_step_time = elapsed
+        if self.speed_meter is not None and n_samples:
+            self.speed_meter.record(n_samples)
+
+    @property
+    def mean_step_time(self) -> float:
+        return self.total_time / self.n_steps if self.n_steps else 0.0
+
+
+class Watchdog:
+    """Fail-fast hang detector.
+
+    Call :meth:`beat` at least every ``timeout_s`` seconds (typically once
+    per training step).  If the heartbeat stops — a wedged collective, a
+    deadlocked host thread — the watchdog dumps every thread's stack and
+    kills the process (exit code 42), letting the launcher's restart logic
+    take over.  ``on_timeout`` can override the kill for tests.
+    """
+
+    def __init__(self, timeout_s: float = 300.0, check_interval_s: Optional[float] = None, on_timeout=None):
+        self.timeout_s = timeout_s
+        self.check_interval_s = check_interval_s or min(10.0, timeout_s / 3)
+        self.on_timeout = on_timeout
+        self._last_beat = time.monotonic()
+        self._armed = False
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True, name="bagua-watchdog")
+            self._thread.start()
+        return self
+
+    def beat(self) -> None:
+        self._last_beat = time.monotonic()
+        self._armed = True
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _run(self) -> None:
+        while not self._stopped.wait(self.check_interval_s):
+            if not self._armed:
+                continue
+            silent = time.monotonic() - self._last_beat
+            if silent > self.timeout_s:
+                logger.error(
+                    "watchdog: no heartbeat for %.1fs (timeout %.1fs); dumping threads",
+                    silent,
+                    self.timeout_s,
+                )
+                if self.on_timeout is not None:
+                    self.on_timeout(silent)
+                    self._armed = False
+                    continue
+                faulthandler.dump_traceback(file=sys.stderr)
+                sys.stderr.flush()
+                os._exit(42)

@@ -1,0 +1,123 @@
+"""Observability (spans, timer, watchdog) and elastic launcher tests."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from bagua_tpu.observability import SpanRecorder, StepTimer, Watchdog
+from bagua_tpu.utils import SpeedMeter
+
+
+def test_span_recorder_plan_order():
+    import jax.numpy as jnp
+
+    from bagua_tpu.bucket import BucketPlan
+
+    tree = {"a": jnp.zeros((4,)), "b": jnp.zeros((4,)), "c": jnp.zeros((4,))}
+    plan = BucketPlan.from_tree(tree, bucket_size_bytes=1)
+    rec = SpanRecorder()
+    rec.record_plan_order(plan)
+    spans = rec.drain()
+    assert len(spans) == 3
+    assert [s["action"] for s in spans] == ["tensor_ready"] * 3
+    starts = [s["start_time"] for s in spans]
+    assert starts == sorted(starts)
+    assert rec.drain() == []
+
+
+def test_step_timer():
+    timer = StepTimer(speed_meter=SpeedMeter())
+    with timer.step(n_samples=32):
+        time.sleep(0.01)
+    assert timer.n_steps == 1
+    assert timer.last_step_time >= 0.01
+    assert timer.mean_step_time > 0
+
+
+def test_watchdog_fires_and_disarms():
+    fired = []
+    wd = Watchdog(timeout_s=0.2, check_interval_s=0.05, on_timeout=lambda s: fired.append(s)).start()
+    wd.beat()
+    time.sleep(0.6)
+    assert fired, "watchdog should have fired"
+    wd.stop()
+
+
+def test_watchdog_quiet_while_beating():
+    fired = []
+    wd = Watchdog(timeout_s=0.5, check_interval_s=0.05, on_timeout=lambda s: fired.append(s)).start()
+    for _ in range(8):
+        wd.beat()
+        time.sleep(0.05)
+    assert not fired
+    wd.stop()
+
+
+def test_watchdog_not_armed_before_first_beat():
+    fired = []
+    wd = Watchdog(timeout_s=0.1, check_interval_s=0.05, on_timeout=lambda s: fired.append(s)).start()
+    time.sleep(0.3)
+    assert not fired  # never armed
+    wd.stop()
+
+
+# ---------------- launcher ----------------------------------------------------
+
+
+def run_launcher(tmp_path, script_body: str, extra_args=None, max_restarts=1):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(script_body))
+    cmd = [
+        sys.executable, "-m", "bagua_tpu.distributed.run",
+        "--nproc_per_node", "2", "--max_restarts", str(max_restarts),
+        "--monitor_interval", "0.2",
+    ] + (extra_args or []) + [str(script)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=120)
+
+
+def test_launcher_success(tmp_path):
+    marker = tmp_path / "ok"
+    r = run_launcher(
+        tmp_path,
+        f"""
+        import os
+        rank = os.environ["RANK"]; ws = os.environ["WORLD_SIZE"]
+        assert ws == "2"
+        assert os.environ["LOCAL_WORLD_SIZE"] == "2"
+        open(r"{marker}" + rank, "w").write("done")
+        """,
+    )
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "ok0").exists() and (tmp_path / "ok1").exists()
+
+
+def test_launcher_restart_then_success(tmp_path):
+    """First attempt fails (rank 1 exits 1); restart succeeds — the
+    checkpoint-restart elastic pattern."""
+    flag = tmp_path / "attempted"
+    r = run_launcher(
+        tmp_path,
+        f"""
+        import os, sys
+        flag = r"{flag}" + os.environ["RANK"]
+        if not os.path.exists(flag):
+            open(flag, "w").write("x")
+            if os.environ["RANK"] == "1":
+                sys.exit(1)
+        """,
+        max_restarts=2,
+    )
+    assert r.returncode == 0, r.stderr
+
+
+def test_launcher_exceeds_max_restarts(tmp_path):
+    r = run_launcher(tmp_path, "import sys; sys.exit(3)", max_restarts=1)
+    assert r.returncode == 1
+    assert "exceeded max_restarts" in r.stderr + r.stdout
