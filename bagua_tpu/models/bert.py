@@ -1,0 +1,168 @@
+"""BERT encoder family (BERT-Large is the reference's second headline
+benchmark: 128-GPU finetune, ``README.md:50-53``).
+
+TPU-native flax implementation with composable parallelism:
+
+* **TP**: attention QKV is column-parallel (heads sharded over ``tp``), the
+  output projection row-parallel; the FFN is a Column→Row pair — two forward
+  allreduces per layer, Megatron-style.
+* **SP (long context)**: the sequence dimension is sharded over ``sp`` and
+  attention runs as ring attention (``bagua_tpu.parallel.ring_attention``);
+  position embeddings are offset by the rank's global block start.
+* **DP**: comes from the engine (batch sharded over the group axes).
+
+``tp_size`` is static so parameter shapes are rank-local; axes are checked
+at apply time.
+"""
+
+import dataclasses
+from typing import Any, Optional, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from bagua_tpu.parallel.ring_attention import ring_attention, _block_attention_local
+from bagua_tpu.parallel.tensor_parallel import (
+    ColumnParallelDense,
+    ParallelMLP,
+    RowParallelDense,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    # parallelism
+    tp_size: int = 1
+    tp_axis: Union[str, Tuple[str, ...]] = "tp"
+    sp_axis: Union[str, Tuple[str, ...], None] = None  # ring attention when set
+    compute_dtype: Any = jnp.float32
+
+
+def bert_large_config(**overrides) -> BertConfig:
+    return BertConfig(**overrides)
+
+
+def bert_base_config(**overrides) -> BertConfig:
+    return BertConfig(
+        hidden_size=768, num_layers=12, num_heads=12, intermediate_size=3072, **overrides
+    )
+
+
+def _sp_offset(cfg: BertConfig, t_local: int):
+    """Global position offset of this rank's sequence block under SP."""
+    if cfg.sp_axis is None:
+        return 0
+    try:
+        from bagua_tpu.communication import rank_id
+
+        return rank_id(
+            (cfg.sp_axis,) if isinstance(cfg.sp_axis, str) else cfg.sp_axis
+        ) * t_local
+    except NameError:
+        return 0
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.cfg
+        b, t, _ = x.shape
+        if cfg.num_heads % cfg.tp_size != 0:
+            raise ValueError("num_heads must divide by tp_size")
+        local_heads = cfg.num_heads // cfg.tp_size
+        head_dim = cfg.hidden_size // cfg.num_heads
+
+        qkv = ColumnParallelDense(
+            3 * cfg.hidden_size, cfg.tp_size, cfg.tp_axis, dtype=cfg.compute_dtype,
+            name="qkv",
+        )(x)
+        qkv = qkv.reshape(b, t, 3, local_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        if cfg.sp_axis is not None:
+            ctx = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=False)
+        else:
+            ctx = _block_attention_local(q, k, v, causal=False)
+        ctx = ctx.reshape(b, t, local_heads * head_dim)
+        return RowParallelDense(
+            cfg.hidden_size, cfg.tp_size, cfg.tp_axis, dtype=cfg.compute_dtype,
+            name="out",
+        )(ctx)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.cfg
+        attn = BertSelfAttention(cfg, name="attention")(x, mask)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_attn")(x + attn)
+        ffn = ParallelMLP(
+            cfg.intermediate_size, cfg.hidden_size, cfg.tp_size, cfg.tp_axis,
+            dtype=cfg.compute_dtype, name="mlp",
+        )(x)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_ffn")(x + ffn)
+
+
+class BertModel(nn.Module):
+    """Encoder producing final hidden states ``(B, T_local, H)``."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None):
+        cfg = self.cfg
+        b, t = input_ids.shape
+        word = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="word_embeddings")(input_ids)
+        pos_ids = jnp.arange(t)[None, :] + _sp_offset(cfg, t)
+        pos = nn.Embed(
+            cfg.max_position_embeddings, cfg.hidden_size, name="position_embeddings"
+        )(pos_ids)
+        x = word + pos
+        if token_type_ids is not None:
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, name="token_type_embeddings")(
+                token_type_ids
+            )
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_embed")(x)
+        x = x.astype(cfg.compute_dtype)
+        for i in range(cfg.num_layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x)
+        return x.astype(jnp.float32)
+
+
+class BertForPreTraining(nn.Module):
+    """Encoder + MLM head (untied decoder)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None):
+        h = BertModel(self.cfg, name="bert")(input_ids, token_type_ids)
+        h = nn.Dense(self.cfg.hidden_size, name="mlm_transform")(h)
+        h = jax.nn.gelu(h)
+        h = nn.LayerNorm(epsilon=self.cfg.layer_norm_eps, name="mlm_ln")(h)
+        return nn.Dense(self.cfg.vocab_size, name="mlm_decoder")(h)
+
+
+def mlm_loss_fn(model: BertForPreTraining):
+    """Masked-LM cross entropy over all positions (synthetic-benchmark style)."""
+
+    def loss_fn(params, batch):
+        input_ids, labels = batch
+        logits = model.apply({"params": params}, input_ids)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+
+    return loss_fn

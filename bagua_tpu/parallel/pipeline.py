@@ -1,0 +1,106 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over a ``pp`` axis.
+
+Not in the reference (SURVEY §2.4: PP "no") — provided as a first-class mesh
+capability.  SPMD formulation: every rank holds ONE stage's parameters
+(stages must share a structure, e.g. uniform transformer blocks).  Time is
+``T = n_stages + n_microbatches - 1`` ticks; at tick ``t`` stage ``s`` is
+active for microbatch ``m = t - s``.  Activations hop to the next stage with
+a single neighbor ``ppermute`` per tick, so in-flight memory per chip is one
+microbatch and the wire pattern is the classic pipeline bubble.
+
+Because the whole schedule is one traced ``fori_loop``, ``jax.grad``
+differentiates straight through it — the backward pipeline (reverse
+``ppermute``s) falls out of autodiff instead of hand-written scheduling.
+"""
+
+from typing import Callable, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    microbatches: jnp.ndarray,
+    axis_name: Union[str, Tuple[str, ...]] = "pp",
+):
+    """Run ``microbatches`` through the pipeline.
+
+    Args:
+        stage_fn: ``stage_fn(stage_params, x) -> y``; both ``x`` and ``y``
+            must have the microbatch shape (stage widths must agree).
+        stage_params: THIS rank's stage parameters.
+        microbatches: ``(n_microbatches, mb, ...)``, consumed by stage 0
+            (other ranks ignore the values but must pass the same shape).
+        axis_name: the pipeline mesh axis.
+
+    Returns:
+        ``(n_microbatches, mb, ...)`` outputs of the LAST stage, broadcast to
+        every pp rank (so the loss can be computed anywhere).
+    """
+    from bagua_tpu.communication import broadcast_inplace, ppermute_shift, rank_id
+
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    try:
+        n_stages = 1
+        for a in axes:
+            n_stages *= jax.lax.axis_size(a)
+    except NameError:
+        n_stages = 1
+    n_micro = microbatches.shape[0]
+    if n_stages == 1:
+        return jax.vmap(lambda x: stage_fn(stage_params, x))(microbatches)
+
+    my = rank_id(axes)
+    ticks = n_stages + n_micro - 1
+    mb_shape = microbatches.shape[1:]
+
+    def tick(t, carry):
+        outbuf, collected = carry
+        # activation from the previous stage (computed last tick)
+        recv = ppermute_shift(outbuf, 1, axes)
+        m = t - my  # microbatch index this stage works on now
+        active = (m >= 0) & (m < n_micro)
+        m_clipped = jnp.clip(m, 0, n_micro - 1)
+        x_first = jax.lax.dynamic_index_in_dim(
+            microbatches, m_clipped, axis=0, keepdims=False
+        )
+        x_in = jnp.where(my == 0, x_first, recv)
+        y = stage_fn(stage_params, x_in)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        is_last = my == n_stages - 1
+        collected = jax.lax.cond(
+            active & is_last,
+            lambda c: jax.lax.dynamic_update_index_in_dim(c, y, m_clipped, axis=0),
+            lambda c: c,
+            collected,
+        )
+        return y, collected
+
+    out0 = jnp.zeros(mb_shape, microbatches.dtype)
+    collected0 = jnp.zeros((n_micro,) + mb_shape, microbatches.dtype)
+    _, collected = jax.lax.fori_loop(0, ticks, tick, (out0, collected0))
+    # Ship the last stage's outputs to every pp rank.  Every rank then
+    # computes an IDENTICAL loss on them (the natural SPMD usage); since the
+    # broadcast's psum-transpose would sum those replicated cotangents,
+    # scale the backward by 1/n_stages so gradients match the sequential
+    # program exactly.
+    out = broadcast_inplace(collected, src_rank=n_stages - 1, axis=axes)
+    return _scale_grad(out, 1.0 / n_stages)
+
+
+@jax.custom_vjp
+def _scale_grad(x, scale):
+    return x
+
+
+def _scale_grad_fwd(x, scale):
+    return x, scale
+
+
+def _scale_grad_bwd(scale, g):
+    return g * scale, None
+
+
+_scale_grad.defvjp(_scale_grad_fwd, _scale_grad_bwd)

@@ -1,0 +1,108 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Long-context support absent from the reference (SURVEY §5.7) but first-class
+here: the sequence is sharded over the ``sp`` axis, and attention runs
+blockwise — each rank computes attention of its local queries against one
+K/V block at a time while the K/V blocks rotate around the ring via
+``lax.ppermute`` (one neighbor send/recv per step, so the memory per chip is
+O(T/sp) and the collective traffic rides ICI neighbor links).
+
+Numerics use the online-softmax (flash-attention style) accumulation:
+running max ``m``, running normalizer ``l``, running output ``o``; each block
+contributes exactly once, so the result equals full attention on the
+gathered sequence up to float roundoff.
+"""
+
+from functools import partial
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def _axis_and_size(axis_name):
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    bound = []
+    n = 1
+    for a in axes:
+        try:
+            n *= jax.lax.axis_size(a)
+            bound.append(a)
+        except NameError:
+            pass  # axis not bound here (single-device / outside shard_map)
+    return tuple(bound), n
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: Union[str, Tuple[str, ...]] = "sp",
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Blockwise ring attention.
+
+    Args:
+        q, k, v: local blocks, shape ``(batch, t_local, heads, head_dim)``.
+            The global sequence is the concatenation of blocks in rank order.
+        axis_name: the sequence-parallel mesh axis.
+        causal: apply a causal mask over *global* positions.
+
+    Returns:
+        Attention output for the local queries, same shape as ``q``.
+    """
+    axes, sp = _axis_and_size(axis_name)
+    if sp == 1:
+        return _block_attention_local(q, k, v, causal=causal)
+
+    from bagua_tpu.communication import ppermute_shift, rank_id
+
+    my = rank_id(axes)
+    b, t, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    qf = (q * scale).astype(jnp.float32)
+
+    def body(i, carry):
+        o, l, m, k_blk, v_blk = carry
+        # block currently held came from rank (my - i) mod sp
+        src = (my - i) % sp
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            q_pos = my * t + jnp.arange(t)
+            k_pos = src * t + jnp.arange(t)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # guard fully-masked rows: exp(-inf - -inf) -> use safe max
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        corr = jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m) - m_safe)
+        corr = jnp.where(jnp.isneginf(m), 0.0, corr)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        k_next = ppermute_shift(k_blk, 1, axes)
+        v_next = ppermute_shift(v_blk, 1, axes)
+        return o_new, l_new, m_new, k_next, v_next
+
+    o0 = jnp.zeros((b, h, t, d), jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    o, l, m, _, _ = jax.lax.fori_loop(0, sp, body, (o0, l0, m0, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (o / l[..., None]).astype(q.dtype)
+    return jnp.transpose(out, (0, 2, 1, 3))  # (b, t, h, d)
+
+
+def _block_attention_local(q, k, v, causal=False):
+    b, t, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if causal:
+        mask = jnp.arange(t)[:, None] >= jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,107 @@
+"""Tensor parallelism: column/row-parallel layers over a mesh axis.
+
+Not present in the reference (SURVEY §2.4 marks TP "no") but a natural
+extension the mesh substrate gives nearly for free: a ``tp`` axis shards the
+hidden dimension.  Megatron-style pairing:
+
+* :class:`ColumnParallelDense` — weight columns sharded; local output is this
+  rank's slice of the features (no collective on the forward path).
+* :class:`RowParallelDense` — weight rows sharded; consumes the sliced
+  features and ``psum``s the partial products over the ``tp`` axis.
+
+A Column→(nonlinearity)→Row pair therefore costs exactly one allreduce
+forward (and one for the gradient of the input, which ``psum``'s transpose
+rule inserts automatically under autodiff).
+
+``tp_size`` is static (it fixes parameter shapes so ``init`` can run outside
+``shard_map``); the bound axis is checked at apply time.
+"""
+
+from typing import Any, Optional, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _check_axis(tp_size: int, axis_name, initializing: bool):
+    if tp_size == 1 or initializing:
+        return
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    n = 1
+    for a in axes:
+        n *= jax.lax.axis_size(a)
+    if n != tp_size:
+        raise ValueError(f"tp_size={tp_size} but bound axes {axes} have size {n}")
+
+
+class ColumnParallelDense(nn.Module):
+    """y_local = x @ W[:, rank-slice] (+ b slice).  Output dim is
+    ``features // tp_size`` per rank."""
+
+    features: int
+    tp_size: int = 1
+    axis_name: Union[str, Tuple[str, ...]] = "tp"
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if self.features % self.tp_size != 0:
+            raise ValueError(
+                f"features ({self.features}) must divide by tp_size ({self.tp_size})"
+            )
+        _check_axis(self.tp_size, self.axis_name, self.is_initializing())
+        local = self.features // self.tp_size
+        w = self.param(
+            "kernel", nn.initializers.lecun_normal(), (x.shape[-1], local), self.dtype
+        )
+        y = x.astype(self.dtype) @ w
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros, (local,), self.dtype)
+        return y
+
+
+class RowParallelDense(nn.Module):
+    """y = psum_tp(x_local @ W[rank-slice, :]) (+ b).  Input dim is the
+    sliced hidden; output is replicated across the ``tp`` axis."""
+
+    features: int
+    tp_size: int = 1
+    axis_name: Union[str, Tuple[str, ...]] = "tp"
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        _check_axis(self.tp_size, self.axis_name, self.is_initializing())
+        w = self.param(
+            "kernel", nn.initializers.lecun_normal(), (x.shape[-1], self.features), self.dtype
+        )
+        y = x.astype(self.dtype) @ w
+        if self.tp_size > 1 and not self.is_initializing():
+            y = jax.lax.psum(y, self.axis_name)
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros, (self.features,), self.dtype)
+        return y
+
+
+class ParallelMLP(nn.Module):
+    """Column→activation→Row FFN: one forward allreduce total."""
+
+    hidden_features: int
+    out_features: int
+    tp_size: int = 1
+    axis_name: Union[str, Tuple[str, ...]] = "tp"
+    activation: str = "gelu"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = ColumnParallelDense(
+            self.hidden_features, self.tp_size, self.axis_name, dtype=self.dtype
+        )(x)
+        h = getattr(jax.nn, self.activation)(h)
+        return RowParallelDense(
+            self.out_features, self.tp_size, self.axis_name, dtype=self.dtype
+        )(h)

@@ -1,0 +1,199 @@
+"""TP layers, ring attention, and the parallel BERT model.
+
+Oracles: single-device full computation on the gathered inputs/weights.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bagua_tpu.parallel.ring_attention import ring_attention, _block_attention_local
+from bagua_tpu.parallel.tensor_parallel import (
+    ColumnParallelDense,
+    ParallelMLP,
+    RowParallelDense,
+)
+
+B, T, H, D = 2, 4, 4, 8  # batch, local seq, heads, head_dim
+SP = 8
+
+
+def sp_mesh(n=8, axis="sp"):
+    devs = jax.devices()[:n]
+    return Mesh(np.array(devs), (axis,))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    rng = np.random.RandomState(0)
+    q = rng.randn(B, SP * T, H, D).astype(np.float32)
+    k = rng.randn(B, SP * T, H, D).astype(np.float32)
+    v = rng.randn(B, SP * T, H, D).astype(np.float32)
+
+    full = np.asarray(
+        _block_attention_local(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal)
+    )
+
+    mesh = sp_mesh()
+    fn = jax.jit(
+        jax.shard_map(
+            lambda qq, kk, vv: ring_attention(qq, kk, vv, axis_name="sp", causal=causal),
+            mesh=mesh,
+            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )
+    got = np.asarray(fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(got, full, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_single_rank_fallback():
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    out = ring_attention(q, q, q, axis_name="sp")  # no bound axis -> local
+    ref = _block_attention_local(q, q, q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_column_row_parallel_matches_dense():
+    """Column->gelu->Row over a 4-way tp axis == single-device dense MLP."""
+    tp = 4
+    rng = np.random.RandomState(2)
+    x = rng.randn(6, 16).astype(np.float32)
+
+    mlp = ParallelMLP(hidden_features=32, out_features=16, tp_size=tp, axis_name="tp")
+    params = mlp.init(jax.random.PRNGKey(0), jnp.asarray(x))["params"]
+
+    # oracle: assemble the full weight matrices from per-rank slices.
+    # Per-rank params are identical after init (shapes are local); emulate
+    # rank r holding columns [r*local:(r+1)*local] by initializing per rank.
+    per_rank = [
+        mlp.init(jax.random.PRNGKey(r), jnp.asarray(x))["params"] for r in range(tp)
+    ]
+    w1 = np.concatenate(
+        [np.asarray(p["ColumnParallelDense_0"]["kernel"]) for p in per_rank], axis=1
+    )
+    b1 = np.concatenate(
+        [np.asarray(p["ColumnParallelDense_0"]["bias"]) for p in per_rank]
+    )
+    w2 = np.concatenate(
+        [np.asarray(p["RowParallelDense_0"]["kernel"]) for p in per_rank], axis=0
+    )
+    b2 = sum(np.asarray(p["RowParallelDense_0"]["bias"]) for p in per_rank)
+
+    expect = jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_rank)
+    fn = jax.jit(
+        jax.shard_map(
+            lambda p, xx: mlp.apply({"params": jax.tree.map(lambda q: q[0], p)}, xx),
+            mesh=mesh,
+            in_specs=(P("tp"), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    got = np.asarray(fn(stacked, jnp.asarray(x)))
+    np.testing.assert_allclose(got, np.asarray(expect), rtol=2e-3, atol=2e-4)
+
+
+def test_tp_axis_mismatch_raises():
+    mlp = ParallelMLP(hidden_features=32, out_features=16, tp_size=4, axis_name="tp")
+    x = jnp.zeros((2, 16))
+    params = mlp.init(jax.random.PRNGKey(0), x)["params"]
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    with pytest.raises(ValueError, match="tp_size=4"):
+        jax.jit(
+            jax.shard_map(
+                lambda xx: mlp.apply({"params": params}, xx),
+                mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False,
+            )
+        )(x)
+
+
+def test_bert_forward_shapes_and_parallel_consistency(group):
+    """BERT with tp=2 x sp=2 on a 2x2 submesh matches the single-device
+    model with assembled weights — end-to-end integration of TP + SP."""
+    from bagua_tpu.models.bert import BertConfig, BertModel
+
+    vocab, hidden, heads, layers = 64, 16, 4, 2
+    seq = 8
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, vocab, size=(2, seq)).astype(np.int32)
+
+    # single-device reference
+    cfg0 = BertConfig(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers, num_heads=heads,
+        intermediate_size=32, max_position_embeddings=seq,
+    )
+    model0 = BertModel(cfg0)
+    params0 = model0.init(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
+    ref = np.asarray(model0.apply({"params": params0}, jnp.asarray(ids)))
+
+    # tp=2, sp=2 model: slice params0 into per-(tp,sp)-rank shards
+    tp, sp = 2, 2
+    cfg = BertConfig(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers, num_heads=heads,
+        intermediate_size=32, max_position_embeddings=seq, tp_size=tp, tp_axis="tp",
+        sp_axis="sp",
+    )
+    model = BertModel(cfg)
+
+    def shard_for_tp(r):
+        """Take tp-rank r's slice of every TP param; heads are contiguous."""
+
+        def slice_leaf(path, leaf):
+            name = jax.tree_util.keystr(path)
+            arr = np.asarray(leaf)
+            if "qkv" in name:
+                if name.endswith("['kernel']"):
+                    # (in, 3*hidden) -> 3 x heads x head_dim; take local heads
+                    k3 = arr.reshape(arr.shape[0], 3, heads, hidden // heads)
+                    loc = k3[:, :, r * (heads // tp) : (r + 1) * (heads // tp)]
+                    return jnp.asarray(loc.reshape(arr.shape[0], -1))
+                loc = arr.reshape(3, heads, hidden // heads)[
+                    :, r * (heads // tp) : (r + 1) * (heads // tp)
+                ]
+                return jnp.asarray(loc.reshape(-1))
+            if "['out']['kernel']" in name:
+                rows = arr.shape[0] // tp
+                return jnp.asarray(arr[r * rows : (r + 1) * rows])
+            if "['out']['bias']" in name:
+                return jnp.asarray(arr / tp)  # bias added once per rank then psum'd? no:
+            if "ColumnParallelDense_0" in name:
+                cols = arr.shape[-1] // tp
+                return jnp.asarray(arr[..., r * cols : (r + 1) * cols])
+            if "RowParallelDense_0" in name and name.endswith("['kernel']"):
+                rows = arr.shape[0] // tp
+                return jnp.asarray(arr[r * rows : (r + 1) * rows])
+            if "RowParallelDense_0" in name and name.endswith("['bias']"):
+                return jnp.asarray(arr)
+            return jnp.asarray(arr)
+
+        return jax.tree_util.tree_map_with_path(slice_leaf, params0)
+
+    # RowParallel bias: added AFTER psum once per rank... our RowParallelDense
+    # adds the bias after the psum on every rank -> replicated, correct as-is.
+    per_tp = [shard_for_tp(r) for r in range(tp)]
+    # build (tp*sp) rank-stacked params: same tp shard for both sp ranks
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *[per_tp[r] for r in (0, 1) for _ in range(sp)]
+    )
+
+    devs = np.array(jax.devices()[:4]).reshape(tp, sp)
+    mesh = Mesh(devs, ("tp", "sp"))
+    fn = jax.jit(
+        jax.shard_map(
+            lambda p, ii: model.apply({"params": jax.tree.map(lambda q: q[0], p)}, ii),
+            mesh=mesh,
+            in_specs=(P(("tp", "sp")), P(None, "sp")),
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )
+    got = np.asarray(fn(stacked, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref, rtol=5e-3, atol=5e-3)
