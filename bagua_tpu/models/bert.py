@@ -91,9 +91,9 @@ class BertSelfAttention(nn.Module):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
         if cfg.sp_axis is not None:
-            ctx = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=False)
+            ctx = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=False, kv_mask=mask)
         else:
-            ctx = _block_attention_local(q, k, v, causal=False)
+            ctx = _block_attention_local(q, k, v, causal=False, kv_mask=mask)
         ctx = ctx.reshape(b, t, local_heads * head_dim)
         return RowParallelDense(
             cfg.hidden_size, cfg.tp_size, cfg.tp_axis, dtype=cfg.compute_dtype,
@@ -122,7 +122,7 @@ class BertModel(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, input_ids, token_type_ids=None):
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
         cfg = self.cfg
         b, t = input_ids.shape
         word = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="word_embeddings")(input_ids)
@@ -138,7 +138,7 @@ class BertModel(nn.Module):
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_embed")(x)
         x = x.astype(cfg.compute_dtype)
         for i in range(cfg.num_layers):
-            x = BertLayer(cfg, name=f"layer_{i}")(x)
+            x = BertLayer(cfg, name=f"layer_{i}")(x, attention_mask)
         return x.astype(jnp.float32)
 
 

@@ -39,6 +39,7 @@ def ring_attention(
     v: jnp.ndarray,
     axis_name: Union[str, Tuple[str, ...]] = "sp",
     causal: bool = False,
+    kv_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Blockwise ring attention.
 
@@ -47,13 +48,16 @@ def ring_attention(
             The global sequence is the concatenation of blocks in rank order.
         axis_name: the sequence-parallel mesh axis.
         causal: apply a causal mask over *global* positions.
+        kv_mask: optional key-padding mask for the LOCAL block, shape
+            ``(batch, t_local)``; True = attend.  It rotates around the ring
+            together with its K/V block.
 
     Returns:
         Attention output for the local queries, same shape as ``q``.
     """
     axes, sp = _axis_and_size(axis_name)
     if sp == 1:
-        return _block_attention_local(q, k, v, causal=causal)
+        return _block_attention_local(q, k, v, causal=causal, kv_mask=kv_mask)
 
     from bagua_tpu.communication import ppermute_shift, rank_id
 
@@ -61,12 +65,15 @@ def ring_attention(
     b, t, h, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
     qf = (q * scale).astype(jnp.float32)
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, t), bool)
 
     def body(i, carry):
-        o, l, m, k_blk, v_blk = carry
+        o, l, m, k_blk, v_blk, mask_blk = carry
         # block currently held came from rank (my - i) mod sp
         src = (my - i) % sp
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        s = jnp.where(mask_blk[:, None, None, :], s, -jnp.inf)
         if causal:
             q_pos = my * t + jnp.arange(t)
             k_pos = src * t + jnp.arange(t)
@@ -85,21 +92,24 @@ def ring_attention(
         )
         k_next = ppermute_shift(k_blk, 1, axes)
         v_next = ppermute_shift(v_blk, 1, axes)
-        return o_new, l_new, m_new, k_next, v_next
+        mask_next = ppermute_shift(mask_blk, 1, axes)
+        return o_new, l_new, m_new, k_next, v_next, mask_next
 
     o0 = jnp.zeros((b, h, t, d), jnp.float32)
     l0 = jnp.zeros((b, h, t), jnp.float32)
     m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
-    o, l, m, _, _ = jax.lax.fori_loop(0, sp, body, (o0, l0, m0, k, v))
+    o, l, m, _, _, _ = jax.lax.fori_loop(0, sp, body, (o0, l0, m0, k, v, kv_mask))
     l = jnp.where(l == 0.0, 1.0, l)
     out = (o / l[..., None]).astype(q.dtype)
     return jnp.transpose(out, (0, 2, 1, 3))  # (b, t, h, d)
 
 
-def _block_attention_local(q, k, v, causal=False):
+def _block_attention_local(q, k, v, causal=False, kv_mask=None):
     b, t, h, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, -jnp.inf)
     if causal:
         mask = jnp.arange(t)[:, None] >= jnp.arange(k.shape[1])[None, :]
         s = jnp.where(mask[None, None], s, -jnp.inf)

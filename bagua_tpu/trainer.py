@@ -1,0 +1,101 @@
+"""High-level training loop convenience (the Lightning-``BaguaStrategy``
+analog — the reference integrates via pytorch-lightning, tested at
+``tests/pytorch_lightning/test_bagua_strategy.py``; here the equivalent
+one-stop entry is a small Trainer that wires the DDP engine, autotune,
+watchdog, speed metrics and checkpointing together)."""
+
+import logging
+import os
+from typing import Callable, Iterable, Optional
+
+import jax
+
+from bagua_tpu.algorithms.base import Algorithm
+from bagua_tpu.ddp import AutotuneSession, DistributedDataParallel
+from bagua_tpu.observability import StepTimer, Watchdog
+
+logger = logging.getLogger(__name__)
+
+
+class Trainer:
+    """Minimal fit loop.
+
+    Args:
+        loss_fn, optimizer, algorithm, process_group: as for
+            :class:`~bagua_tpu.ddp.DistributedDataParallel`.
+        ckpt_dir: if set, checkpoints every ``ckpt_interval`` steps and
+            auto-resumes from the latest checkpoint on startup.
+        autotune_model_name: if set (and the autotune service is reachable),
+            runs the report/ask/re-bucket cycle.
+        watchdog_timeout_s: hang detector (0 disables).
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable,
+        optimizer,
+        algorithm: Algorithm,
+        process_group=None,
+        ckpt_dir: Optional[str] = None,
+        ckpt_interval: int = 1000,
+        autotune_model_name: Optional[str] = None,
+        watchdog_timeout_s: float = 300.0,
+        dp_filter=None,
+    ):
+        self.ddp = DistributedDataParallel(
+            loss_fn, optimizer, algorithm, process_group=process_group, dp_filter=dp_filter
+        )
+        self.ckpt_dir = ckpt_dir
+        self.ckpt_interval = ckpt_interval
+        self.autotune_model_name = autotune_model_name
+        self.timer = StepTimer(speed_meter=self.ddp.speed_meter)
+        self.watchdog = (
+            Watchdog(watchdog_timeout_s).start() if watchdog_timeout_s > 0 else None
+        )
+        self._session: Optional[AutotuneSession] = None
+
+    def init_state(self, params=None, stacked_params=None):
+        state = self.ddp.init(params, stacked_params=stacked_params)
+        if self.ckpt_dir:
+            from bagua_tpu.checkpoint import get_latest_iteration, load_checkpoint
+
+            it = get_latest_iteration(self.ckpt_dir)
+            if it is not None:
+                state, it = load_checkpoint(self.ckpt_dir, target=state)
+                logger.info("resumed from checkpoint at iteration %d", it)
+        if self.autotune_model_name:
+            try:
+                self._session = AutotuneSession(self.ddp, self.autotune_model_name)
+            except Exception as e:  # service not reachable: train without tuning
+                logger.warning("autotune disabled: %s", e)
+        return state
+
+    def fit(self, state, batches: Iterable, n_steps: Optional[int] = None, log_every: int = 100):
+        """Run the training loop; returns the final state."""
+        losses = None
+        for i, batch in enumerate(batches):
+            if n_steps is not None and i >= n_steps:
+                break
+            n_samples = jax.tree.leaves(batch)[0].shape[0]
+            with self.timer.step(n_samples):
+                state, losses = self.ddp.train_step(state, batch)
+            if self.watchdog:
+                self.watchdog.beat()
+            if self._session:
+                self._session.tick(n_samples)
+            step = int(state.step[0])
+            if self.ckpt_dir and step % self.ckpt_interval == 0:
+                from bagua_tpu.checkpoint import save_checkpoint
+
+                save_checkpoint(step, self.ckpt_dir, state)
+            if log_every and step % log_every == 0:
+                jax.block_until_ready(losses)
+                logger.info(
+                    "step %d loss %.5f (%.1f samples/s)",
+                    step,
+                    float(losses.mean()),
+                    self.ddp.speed_meter.speed(30.0),
+                )
+        if losses is not None:
+            jax.block_until_ready(losses)
+        return state

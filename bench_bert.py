@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""Secondary benchmark: BERT-Large MLM training throughput per chip
+(the reference's second headline workload, ``README.md:50-53``; ByteGrad
+config from BASELINE.json).  Prints ONE JSON line like bench.py."""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def main():
+    import bagua_tpu
+    from bagua_tpu.algorithms import Algorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.models.bert import BertForPreTraining, bert_large_config, mlm_loss_fn
+
+    group = bagua_tpu.init_process_group()
+    n = group.size
+    seq, per_chip_batch = 128, 32
+
+    cfg = bert_large_config(compute_dtype=jnp.bfloat16, max_position_embeddings=seq)
+    model = BertForPreTraining(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, seq), jnp.int32))["params"]
+    ddp = DistributedDataParallel(
+        mlm_loss_fn(model), optax.sgd(1e-3), Algorithm.init("bytegrad"), process_group=group
+    )
+    state = ddp.init(params)
+
+    rng = np.random.RandomState(0)
+    bs = per_chip_batch * n
+    x = jnp.asarray(rng.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
+    y = jnp.asarray(rng.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
+
+    for _ in range(3):
+        state, losses = ddp.train_step(state, (x, y))
+    jax.block_until_ready(losses)
+
+    n_iters = 15
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        state, losses = ddp.train_step(state, (x, y))
+    jax.block_until_ready(losses)
+    elapsed = time.perf_counter() - t0
+
+    sps = bs * n_iters / elapsed / n
+    print(
+        json.dumps(
+            {
+                "metric": "bert_large_mlm_samples_per_sec_per_chip",
+                "value": round(sps, 2),
+                "unit": "samples/s/chip",
+                "vs_baseline": None,
+                "config": "seq128 batch32/chip bytegrad bf16",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

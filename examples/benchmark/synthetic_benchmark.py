@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Synthetic throughput benchmark (analog of the reference's
+``examples/benchmark/synthetic_benchmark.py``, the workload behind the CI
+thresholds in ``benchmark_master.sh``).
+
+    python examples/benchmark/synthetic_benchmark.py --model vgg16 \
+        --algorithm gradient_allreduce --num-iters 30
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import bagua_tpu
+from bagua_tpu.algorithms import Algorithm, QAdamOptimizer
+from bagua_tpu.ddp import DistributedDataParallel
+
+
+def build(model_name: str, dtype):
+    if model_name == "vgg16":
+        from bagua_tpu.models.vgg import init_vgg16, vgg_loss_fn
+
+        model, params = init_vgg16(jax.random.PRNGKey(0), 224, 1000, compute_dtype=dtype)
+        def batch_fn(rng, bs):
+            return (
+                jnp.asarray(rng.rand(bs, 224, 224, 3).astype(np.float32)),
+                jnp.asarray(rng.randint(0, 1000, (bs,)).astype(np.int32)),
+            )
+        return vgg_loss_fn(model), params, batch_fn
+    if model_name == "bert-large":
+        from bagua_tpu.models.bert import BertForPreTraining, bert_large_config, mlm_loss_fn
+
+        cfg = bert_large_config(compute_dtype=dtype, max_position_embeddings=128)
+        model = BertForPreTraining(cfg)
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 128), jnp.int32))["params"]
+        def batch_fn(rng, bs):
+            return (
+                jnp.asarray(rng.randint(0, cfg.vocab_size, (bs, 128)).astype(np.int32)),
+                jnp.asarray(rng.randint(0, cfg.vocab_size, (bs, 128)).astype(np.int32)),
+            )
+        return mlm_loss_fn(model), params, batch_fn
+    raise ValueError(model_name)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="vgg16", choices=["vgg16", "bert-large"])
+    p.add_argument("--algorithm", default="gradient_allreduce")
+    p.add_argument("--batch-size", type=int, default=32, help="per chip")
+    p.add_argument("--num-iters", type=int, default=30)
+    p.add_argument("--num-warmup", type=int, default=3)
+    p.add_argument("--fp32", action="store_true")
+    args = p.parse_args()
+
+    group = bagua_tpu.init_process_group()
+    dtype = jnp.float32 if args.fp32 else jnp.bfloat16
+    loss_fn, params, batch_fn = build(args.model, dtype)
+
+    if args.algorithm == "qadam":
+        algo = Algorithm.init("qadam", q_adam_optimizer=QAdamOptimizer(lr=1e-3, warmup_steps=10))
+        opt = None
+    else:
+        algo = Algorithm.init(args.algorithm)
+        opt = optax.sgd(0.01, momentum=0.9)
+
+    ddp = DistributedDataParallel(loss_fn, opt, algo, process_group=group)
+    state = ddp.init(params)
+    rng = np.random.RandomState(0)
+    batch = batch_fn(rng, args.batch_size * group.size)
+
+    for _ in range(args.num_warmup):
+        state, losses = ddp.train_step(state, batch)
+    jax.block_until_ready(losses)
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        state, losses = ddp.train_step(state, batch)
+    jax.block_until_ready(losses)
+    dt = time.perf_counter() - t0
+
+    sps = args.batch_size * group.size * args.num_iters / dt / group.size
+    print(
+        f"model={args.model} algorithm={args.algorithm} "
+        f"batch={args.batch_size}/chip chips={group.size}: "
+        f"{sps:.1f} samples/sec/chip, final loss {float(losses.mean()):.6f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

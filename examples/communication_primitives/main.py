@@ -1,0 +1,32 @@
+#!/usr/bin/env python3
+"""Exercise every eager collective (analog of the reference's
+``examples/communication_primitives/main.py``, the 2-node CI smoke test)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import bagua_tpu
+from bagua_tpu import ReduceOp
+
+
+def main():
+    group = bagua_tpu.init_process_group()
+    n = group.size
+    x = jnp.asarray(np.arange(n * 8, dtype=np.float32).reshape(n, 8))
+
+    print("group:", group)
+    print("allreduce SUM :", np.asarray(bagua_tpu.allreduce(x, op=ReduceOp.SUM))[0][:4])
+    print("allreduce AVG :", np.asarray(bagua_tpu.allreduce(x, op=ReduceOp.AVG))[0][:4])
+    print("allgather     :", bagua_tpu.allgather(x).shape)
+    print("reducescatter :", bagua_tpu.reducescatter(x).shape)
+    print("broadcast     :", np.asarray(bagua_tpu.broadcast(x, src=0))[-1][:4])
+    print("alltoall      :", bagua_tpu.alltoall(x).shape)
+    print("reduce(dst=0) :", np.asarray(bagua_tpu.reduce(x, dst=0))[0][:4])
+    print("scatter(src=0):", bagua_tpu.scatter(x, src=0).shape)
+    print("gather(dst=0) :", bagua_tpu.gather(x, dst=0).shape)
+    bagua_tpu.barrier()
+    print("barrier OK")
+
+
+if __name__ == "__main__":
+    main()
