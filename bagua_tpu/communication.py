@@ -237,6 +237,33 @@ def alltoall_inplace(x: jnp.ndarray, axis=None) -> jnp.ndarray:
     return jax.lax.all_to_all(x, axes, split_axis=0, concat_axis=0, tiled=True)
 
 
+def alltoall_v_inplace(x: jnp.ndarray, send_counts: jnp.ndarray, axis=None):
+    """Variable-count all-to-all (reference ``alltoall_v``,
+    ``communication.py:1263``), in the static-shape idiom XLA requires.
+
+    Args:
+        x: ``(n, capacity, ...)`` — chunk j (padded to ``capacity``) goes to
+           rank j; only the first ``send_counts[j]`` rows of chunk j are
+           meaningful.
+        send_counts: ``(n,)`` int array — may differ per rank (it is data,
+           not shape).
+
+    Returns:
+        ``(recv, recv_counts)``: ``recv[j]`` is the (padded) chunk received
+        from rank j, valid up to ``recv_counts[j]`` rows.
+    """
+    axes = _axes(axis)
+    n = axis_size(axes)
+    if x.shape[0] != n:
+        raise ValueError(f"leading dim {x.shape[0]} != group size {n}")
+    recv = jax.lax.all_to_all(x, axes, split_axis=0, concat_axis=0, tiled=True)
+    recv = recv.reshape((n,) + x.shape[1:])
+    recv_counts = jax.lax.all_to_all(
+        send_counts.reshape(n, 1), axes, split_axis=0, concat_axis=0, tiled=True
+    ).reshape(n)
+    return recv, recv_counts
+
+
 def ppermute_apply(x: jnp.ndarray, perm, axis=None) -> jnp.ndarray:
     """Apply an explicit (src, dst) permutation over the (possibly combined)
     group axes.  Single axis lowers to ``lax.ppermute``; combined axes fall

@@ -1,0 +1,33 @@
+"""Autograd-integrated collectives (reference ``data_parallel/functional.py``).
+
+The reference wraps ``all_reduce`` in a ``torch.autograd.Function`` whose
+backward is another all_reduce (``functional.py:56-79``).  Under JAX every
+collective primitive already has a transpose rule — ``psum``'s gradient is
+``psum`` — so the differentiable form is the collective itself.  These
+wrappers exist for API parity and for documentation: they are safe inside
+``jax.grad``.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bagua_tpu.communication import (
+    BaguaProcessGroup,
+    ReduceOp,
+    allreduce,
+    allreduce_inplace,
+)
+
+
+def all_reduce(tensor, op: ReduceOp = ReduceOp.AVG, group: Optional[BaguaProcessGroup] = None):
+    """Differentiable eager all_reduce over stacked per-rank arrays: the
+    gradient of the output w.r.t. each rank's input is the same reduction of
+    the output cotangents (matching the reference's symmetric backward)."""
+    return allreduce(tensor, op=op, comm=group)
+
+
+def all_reduce_inplace(x, op: ReduceOp = ReduceOp.AVG, axis=None):
+    """Differentiable in-step collective (use inside shard_map); ``psum`` /
+    ``pmean`` transpose rules make this correct under ``jax.grad``."""
+    return allreduce_inplace(x, op=op, axis=axis)

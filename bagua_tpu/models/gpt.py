@@ -1,0 +1,99 @@
+"""GPT-style causal decoder with optional ring-attention sequence parallelism
+— the long-context demonstration model (causal ring attention over the ``sp``
+axis lets context length scale with the number of chips)."""
+
+import dataclasses
+from typing import Any, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from bagua_tpu.parallel.ring_attention import ring_attention, _block_attention_local
+from bagua_tpu.parallel.tensor_parallel import ColumnParallelDense, ParallelMLP, RowParallelDense
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 2048
+    tp_size: int = 1
+    tp_axis: Union[str, Tuple[str, ...]] = "tp"
+    sp_axis: Union[str, Tuple[str, ...], None] = None
+    compute_dtype: Any = jnp.float32
+
+
+def _sp_offset(cfg: GPTConfig, t_local: int):
+    if cfg.sp_axis is None:
+        return 0
+    try:
+        from bagua_tpu.communication import rank_id
+
+        axes = (cfg.sp_axis,) if isinstance(cfg.sp_axis, str) else cfg.sp_axis
+        return rank_id(axes) * t_local
+    except NameError:
+        return 0
+
+
+class GPTBlock(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, t, _ = x.shape
+        local_heads = cfg.num_heads // cfg.tp_size
+        head_dim = cfg.hidden_size // cfg.num_heads
+
+        h = nn.LayerNorm(name="ln1")(x)
+        qkv = ColumnParallelDense(
+            3 * cfg.hidden_size, cfg.tp_size, cfg.tp_axis, dtype=cfg.compute_dtype, name="qkv"
+        )(h).reshape(b, t, 3, local_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cfg.sp_axis is not None:
+            ctx = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True)
+        else:
+            ctx = _block_attention_local(q, k, v, causal=True)
+        attn = RowParallelDense(
+            cfg.hidden_size, cfg.tp_size, cfg.tp_axis, dtype=cfg.compute_dtype, name="out"
+        )(ctx.reshape(b, t, local_heads * head_dim))
+        x = x + attn
+        h = nn.LayerNorm(name="ln2")(x)
+        return x + ParallelMLP(
+            4 * cfg.hidden_size, cfg.hidden_size, cfg.tp_size, cfg.tp_axis,
+            dtype=cfg.compute_dtype, name="mlp",
+        )(h)
+
+
+class GPTModel(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        b, t = input_ids.shape
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="wte")(input_ids)
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, name="wpe")(
+            jnp.arange(t)[None, :] + _sp_offset(cfg, t)
+        )
+        x = (x + pos).astype(cfg.compute_dtype)
+        for i in range(cfg.num_layers):
+            x = GPTBlock(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(name="ln_f")(x.astype(jnp.float32))
+        wte = self.variables["params"]["wte"]["embedding"]
+        return x @ wte.T  # tied LM head
+
+
+def lm_loss_fn(model: GPTModel):
+    """Next-token cross entropy (within the local block under SP)."""
+
+    def loss_fn(params, batch):
+        ids = batch
+        logits = model.apply({"params": params}, ids)
+        logp = jax.nn.log_softmax(logits[:, :-1])
+        return -jnp.mean(jnp.take_along_axis(logp, ids[:, 1:, None], axis=-1))
+
+    return loss_fn
