@@ -15,3 +15,4 @@ from bagua_tpu.contrib.load_balancing_data_loader import (  # noqa: F401
     LoadBalancingDistributedBatchSampler,
 )
 from bagua_tpu.contrib.sync_batchnorm import SyncBatchNorm  # noqa: F401
+from bagua_tpu.contrib.zero import zero_optimizer  # noqa: F401

@@ -87,10 +87,11 @@ def init_resnet50(key, image_size: int = 224, num_classes: int = 1000, compute_d
 
 
 def resnet_loss_fn(model: ResNet):
-    """Cross-entropy; params tree includes batch_stats (mutable BN handled by
-    treating stats as part of the algo-visible state is overkill for the
-    synthetic benchmark — stats update is dropped, matching deterministic
-    benchmark mode)."""
+    """Cross-entropy.  The DDP params tree holds both ``params`` and
+    ``batch_stats``; pass ``dp_filter=lambda n: "batch_stats" not in n`` to
+    the engine so the (gradient-free) BN statistics are neither bucketed nor
+    allreduced.  Stats updates inside the loss are dropped (deterministic
+    benchmark mode, matching the reference's synthetic benchmark)."""
 
     def loss_fn(params, batch):
         x, y = batch

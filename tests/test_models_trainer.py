@@ -22,6 +22,7 @@ def test_resnet50_forward_and_train_step(group):
     ddp = DistributedDataParallel(
         resnet_loss_fn(model), optax.sgd(0.01), GradientAllReduceAlgorithm(),
         process_group=group,
+        dp_filter=lambda name: "batch_stats" not in name,
     )
     state = ddp.init(full)
     rng = np.random.RandomState(0)
