@@ -45,6 +45,9 @@ class BertConfig:
     tp_axis: Union[str, Tuple[str, ...]] = "tp"
     sp_axis: Union[str, Tuple[str, ...], None] = None  # ring attention when set
     compute_dtype: Any = jnp.float32
+    #: rematerialize each layer's activations in the backward pass
+    #: (jax.checkpoint) — trades FLOPs for HBM, the standard TPU memory lever
+    remat: bool = False
 
 
 def bert_large_config(**overrides) -> BertConfig:
@@ -137,8 +140,9 @@ class BertModel(nn.Module):
             )
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_embed")(x)
         x = x.astype(cfg.compute_dtype)
+        layer_cls = nn.remat(BertLayer) if cfg.remat else BertLayer
         for i in range(cfg.num_layers):
-            x = BertLayer(cfg, name=f"layer_{i}")(x, attention_mask)
+            x = layer_cls(cfg, name=f"layer_{i}")(x, attention_mask)
         return x.astype(jnp.float32)
 
 
