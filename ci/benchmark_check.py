@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""CI benchmark gate (analog of the reference's
+``.buildkite/scripts/benchmark_master.sh``): for every algorithm, run the
+synthetic benchmark twice and assert (a) the two runs' final losses are
+EXACTLY equal (determinism gate, as the reference asserts exact loss values)
+and (b) throughput clears a floor.
+
+Run on real TPU:   python ci/benchmark_check.py --min-throughput 400
+Run on CPU sim:    JAX_PLATFORMS=cpu python ci/benchmark_check.py --cpu
+"""
+
+import argparse
+import sys
+import time
+
+import jax
+
+
+def run_once(algorithm: str, n_steps: int, batch: int):
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import bagua_tpu
+    from bagua_tpu.algorithms import Algorithm, QAdamOptimizer
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+    group = bagua_tpu.get_default_group()
+    params = init_mlp(jax.random.PRNGKey(1), [64, 128, 16])
+    if algorithm == "qadam":
+        algo = Algorithm.init("qadam", q_adam_optimizer=QAdamOptimizer(lr=1e-3, warmup_steps=5))
+        opt = None
+    else:
+        algo = Algorithm.init(algorithm)
+        opt = optax.sgd(0.05)
+    ddp = DistributedDataParallel(mse_loss, opt, algo, process_group=group)
+    state = ddp.init(params)
+    rng = np.random.RandomState(3)
+    bs = batch * group.size
+    data = [
+        (jnp.asarray(rng.randn(bs, 64), np.float32), jnp.asarray(rng.randn(bs, 16), np.float32))
+        for _ in range(n_steps)
+    ]
+    state, losses = ddp.train_step(state, data[0])  # compile
+    jax.block_until_ready(losses)
+    t0 = time.perf_counter()
+    for b in data[1:]:
+        state, losses = ddp.train_step(state, b)
+    jax.block_until_ready(losses)
+    dt = time.perf_counter() - t0
+    sps = bs * (n_steps - 1) / dt / group.size
+    return float(losses.mean()), sps
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu", action="store_true", help="run on the CPU simulation")
+    p.add_argument("--min-throughput", type=float, default=0.0, help="samples/s/chip floor")
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--batch", type=int, default=64)
+    args = p.parse_args()
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import bagua_tpu
+    from bagua_tpu.algorithms import GlobalAlgorithmRegistry
+
+    bagua_tpu.init_process_group()
+    failures = []
+    for name in sorted(GlobalAlgorithmRegistry.keys()):
+        if name == "async":
+            continue  # wall-clock-driven schedule: not bitwise-deterministic
+        loss1, sps1 = run_once(name, args.steps, args.batch)
+        loss2, sps2 = run_once(name, args.steps, args.batch)
+        det = "OK " if loss1 == loss2 else "FAIL"
+        thr = "OK " if max(sps1, sps2) >= args.min_throughput else "FAIL"
+        print(
+            f"{name:28s} loss={loss1:.8f} determinism={det} "
+            f"throughput={max(sps1, sps2):9.1f} samples/s/chip floor={thr}"
+        )
+        if det == "FAIL":
+            failures.append(f"{name}: loss {loss1} != {loss2}")
+        if thr == "FAIL":
+            failures.append(f"{name}: throughput {max(sps1, sps2):.1f} < {args.min_throughput}")
+    if failures:
+        print("FAILURES:\n  " + "\n  ".join(failures))
+        sys.exit(1)
+    print("all benchmark checks passed")
+
+
+if __name__ == "__main__":
+    main()

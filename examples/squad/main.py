@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""SQuAD-style BERT finetune example (analog of the reference's
+``examples/squad``): BERT + span-prediction head, ByteGrad compression (the
+BASELINE.json config "BERT-Large SQuAD finetune with ByteGrad 8-bit
+compression").  QA data is synthetic (zero-egress) but the model/loss shape
+is the real finetune task: predict answer start/end positions.
+
+    python examples/squad/main.py --steps 20           # BERT-mini, CPU-able
+    python examples/squad/main.py --large --steps 100  # BERT-Large
+"""
+
+import argparse
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import bagua_tpu
+from bagua_tpu.algorithms import Algorithm
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.models.bert import BertConfig, BertModel, bert_large_config
+
+
+class BertForQuestionAnswering(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None):
+        h = BertModel(self.cfg, name="bert")(input_ids, attention_mask=attention_mask)
+        logits = nn.Dense(2, name="qa_outputs")(h)  # (B, T, 2)
+        return logits[..., 0], logits[..., 1]  # start, end
+
+
+def qa_loss_fn(model):
+    def loss_fn(params, batch):
+        ids, mask, starts, ends = batch
+        s_logits, e_logits = model.apply({"params": params}, ids, attention_mask=mask)
+        s_logits = jnp.where(mask, s_logits, -1e9)
+        e_logits = jnp.where(mask, e_logits, -1e9)
+
+        def ce(logits, pos):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(jnp.take_along_axis(logp, pos[:, None], axis=1))
+
+        return 0.5 * (ce(s_logits, starts) + ce(e_logits, ends))
+
+    return loss_fn
+
+
+def synthetic_squad(rng, n, seq, vocab):
+    ids = rng.randint(5, vocab, (n, seq)).astype(np.int32)
+    lengths = rng.randint(seq // 2, seq, n)
+    mask = np.arange(seq)[None, :] < lengths[:, None]
+    starts = (rng.rand(n) * (lengths - 2)).astype(np.int32)
+    spans = rng.randint(1, 5, n)
+    ends = np.minimum(starts + spans, lengths - 1).astype(np.int32)
+    # plant a weak signal: answer tokens get a marker id
+    for i in range(n):
+        ids[i, starts[i]] = 2
+        ids[i, ends[i]] = 3
+    return ids, mask, starts, ends
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--large", action="store_true")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    args = p.parse_args()
+
+    group = bagua_tpu.init_process_group()
+    if args.large:
+        cfg = bert_large_config(
+            compute_dtype=jnp.bfloat16, max_position_embeddings=args.seq
+        )
+    else:
+        cfg = BertConfig(
+            vocab_size=1000, hidden_size=64, num_layers=2, num_heads=4,
+            intermediate_size=128, max_position_embeddings=args.seq,
+        )
+    model = BertForQuestionAnswering(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, args.seq), jnp.int32)
+    )["params"]
+
+    ddp = DistributedDataParallel(
+        qa_loss_fn(model), optax.adam(3e-4), Algorithm.init("bytegrad"),
+        process_group=group,
+    )
+    state = ddp.init(params)
+
+    rng = np.random.RandomState(0)
+    bs = args.batch_size * group.size
+    for step in range(args.steps):
+        ids, mask, starts, ends = synthetic_squad(rng, bs, args.seq, cfg.vocab_size)
+        state, losses = ddp.train_step(
+            state,
+            (jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(starts), jnp.asarray(ends)),
+        )
+        if step % 10 == 0:
+            print(f"step {step}: loss {float(losses.mean()):.4f}")
+    print(f"final loss {float(losses.mean()):.6f}")
+
+
+if __name__ == "__main__":
+    main()
