@@ -65,3 +65,16 @@ GlobalAlgorithmRegistry.register(
     AsyncModelAverageAlgorithm,
     "asynchronous model averaging with host-armed time-scheduled sync",
 )
+
+#: algorithms whose schedule is wall-clock-driven (not bitwise-deterministic
+#: across runs by design) — determinism gates skip these.
+WALL_CLOCK_ALGORITHMS = frozenset({"async"})
+
+
+def build_algorithm(name: str, lr: float = 1e-3, qadam_warmup_steps: int = 10, **kwargs) -> Algorithm:
+    """Construct any registered algorithm, defaulting required constructor
+    kwargs (QAdam needs its bundled optimizer).  The one-stop builder for
+    benches/CI/tests so per-algorithm special cases live in one place."""
+    if name == "qadam" and "q_adam_optimizer" not in kwargs:
+        kwargs["q_adam_optimizer"] = QAdamOptimizer(lr=lr, warmup_steps=qadam_warmup_steps)
+    return Algorithm.init(name, **kwargs)

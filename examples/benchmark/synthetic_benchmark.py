@@ -16,7 +16,7 @@ import numpy as np
 import optax
 
 import bagua_tpu
-from bagua_tpu.algorithms import Algorithm, QAdamOptimizer
+from bagua_tpu.algorithms import build_algorithm
 from bagua_tpu.ddp import DistributedDataParallel
 
 
@@ -60,12 +60,8 @@ def main():
     dtype = jnp.float32 if args.fp32 else jnp.bfloat16
     loss_fn, params, batch_fn = build(args.model, dtype)
 
-    if args.algorithm == "qadam":
-        algo = Algorithm.init("qadam", q_adam_optimizer=QAdamOptimizer(lr=1e-3, warmup_steps=10))
-        opt = None
-    else:
-        algo = Algorithm.init(args.algorithm)
-        opt = optax.sgd(0.01, momentum=0.9)
+    algo = build_algorithm(args.algorithm, lr=1e-3, qadam_warmup_steps=10)
+    opt = None if args.algorithm == "qadam" else optax.sgd(0.01, momentum=0.9)
 
     ddp = DistributedDataParallel(loss_fn, opt, algo, process_group=group)
     state = ddp.init(params)

@@ -79,8 +79,10 @@ def main():
     state = ddp.init(params)
 
     dataset = CachedDataset(SyntheticImageNet(image_size=args.image_size), backend="memory")
+    # Sampling over the CACHED dataset warms the cache during the complexity
+    # pass, so the training loop below is served entirely from cache.
     sampler = LoadBalancingDistributedSampler(
-        dataset.dataset, complexity_fn=lambda s: int(s[1]),  # class id as fake complexity
+        dataset, complexity_fn=lambda s: int(s[1]),  # class id as fake complexity
         num_replicas=1, rank=0,
     )
 

@@ -16,7 +16,7 @@ import numpy as np
 import optax
 
 import bagua_tpu
-from bagua_tpu.algorithms import Algorithm, QAdamOptimizer
+from bagua_tpu.algorithms import build_algorithm
 from bagua_tpu.ddp import DistributedDataParallel
 
 
@@ -60,12 +60,8 @@ def main():
             jnp.take_along_axis(jax.nn.log_softmax(logits), y[:, None], axis=1)
         )
 
-    if args.algorithm == "qadam":
-        algo = Algorithm.init("qadam", q_adam_optimizer=QAdamOptimizer(lr=args.lr, warmup_steps=20))
-        opt = None
-    else:
-        algo = Algorithm.init(args.algorithm)
-        opt = optax.adam(args.lr)
+    algo = build_algorithm(args.algorithm, lr=args.lr, qadam_warmup_steps=20)
+    opt = None if args.algorithm == "qadam" else optax.adam(args.lr)
 
     ddp = DistributedDataParallel(loss_fn, opt, algo, process_group=group)
     state = ddp.init(params)

@@ -11,24 +11,24 @@ import numpy as np
 import optax
 import pytest
 
-from bagua_tpu.algorithms import Algorithm, GlobalAlgorithmRegistry, QAdamOptimizer
+from bagua_tpu.algorithms import (
+    WALL_CLOCK_ALGORITHMS,
+    GlobalAlgorithmRegistry,
+    build_algorithm,
+)
 from bagua_tpu.ddp import DistributedDataParallel
 from bagua_tpu.models.mlp import init_mlp, mse_loss
 
 
 @pytest.mark.parametrize("name", sorted(GlobalAlgorithmRegistry.keys()))
 def test_training_is_deterministic(group, name):
-    if name == "async":
-        pytest.skip("async sync schedule is wall-clock-driven by design")
+    if name in WALL_CLOCK_ALGORITHMS:
+        pytest.skip("wall-clock-driven schedule: not bitwise-deterministic by design")
 
     def run():
         params = init_mlp(jax.random.PRNGKey(5), [12, 16, 4])
-        if name == "qadam":
-            algo = Algorithm.init(name, q_adam_optimizer=QAdamOptimizer(lr=1e-3, warmup_steps=3))
-            opt = None
-        else:
-            algo = Algorithm.init(name)
-            opt = optax.sgd(0.05)
+        algo = build_algorithm(name, lr=1e-3, qadam_warmup_steps=3)
+        opt = None if name == "qadam" else optax.sgd(0.05)
         ddp = DistributedDataParallel(mse_loss, opt, algo, process_group=group)
         state = ddp.init(params)
         rng = np.random.RandomState(9)
