@@ -10,8 +10,12 @@ Run on CPU sim:    JAX_PLATFORMS=cpu python ci/benchmark_check.py --cpu
 """
 
 import argparse
+import os
 import sys
 import time
+
+# runnable as `python ci/benchmark_check.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
