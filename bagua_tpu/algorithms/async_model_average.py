@@ -3,36 +3,52 @@
 TPU-native redesign of the reference's ``async_model_average.py`` +
 ``decentralized_full_precision_asynchronous.rs``.  The reference runs a
 background thread that continuously allreduce-averages the live weights on a
-dedicated CUDA stream, guarded by weight locks and a 1-byte MIN-allreduce
-abort negotiation — machinery that exists because CUDA kernels and NCCL calls
-mutate buffers in place while autograd runs.
+dedicated CUDA stream while forward/backward proceeds, with weight locks and
+a 1-byte MIN-allreduce abort negotiation
+(``async_model_average.py:208-230``,
+``decentralized_full_precision_asynchronous.rs:98-171``).  The defining
+property: **training never blocks on the average**; staleness is tolerated.
 
-Under XLA a step is a pure function and collectives are compiler-scheduled,
-so in-place cross-thread mutation does not map.  The same *algorithm* —
-"train on local data continuously; fold the group average into the weights
-every ``sync_interval_ms``, never blocking training on communication" — is
-realized with a **host-armed sync variant** of the step function:
+Under XLA arrays are immutable and a step is a pure function, so "average the
+live weights in place" does not map directly — but the property does:
 
-* a monotonic timer arms a flag every ``sync_interval_ms``;
-* when armed, the next step dispatches the "sync" variant, which averages the
-  weights over the group (``pmean`` of the weight buckets) *at step start*,
-  exactly where the reference copies peer-averaged weights back between
-  steps; otherwise the "plain" variant runs with zero collectives;
-* because JAX dispatch is asynchronous, the host never blocks — the sync
-  step's collective is overlapped with neighboring steps' compute by XLA's
-  latency-hiding scheduler (the role of the reference's comm stream).
+* A daemon **averager thread** wakes every ``sync_interval_ms``, snapshots the
+  current rank-stacked parameters (a Python ref — jax.Arrays are immutable, so
+  the snapshot is free), and dispatches a separately-jitted **average
+  program** that returns ``(group_mean, snapshot_copy)`` in fresh buffers.
+  The device executes it interleaved with training steps (the role of the
+  reference's comm stream); the host training loop never waits on it.
+* When a result lands, it is **folded** into the training state right before
+  the next step dispatch: ``params <- params + (avg - snapshot)`` — i.e. the
+  averaging *delta* measured at snapshot time is applied to the current
+  weights.  This is the well-defined functional analog of the reference's
+  tolerated race between the averaging write-back and concurrent optimizer
+  updates: progress made since the snapshot survives, staleness in the
+  average is accepted.
+* The steady-state train step itself contains **zero collectives** (warmup
+  steps route through a ``lax.cond`` gradient allreduce, after which the
+  branch is dead) — so step cadence is independent of averaging cadence.
+* ``abort()`` mirrors the reference's negotiated abort: the averager
+  contributes a 0 to a group MIN every cycle (``_negotiate``); averaging only
+  runs when every rank contributes 1.  ``abort()`` waits for any in-flight
+  average to drain, discards the undelivered result, and parks the thread;
+  ``resume()`` re-arms it.  (Reference ``:232-305``.)
 
-``warmup_steps`` of plain gradient allreduce, ``abort()``/``resume()``
-(reference ``:232-305``) are preserved.  Both step variants are compiled once
-and cached by the engine, so flipping between them costs nothing at runtime.
+Dispatch-order safety: the engine serializes step dispatch with the averager's
+snapshot+dispatch via ``host_dispatch_lock`` (microseconds — only the
+*enqueue* is serialized, not device execution).  This is required because the
+step donates its input buffers; sampling under the lock guarantees the
+averager only ever reads the freshest, not-yet-donated parameters.
 """
 
-import time
+import threading
 
 import jax
+import jax.numpy as jnp
 
 from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
-from bagua_tpu.communication import ReduceOp, allreduce_inplace
+from bagua_tpu.communication import ALL_AXES, ReduceOp, allreduce_inplace
+from jax.sharding import PartitionSpec as P
 
 
 class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
@@ -53,36 +69,161 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
         self.peer_selection_mode = peer_selection_mode
         self.sync_interval_ms = sync_interval_ms
         self.warmup_steps = warmup_steps
+
         self._status = "running"
-        self._last_sync = 0.0
+        self._latest = None  # rank-stacked params of the newest dispatched step
+        self._published_step = 0
+        self._pending = None  # (snapshot, avg) awaiting fold
+        self._pending_lock = threading.Lock()
+        self._cycle_lock = threading.Lock()  # held across one averaging cycle
+        self.host_dispatch_lock = threading.Lock()  # shared with the engine
+        self._thread = None
+        self._stop_event = threading.Event()  # per-thread; replaced on spawn
+        self._wake = threading.Event()
+        self._shutdown = False
+        self._jit_average = None
+        self._jit_fold = jax.jit(
+            lambda params, snap, avg: jax.tree.map(
+                lambda p, s, a: p + (a - s), params, snap, avg
+            )
+        )
+        self.folds_applied = 0  # observability: how many averages landed
 
-    # -- host-side scheduling ----------------------------------------------
+    # -- the average program -------------------------------------------------
 
-    def step_variant(self, step: int) -> str:
-        if self._status != "running" or step < self.warmup_steps:
-            return "plain"
-        now = time.monotonic()
-        if (now - self._last_sync) * 1000.0 >= self.sync_interval_ms:
-            self._last_sync = now
-            return "sync"
-        return "plain"
+    def _build_average(self):
+        def local(p):
+            def mean_of(x):
+                # Uniform stacking: every device holds size/n_dev rows, so the
+                # pmean of local means is the group mean.
+                m = jax.lax.pmean(jnp.mean(x, axis=0, keepdims=True), ALL_AXES)
+                return jnp.broadcast_to(m, x.shape)
+
+            avg = jax.tree.map(mean_of, p)
+            # ``x + 0`` forces fresh output buffers (no aliasing with the live
+            # training params, which the next step will donate).
+            snap = jax.tree.map(lambda x: x + 0, p)
+            return avg, snap
+
+        return jax.jit(
+            self.process_group.shard_map(
+                local, in_specs=P(ALL_AXES), out_specs=(P(ALL_AXES), P(ALL_AXES))
+            )
+        )
+
+    # -- averager thread -----------------------------------------------------
+
+    def _negotiate(self, ready: bool) -> bool:
+        """Group MIN of per-rank readiness (the reference's 1-byte MIN
+        allreduce abort negotiation, ``async_model_average.py:272-305``).
+
+        Single-controller: the min over ranks is local.  Multi-process: every
+        process's averager contributes each cycle (aborted ranks contribute 0
+        but keep negotiating), so the agreed result keeps the collective
+        sequence identical on all processes.
+        """
+        if jax.process_count() == 1:
+            return bool(ready)
+        from jax.experimental import multihost_utils
+
+        import numpy as np
+
+        flags = multihost_utils.process_allgather(np.int32(1 if ready else 0))
+        return bool(flags.min())
+
+    def _cycle(self, stop_event=None):
+        stop_event = stop_event or self._stop_event
+        # Multi-process: negotiation is itself a collective, and warmup steps
+        # contain gradient allreduces — negotiating mid-warmup would interleave
+        # collectives in different orders across processes and hang the job.
+        # Every process gates on its *local* warmup completion, making the
+        # per-process collective sequence identical: W warmup allreduces, then
+        # negotiate rounds (which rate-match by blocking on the slowest peer).
+        if jax.process_count() > 1 and self._published_step < self.warmup_steps:
+            return
+        with self._cycle_lock:
+            ready = (
+                self._status == "running"
+                and not stop_event.is_set()
+                and self._latest is not None
+                and self._published_step >= self.warmup_steps
+            )
+            if not self._negotiate(ready):
+                return
+            if self._jit_average is None:
+                self._jit_average = self._build_average()
+            with self.host_dispatch_lock:
+                avg, snap = self._jit_average(self._latest)
+            jax.block_until_ready(avg)
+            with self._pending_lock:
+                if self._status == "running":
+                    self._pending = (snap, avg)
+
+    def _run(self, stop_event, wake):
+        while True:
+            wake.wait(self.sync_interval_ms / 1000.0)
+            wake.clear()
+            if stop_event.is_set():
+                return
+            self._cycle(stop_event)
+
+    def _ensure_thread(self):
+        if self._shutdown:
+            return
+        if self._thread is None or not self._thread.is_alive():
+            # Fresh events per thread: a stuck old thread keeps its own (set)
+            # stop event, so it can never be revived by a new spawn.
+            self._stop_event = threading.Event()
+            self._wake = threading.Event()
+            self._thread = threading.Thread(
+                target=self._run,
+                args=(self._stop_event, self._wake),
+                daemon=True,
+                name="bagua-async-averager",
+            )
+            self._thread.start()
+
+    # -- host-side engine hooks ---------------------------------------------
+
+    def host_pre_dispatch(self, state):
+        with self._pending_lock:
+            pending, self._pending = self._pending, None
+        if pending is None:
+            return state
+        snap, avg = pending
+        self.folds_applied += 1
+        return state._replace(params=self._jit_fold(state.params, snap, avg))
+
+    def host_post_dispatch(self, state, step: int) -> None:
+        self._latest = state.params
+        self._published_step = step
+        self._ensure_thread()
+
+    # -- control (reference ``:232-305``) ------------------------------------
 
     def abort(self):
-        """Pause averaging (e.g. around evaluation), reference ``:232-270``."""
+        """Stop averaging; waits for any in-flight average to drain and
+        discards its undelivered result."""
+        if self._status != "running":
+            return
         self._status = "aborted"
+        with self._cycle_lock:  # drain: in-flight cycle finishes first
+            with self._pending_lock:
+                self._pending = None
 
     def resume(self):
         self._status = "running"
-        self._last_sync = 0.0
 
-    # -- traced stages ------------------------------------------------------
+    def host_shutdown(self):
+        """Stop the averager thread permanently (end of training)."""
+        self._shutdown = True
+        self._stop_event.set()
+        if self._thread is not None:
+            self._wake.set()
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
-    def on_step_start(self, params, state, ctx: StepContext):
-        if ctx.extras.get("variant") == "sync":
-            flats = ctx.plan.bucketize(params)
-            flats = [allreduce_inplace(f, op=ReduceOp.AVG) for f in flats]
-            params = ctx.plan.debucketize(flats, params)
-        return params, state
+    # -- traced stages -------------------------------------------------------
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
         if self.warmup_steps > 0:

@@ -105,6 +105,26 @@ class AlgorithmImpl:
     def on_step_end(self, params, state, ctx: StepContext):
         return params, state
 
+    # -- host-side integration (non-traced) ----------------------------------
+
+    #: Optional ``threading.Lock``.  When set, the engine serializes step
+    #: *dispatch* (enqueue only, not device execution) with the algorithm's
+    #: background threads — required when the step donates buffers a
+    #: background thread may be sampling (async model average).
+    host_dispatch_lock = None
+
+    def host_pre_dispatch(self, state):
+        """Called on the host right before each step dispatch; may return a
+        replacement state (async average folds finished results here)."""
+        return state
+
+    def host_post_dispatch(self, state, step: int) -> None:
+        """Called with each freshly dispatched step's output state and the
+        host-side step counter."""
+
+    def host_shutdown(self) -> None:
+        """Stop any background machinery (end of training)."""
+
     # -- control ------------------------------------------------------------
 
     def need_reset(self, step: int) -> bool:

@@ -108,9 +108,11 @@ def init_process_group(
     mesh over the local devices.
     """
     global _default_group
-    if coordinator_address is not None:
+    if coordinator_address is not None and not jax.distributed.is_initialized():
         # Must run before anything initializes the XLA backend (jax.distributed
         # requirement); callers on multi-host must call init_process_group first.
+        # Skipped when the runtime is already up (e.g. re-initializing the
+        # default group after a checkpoint-restart in the same process).
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -88,23 +88,43 @@ class FileStore(Store):
         self.path = path or tempfile.mkdtemp(prefix="bagua_store_")
         os.makedirs(self.path, exist_ok=True)
 
-    def _file(self, key: str) -> str:
-        return os.path.join(self.path, f"{_hash(key.encode()):016x}.blob")
+    def _candidates(self, key: str):
+        """Filenames for ``key``: the hash slot, then linear-probe suffixes.
+
+        Blobs are named by a 64-bit key hash; distinct keys may collide, so
+        both ``set`` and ``get`` probe ``<hash>.blob``, ``<hash>.1.blob``, …
+        and match on the stored key (each blob records its full key)."""
+        base = f"{_hash(key.encode()):016x}"
+        yield os.path.join(self.path, f"{base}.blob")
+        for i in range(1, 64):
+            yield os.path.join(self.path, f"{base}.{i}.blob")
+
+    def _slot(self, key: str, load_value: bool):
+        """Walk the probe chain for ``key``.  Returns ``(path, found, value)``:
+        ``path`` is the slot holding the key (or the first free slot), and
+        ``value`` is the stored payload when ``found`` and ``load_value``.
+        Blobs hold two sequential pickles — key, then value — so key
+        comparison never deserializes the payload."""
+        for cand in self._candidates(key):
+            try:
+                with open(cand, "rb") as f:
+                    if pickle.load(f) == key:
+                        return cand, True, (pickle.load(f) if load_value else None)
+            except FileNotFoundError:
+                return cand, False, None
+        return next(self._candidates(key)), False, None  # exhausted: reuse slot 0
 
     def set(self, key, value):
-        target = self._file(key)
+        target, _, _ = self._slot(key, load_value=False)
         fd, tmp = tempfile.mkstemp(dir=self.path)
         with os.fdopen(fd, "wb") as f:
-            pickle.dump((key, value), f)
+            pickle.dump(key, f)
+            pickle.dump(value, f)
         os.replace(tmp, target)
 
     def get(self, key):
-        try:
-            with open(self._file(key), "rb") as f:
-                stored_key, value = pickle.load(f)
-                return value if stored_key == key else None
-        except FileNotFoundError:
-            return None
+        _, found, value = self._slot(key, load_value=True)
+        return value if found else None
 
     def num_keys(self):
         return len([f for f in os.listdir(self.path) if f.endswith(".blob")])

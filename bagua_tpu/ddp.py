@@ -220,7 +220,24 @@ class DistributedDataParallel:
         if fn is None:
             fn = self._step_fns[variant] = self._build_step(variant)
         self._host_step += 1
-        return fn(state, batch)
+        state = self.impl.host_pre_dispatch(state)
+        lock = self.impl.host_dispatch_lock
+        if lock is None:
+            new_state, losses = fn(state, batch)
+            self.impl.host_post_dispatch(new_state, self._host_step)
+        else:
+            # Serialize dispatch with the algorithm's background thread: the
+            # step donates ``state``, so sampling threads must never race the
+            # enqueue (see async_model_average.py module docstring).
+            with lock:
+                new_state, losses = fn(state, batch)
+                self.impl.host_post_dispatch(new_state, self._host_step)
+        return new_state, losses
+
+    def shutdown(self):
+        """Tear down algorithm background machinery (e.g. the async
+        averager thread); safe to call more than once."""
+        self.impl.host_shutdown()
 
     def abort(self):
         """Pause background/async behavior (reference
@@ -274,7 +291,13 @@ class AutotuneSession:
         self._step += 1
         if self.completed or self._step % self.interval != 0:
             return
-        rank = 0  # single-controller: one report covers the group
+        # The service samples a check board and only tunes once every rank in
+        # [0, world_size) has reported for an iteration — on multi-process
+        # runs each controller must therefore report its own process index,
+        # not a constant (reference reports torch rank, ``bagua_distributed.py:358``).
+        import jax
+
+        rank = jax.process_index()
         self.client.report_metrics(
             self.model_name, rank, self._step, self.ddp.speed_meter.speed(60.0)
         )

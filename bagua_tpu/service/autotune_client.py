@@ -88,4 +88,17 @@ class AutotuneClient:
 
 
 def get_hyperparameters_service_client() -> AutotuneClient:
-    return AutotuneClient()
+    """Build a client pointing at the job's autotune service.
+
+    Resolution order (reference ``env.py:get_autotune_server_addr``):
+    ``AUTO_TUNE_SERVER_ADDR`` (``host:port``, exported by the launcher) >
+    ``MASTER_ADDR`` + ``BAGUA_SERVICE_PORT`` > localhost + default port —
+    so workers on non-master hosts reach the master's service.
+    """
+    import os
+
+    addr = os.environ.get("AUTO_TUNE_SERVER_ADDR")
+    if addr and ":" in addr:
+        host, _, port_s = addr.rpartition(":")
+        return AutotuneClient(host=host, port=int(port_s))
+    return AutotuneClient(host=os.environ.get("MASTER_ADDR", "127.0.0.1"))

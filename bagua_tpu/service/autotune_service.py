@@ -181,7 +181,10 @@ def start_autotune_server(
     ``server_address[1]`` is the bound port).  Analog of the reference
     spawning a Flask process from ``init_process_group``
     (``communication.py:384-420``)."""
-    server = ThreadingHTTPServer(("127.0.0.1", port), service.make_handler())
+    # Bind all interfaces: workers on other hosts reach the service at
+    # AUTO_TUNE_SERVER_ADDR (the reference's Flask service binds 0.0.0.0 too,
+    # ``communication.py:399``).
+    server = ThreadingHTTPServer(("0.0.0.0", port), service.make_handler())
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server

@@ -325,3 +325,19 @@ def test_shm_store_overwrite_and_clear():
     finally:
         s.shutdown()
         ShmStore(name=name, capacity_bytes=1 << 20).unlink()
+
+
+def test_file_store_hash_collision(tmp_path, monkeypatch):
+    """Two distinct keys whose 64-bit hashes collide must both survive: the
+    store linear-probes suffixed slots instead of silently evicting."""
+    import bagua_tpu.contrib.store as store_mod
+
+    monkeypatch.setattr(store_mod, "_hash", lambda b: 42)  # force collisions
+    s = store_mod.FileStore(path=str(tmp_path))
+    s.set("alpha", 1)
+    s.set("beta", 2)
+    s.set("alpha", 11)  # overwrite must hit alpha's probed slot, not beta's
+    assert s.get("alpha") == 11
+    assert s.get("beta") == 2
+    assert s.get("gamma") is None
+    assert s.num_keys() == 2
