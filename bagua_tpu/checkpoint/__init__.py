@@ -4,4 +4,5 @@ from bagua_tpu.checkpoint.checkpointing import (  # noqa: F401
     save_checkpoint,
     load_checkpoint,
     get_latest_iteration,
+    remap_world_size,
 )

@@ -98,11 +98,28 @@ def get_latest_iteration(ckpt_dir: str) -> Optional[int]:
         return int(f.read().strip())
 
 
+def _restore_to_host(ckpt, path):
+    """Restore every leaf as a host numpy array, ignoring the sharding the
+    checkpoint was written with — required when resuming on a different
+    topology (elastic scale-up/down), where the saved device layout no longer
+    exists.  Pair with :func:`remap_world_size`."""
+    import numpy as np
+
+    import orbax.checkpoint as ocp
+
+    tree = ckpt.metadata(path).item_metadata.tree
+    restore_args = jax.tree.map(
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
+    )
+    return ckpt.restore(path, restore_args=restore_args)
+
+
 def load_checkpoint(
     ckpt_dir: str,
     iteration: Optional[int] = None,
     target=None,
     expert_filter=_default_expert_filter,
+    to_host: bool = False,
 ) -> Tuple[object, int]:
     """Load the checkpoint named by the tracker (or an explicit iteration).
     Returns ``(state, iteration)`` (reference ``load_checkpoint``,
@@ -110,7 +127,11 @@ def load_checkpoint(
 
     Pass ``target`` (a pytree of the same structure, e.g. a freshly built
     ``TrainState``) to restore exact container types — Orbax otherwise
-    returns plain dicts/lists, which breaks optax NamedTuple states."""
+    returns plain dicts/lists, which breaks optax NamedTuple states.
+
+    ``to_host=True`` restores every leaf as a full host numpy array
+    regardless of the topology the checkpoint was saved on — the elastic
+    resume path: load on the new world, :func:`remap_world_size`, re-init."""
     if iteration is None:
         iteration = get_latest_iteration(ckpt_dir)
         if iteration is None:
@@ -119,6 +140,13 @@ def load_checkpoint(
     ckpt = _checkpointer()
     expert_path = os.path.join(path, "expert_states")
     has_expert = os.path.exists(expert_path)
+    if to_host:
+        non_expert = _restore_to_host(ckpt, os.path.join(path, "model_states"))
+        if has_expert:
+            state = _merge(non_expert, _restore_to_host(ckpt, expert_path))
+        else:
+            state = non_expert
+        return state, iteration
     target_non_expert = target_expert = None
     if target is not None and has_expert:
         target_non_expert, target_expert = _split_expert(target, expert_filter)
@@ -131,3 +159,47 @@ def load_checkpoint(
     else:
         state = non_expert
     return state, iteration
+
+
+def remap_world_size(
+    state,
+    new_size: int,
+    expert_filter=_default_expert_filter,
+):
+    """Remap a rank-stacked train state to a different world size (elastic
+    scale-up/down restart; the reference's expert-layout remapping on restart
+    with a different expert-parallel degree, ``checkpointing.py:34-84``).
+
+    * Replicated leaves (everything centralized algorithms keep bitwise equal
+      across ranks — params, optimizer state, step) are sliced to one copy and
+      re-stacked to ``new_size``.
+    * Expert leaves (``expert_filter`` on the leaf path) hold a *different*
+      shard per rank: shape ``(old_size, local_experts, ...)``.  The global
+      expert pool ``old_size * local_experts`` is preserved and redistributed
+      as ``(new_size, old_size * local_experts / new_size, ...)``; the total
+      must divide evenly.
+
+    Decentralized algorithms keep genuinely different weights per rank; remap
+    their state only after a sync point (the reference likewise checkpoints
+    decentralized runs post-average).
+    """
+    import jax.numpy as jnp
+
+    def remap(path, x):
+        if x is None:
+            return None
+        if expert_filter(jax.tree_util.keystr(path)):
+            old_size, local = x.shape[0], x.shape[1]
+            total = old_size * local
+            if total % new_size != 0:
+                raise ValueError(
+                    f"cannot redistribute {total} experts over {new_size} ranks"
+                    f" (leaf {jax.tree_util.keystr(path)})"
+                )
+            return jnp.reshape(
+                x, (new_size, total // new_size) + tuple(x.shape[2:])
+            )
+        one = x[0]
+        return jnp.broadcast_to(one[None], (new_size,) + tuple(one.shape))
+
+    return jax.tree_util.tree_map_with_path(remap, state)

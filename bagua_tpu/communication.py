@@ -77,6 +77,12 @@ class BaguaProcessGroup:
         return len(self.devices)
 
     @property
+    def spans_processes(self) -> bool:
+        """True when the group's devices live in more than one OS process
+        (multi-host / multi-controller deployment)."""
+        return len({d.process_index for d in self.devices}) > 1
+
+    @property
     def ranks(self) -> List[int]:
         return list(range(self.size))
 

@@ -122,7 +122,12 @@ class DistributedDataParallel:
         if stacked_params is not None and params is not None:
             raise ValueError("pass either params or stacked_params, not both")
         if stacked_params is not None:
-            template = jax.tree.map(lambda x: x[0], stacked_params)
+            # Only shapes/dtypes are needed downstream (bucket plan + re-jit
+            # template), so avoid indexing rank 0 — on a multi-process group
+            # that slice may not be addressable from this host.
+            template = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), stacked_params
+            )
         else:
             if params is None:
                 raise ValueError("pass params or stacked_params")
@@ -136,6 +141,33 @@ class DistributedDataParallel:
         self._tree_template = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template
         )
+        if self.group.spans_processes:
+            # Multi-host: build the rank-stacked state *inside* jit with
+            # explicit out_shardings over the group mesh, so every process
+            # computes exactly its addressable shards (the analog of the
+            # reference's per-node state setup after the rank-0 broadcast).
+            # With plain ``params``, every process must pass the same values
+            # (e.g. same PRNG seed) — they are treated as replicated inputs.
+            sharding = jax.sharding.NamedSharding(self.group.mesh, P(ALL_AXES))
+            if stacked_params is not None:
+                build = lambda sp: TrainState(
+                    params=sp,
+                    opt_state=jax.vmap(self.optimizer.init)(sp),
+                    algo_state=jax.vmap(self.impl.init_state)(sp),
+                    step=jnp.zeros((n,), jnp.int32),
+                )
+                return jax.jit(build, out_shardings=sharding)(stacked_params)
+            build = lambda p: TrainState(
+                params=_stack(p, n),
+                opt_state=_stack(self.optimizer.init(p), n),
+                algo_state=_stack(self.impl.init_state(p), n),
+                step=jnp.zeros((n,), jnp.int32),
+            )
+            import numpy as np
+
+            return jax.jit(build, out_shardings=sharding)(
+                jax.tree.map(np.asarray, params)
+            )
         if stacked_params is not None:
             stacked = stacked_params
             opt_state = jax.vmap(self.optimizer.init)(stacked)
@@ -211,8 +243,15 @@ class DistributedDataParallel:
         if self._host_step is None:
             # Seed the host-side mirror of the traced counter from the state,
             # so resuming from a checkpoint keeps step_variant/need_reset in
-            # sync with the traced schedule (one device fetch, once).
-            self._host_step = int(state.step[0])
+            # sync with the traced schedule (one device fetch, once).  On a
+            # multi-host group rank 0's slice may not be addressable here, so
+            # read whichever shard this process holds (all ranks agree).
+            step_arr = state.step
+            if isinstance(step_arr, jax.Array) and not step_arr.is_fully_addressable:
+                local = step_arr.addressable_shards[0].data
+                self._host_step = int(jnp.reshape(local, (-1,))[0])
+            else:
+                self._host_step = int(step_arr[0])
         if self.impl.need_reset(self._host_step):
             self._step_fns = {}
         variant = self.impl.step_variant(self._host_step)
@@ -250,6 +289,27 @@ class DistributedDataParallel:
             self.impl.resume()
 
     # -- convenience --------------------------------------------------------
+
+    def shard_batch(self, local_batch):
+        """Assemble the global batch from this process's local rows.
+
+        On a multi-host group each process loads only its own slice of the
+        global batch (the reference's per-node DataLoader shard); this glues
+        the slices into one global array over the group mesh via
+        ``jax.make_array_from_process_local_data``.  Single-process groups
+        pass through unchanged — ``train_step`` accepts host arrays directly.
+        """
+        if not self.group.spans_processes:
+            return local_batch
+        import numpy as np
+
+        sharding = jax.sharding.NamedSharding(self.group.mesh, P(ALL_AXES))
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            ),
+            local_batch,
+        )
 
     def record_speed(self, n_samples: int) -> None:
         self.speed_meter.record(n_samples)

@@ -1,1 +1,21 @@
 """Launchers (reference ``bagua/distributed/``)."""
+
+
+def init_from_env():
+    """Initialize the default process group from launcher-exported env vars
+    (``RANK`` / ``WORLD_SIZE`` / ``MASTER_ADDR`` / ``MASTER_PORT``) — the
+    worker-side half of ``bagua_tpu.distributed.run`` (reference workers read
+    the same vars, ``env.py:5-134``).  Single-process when ``WORLD_SIZE`` is
+    unset or 1."""
+    import os
+
+    import bagua_tpu
+
+    world_size = int(os.environ.get("WORLD_SIZE", "1"))
+    if world_size <= 1:
+        return bagua_tpu.init_process_group()
+    return bagua_tpu.init_process_group(
+        coordinator_address=f"{os.environ['MASTER_ADDR']}:{os.environ['MASTER_PORT']}",
+        num_processes=world_size,
+        process_id=int(os.environ["RANK"]),
+    )

@@ -2,16 +2,30 @@
 
 TPU-native analog of the reference's torchelastic-derived launcher
 (``bagua/distributed/run.py``): sets up the distributed env, spawns one
-worker process per local replica, monitors them, and on any failure tears the
-whole gang down and restarts it (restart-all semantics, reference behavior
-doc ``run.py:116-148``) up to ``--max_restarts`` times.  Workers are expected
-to checkpoint and resume via ``bagua_tpu.checkpoint`` (the pattern the
-reference documents at ``run.py:149-159``); on TPU, slices are
-gang-scheduled, so elasticity *is* checkpoint-restart.
+worker process per local replica, monitors them, and on failure re-forms the
+gang (restart-all semantics, reference behavior doc ``run.py:116-148``).
+
+**Elastic membership** (reference ``run.py:116-148,189-345``): ``--nnodes``
+accepts ``MIN:MAX``.  Worker slots that fail repeatedly
+(``--slot_failure_tolerance`` consecutive crashes) are benched, and the gang
+re-rendezvouses at the reduced world size — fresh ``WORLD_SIZE``/``RANK``
+(contiguous over the surviving slots) and a rotated ``MASTER_PORT`` so the
+new ``jax.distributed`` rendezvous never collides with a lingering listener.
+``SIGUSR1`` un-benches every slot and re-forms the gang at full size (the
+operator's "scale up now" signal — the analog of a new node joining the
+reference's etcd rendezvous).  Workers are expected to checkpoint and resume
+via ``bagua_tpu.checkpoint`` (reference pattern ``run.py:149-159``), using
+:func:`bagua_tpu.checkpoint.remap_world_size` when the world size changed.
+
+Node-level membership across hosts needs a shared rendezvous store; this
+launcher implements elasticity over its local worker slots (the testable
+single-host analog), and ``bagua_tpu.distributed.baguarun`` fans launchers
+out across hosts.
 
 Env exported to workers (reference ``set_bagua_env``, ``run.py:578-603``):
 ``RANK``, ``WORLD_SIZE``, ``LOCAL_RANK``, ``LOCAL_WORLD_SIZE``, ``NODE_RANK``,
-``MASTER_ADDR``, ``MASTER_PORT``, ``BAGUA_SERVICE_PORT``, autotune knobs.
+``MASTER_ADDR``, ``MASTER_PORT``, ``BAGUA_SERVICE_PORT``, ``BAGUA_SLOT``,
+``BAGUA_ATTEMPT``, autotune knobs.
 Rank 0's launcher also hosts the autotune service when ``--autotune_level >= 1``.
 """
 
@@ -22,20 +36,45 @@ import signal
 import subprocess
 import sys
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 logger = logging.getLogger("bagua_tpu.launcher")
+
+
+def parse_nnodes(spec: str) -> Tuple[int, int]:
+    """``"N"`` -> (N, N); ``"MIN:MAX"`` -> (MIN, MAX) (reference CLI)."""
+    if ":" in spec:
+        lo, hi = spec.split(":", 1)
+        lo, hi = int(lo), int(hi)
+    else:
+        lo = hi = int(spec)
+    if not (1 <= lo <= hi):
+        raise ValueError(f"bad --nnodes {spec!r}")
+    return lo, hi
 
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(
         "bagua_tpu.distributed.run", description="bagua_tpu elastic launcher"
     )
-    p.add_argument("--nnodes", type=int, default=1, help="number of nodes (hosts)")
+    p.add_argument(
+        "--nnodes", type=str, default="1",
+        help="number of nodes: N, or MIN:MAX for elastic membership",
+    )
     p.add_argument("--node_rank", type=int, default=0)
     p.add_argument(
         "--nproc_per_node", type=int, default=1,
         help="worker processes per node (on TPU usually 1 process drives all local chips)",
+    )
+    p.add_argument(
+        "--min_replicas", type=int, default=None,
+        help="elastic floor for local worker slots; below this the launch "
+        "fails (defaults to nproc_per_node, i.e. no shrinking)",
+    )
+    p.add_argument(
+        "--slot_failure_tolerance", type=int, default=2,
+        help="consecutive failures before a worker slot is benched and the "
+        "gang shrinks",
     )
     p.add_argument("--master_addr", default="127.0.0.1")
     p.add_argument("--master_port", type=int, default=29500)
@@ -46,61 +85,113 @@ def parse_args(argv=None):
     p.add_argument("--no_python", action="store_true")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    args.min_nodes, args.max_nodes = parse_nnodes(args.nnodes)
+    if args.min_nodes != args.max_nodes:
+        # Node-level membership change needs a shared rendezvous store that
+        # every node launcher consults (the reference uses etcd); silently
+        # assuming max_nodes would hang jax.distributed.initialize waiting
+        # for phantom processes.  Use --min_replicas for (local) slot-level
+        # elasticity instead.
+        raise SystemExit(
+            "--nnodes MIN:MAX requires a shared rendezvous backend, which "
+            "this launcher does not provide; launch with the exact node "
+            "count and use --min_replicas for worker-slot elasticity"
+        )
+    if args.min_replicas is None:
+        args.min_replicas = args.nproc_per_node
+    return args
 
 
-def worker_env(args, local_rank: int) -> dict:
+def worker_env(
+    args, slot: int, rank: int, local_rank: int, local_world: int,
+    world_size: int, attempt: int,
+) -> dict:
     env = dict(os.environ)
-    world_size = args.nnodes * args.nproc_per_node
-    rank = args.node_rank * args.nproc_per_node + local_rank
+    # Single-node gangs rotate the rendezvous port per gang epoch so a fresh
+    # gang never trips over a lingering listener; the rotation skips the
+    # autotune service port.  Multi-node gangs keep it CONSTANT — launchers on
+    # different hosts cannot observe each other's epoch counters, and a
+    # desynced rotation would rendezvous them onto different ports forever.
+    if args.max_nodes == 1:
+        master_port = args.master_port + attempt
+        while master_port == args.bagua_service_port:
+            master_port += 1
+    else:
+        master_port = args.master_port
     env.update(
         RANK=str(rank),
         WORLD_SIZE=str(world_size),
         LOCAL_RANK=str(local_rank),
-        LOCAL_WORLD_SIZE=str(args.nproc_per_node),
+        LOCAL_WORLD_SIZE=str(local_world),
         NODE_RANK=str(args.node_rank),
         MASTER_ADDR=args.master_addr,
-        MASTER_PORT=str(args.master_port),
+        MASTER_PORT=str(master_port),
         BAGUA_SERVICE_PORT=str(args.bagua_service_port),
         BAGUA_AUTOTUNE=str(args.autotune_level),
+        BAGUA_SLOT=str(slot),
+        BAGUA_ATTEMPT=str(attempt),
         AUTO_TUNE_SERVER_ADDR=f"{args.master_addr}:{args.bagua_service_port}",
     )
     return env
 
 
-def spawn_workers(args) -> List[subprocess.Popen]:
-    procs = []
-    for local_rank in range(args.nproc_per_node):
+def spawn_workers(args, slots: List[int], attempt: int) -> Dict[int, subprocess.Popen]:
+    """Spawn one worker per active slot; ranks are contiguous over ``slots``.
+
+    Multi-node: every node launcher is assumed to shrink symmetrically (a
+    shared rendezvous store would relax this); world size is nodes x active
+    slots."""
+    world_size = args.max_nodes * len(slots)
+    procs = {}
+    for local_rank, slot in enumerate(slots):
         if args.no_python:
             cmd = [args.training_script] + args.training_script_args
         else:
             cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
-        procs.append(subprocess.Popen(cmd, env=worker_env(args, local_rank)))
+        global_rank = args.node_rank * len(slots) + local_rank
+        procs[slot] = subprocess.Popen(
+            cmd,
+            env=worker_env(
+                args, slot, global_rank, local_rank, len(slots), world_size, attempt
+            ),
+        )
     return procs
 
 
-def kill_all(procs: List[subprocess.Popen]) -> None:
-    for p in procs:
+def kill_all(procs) -> None:
+    plist = list(procs.values()) if isinstance(procs, dict) else list(procs)
+    for p in plist:
         if p.poll() is None:
             p.send_signal(signal.SIGTERM)
     deadline = time.time() + 10
-    for p in procs:
+    for p in plist:
         try:
             p.wait(timeout=max(0.1, deadline - time.time()))
         except subprocess.TimeoutExpired:
             p.kill()
 
 
-def monitor(procs: List[subprocess.Popen], interval: float) -> Optional[int]:
-    """Wait until all workers exit cleanly (return None) or any fails
-    (return its exit code)."""
+def monitor(
+    procs: Dict[int, subprocess.Popen], interval: float, interrupt=lambda: False
+) -> Tuple[str, List[int]]:
+    """Watch the gang.  Returns ``("done", [])`` when all workers exit 0,
+    ``("failed", slots)`` with *every* slot that had exited nonzero when the
+    failure was observed, or ``("interrupted", [])`` when ``interrupt()``
+    goes true (scale-up signal).
+
+    Reporting the whole failed set (rather than the lowest-indexed slot)
+    avoids systematically mis-blaming a healthy slot whose worker merely
+    collapsed after a faulty peer died within the same poll window."""
     while True:
-        states = [p.poll() for p in procs]
-        for code in states:
-            if code is not None and code != 0:
-                return code
-        if all(code == 0 for code in states):
-            return None
+        codes = {slot: p.poll() for slot, p in procs.items()}
+        failed = [slot for slot, code in codes.items() if code is not None and code != 0]
+        if failed:
+            return "failed", failed
+        if all(code == 0 for code in codes.values()):
+            return "done", []
+        if interrupt():
+            return "interrupted", []
         time.sleep(interval)
 
 
@@ -108,29 +199,74 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO, format="[bagua_tpu.launcher] %(message)s")
     args = parse_args(argv)
 
-    autotune_server = None
+    autotune_server = service = None
     if args.autotune_level >= 1 and args.node_rank == 0:
         from bagua_tpu.service import AutotuneService, start_autotune_server
 
         service = AutotuneService(
-            world_size=args.nnodes * args.nproc_per_node,
+            world_size=args.max_nodes * args.nproc_per_node,
             autotune_level=args.autotune_level,
         )
         autotune_server = start_autotune_server(service, port=args.bagua_service_port)
         logger.info("autotune service on port %d", args.bagua_service_port)
 
+    scale_up = {"armed": False}
+    signal.signal(signal.SIGUSR1, lambda *_: scale_up.__setitem__("armed", True))
+
+    consecutive_failures = {s: 0 for s in range(args.nproc_per_node)}
+    benched = set()
+    failures = 0  # restart budget: consumed by failures only, not scale-ups
+    epoch = 0  # every gang formation (drives single-node port rotation)
     try:
-        for attempt in range(args.max_restarts + 1):
-            procs = spawn_workers(args)
-            failed = monitor(procs, args.monitor_interval)
-            if failed is None:
+        while failures <= args.max_restarts:
+            slots = [s for s in range(args.nproc_per_node) if s not in benched]
+            if len(slots) < args.min_replicas:
+                logger.error(
+                    "only %d healthy worker slots left (< --min_replicas %d)",
+                    len(slots), args.min_replicas,
+                )
+                return 1
+            if service is not None:
+                # keep the autotune check board sized to the LIVE world, or
+                # benched ranks would block tuning forever
+                service.world_size = args.max_nodes * len(slots)
+            logger.info(
+                "gang epoch %d: %d worker(s) (slots %s), world re-formed",
+                epoch, len(slots), slots,
+            )
+            procs = spawn_workers(args, slots, epoch)
+            outcome, failed_slots = monitor(
+                procs, args.monitor_interval, interrupt=lambda: scale_up["armed"]
+            )
+            epoch += 1
+            if outcome == "done":
                 logger.info("all workers finished")
                 return 0
-            logger.warning(
-                "worker failed with exit code %d (attempt %d/%d); restarting all",
-                failed, attempt + 1, args.max_restarts + 1,
-            )
             kill_all(procs)
+            if outcome == "interrupted":
+                scale_up["armed"] = False
+                logger.info("SIGUSR1: un-benching %s, re-forming at full size", sorted(benched))
+                benched.clear()
+                for s in consecutive_failures:
+                    consecutive_failures[s] = 0
+                continue
+            failures += 1
+            for s in slots:
+                if s in failed_slots:
+                    consecutive_failures[s] += 1
+                else:
+                    consecutive_failures[s] = 0
+            for s in failed_slots:
+                if consecutive_failures[s] >= args.slot_failure_tolerance:
+                    benched.add(s)
+                    logger.warning(
+                        "slot %d benched after %d consecutive failures; gang shrinks",
+                        s, consecutive_failures[s],
+                    )
+            logger.warning(
+                "worker slot(s) %s failed (failure %d/%d); restarting gang",
+                failed_slots, failures, args.max_restarts + 1,
+            )
         logger.error("exceeded max_restarts=%d", args.max_restarts)
         return 1
     finally:

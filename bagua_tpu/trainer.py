@@ -99,3 +99,17 @@ class Trainer:
         if losses is not None:
             jax.block_until_ready(losses)
         return state
+
+    def close(self) -> None:
+        """Release background machinery: the hang watchdog and any algorithm
+        threads (async averager).  Safe to call more than once."""
+        if self.watchdog:
+            self.watchdog.stop()
+            self.watchdog = None
+        self.ddp.shutdown()
+
+    def __enter__(self) -> "Trainer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

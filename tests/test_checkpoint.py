@@ -79,3 +79,47 @@ def test_resume_training_identical(group, tmp_path):
     for a, b in zip(jax.tree.leaves(uninterrupted.params), jax.tree.leaves(state2.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert int(state2.step[0]) == 6
+
+
+def test_remap_world_size_replicated_and_expert():
+    """Elastic restart remap: replicated leaves re-stack to the new size;
+    expert leaves redistribute the global expert pool (total preserved)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bagua_tpu.checkpoint import remap_world_size
+
+    state = {
+        "dense": {"w": jnp.broadcast_to(jnp.arange(6.0)[None], (8, 6))},
+        "moe": {"experts": jnp.arange(8 * 2 * 3.0).reshape(8, 2, 3)},
+    }
+    is_expert = lambda path: "experts" in path
+
+    down = remap_world_size(state, 4, expert_filter=is_expert)
+    assert down["dense"]["w"].shape == (4, 6)
+    np.testing.assert_array_equal(down["dense"]["w"][3], state["dense"]["w"][0])
+    assert down["moe"]["experts"].shape == (4, 4, 3)  # 16 experts preserved
+    np.testing.assert_array_equal(
+        np.asarray(down["moe"]["experts"]).reshape(16, 3),
+        np.asarray(state["moe"]["experts"]).reshape(16, 3),
+    )
+
+    up = remap_world_size(down, 16, expert_filter=is_expert)
+    assert up["moe"]["experts"].shape == (16, 1, 3)
+    assert up["dense"]["w"].shape == (16, 6)
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        remap_world_size(state, 5, expert_filter=is_expert)  # 16 % 5 != 0
+
+
+def test_parse_nnodes():
+    from bagua_tpu.distributed.run import parse_nnodes
+
+    assert parse_nnodes("3") == (3, 3)
+    assert parse_nnodes("1:4") == (1, 4)
+    import pytest
+
+    with pytest.raises(ValueError):
+        parse_nnodes("4:2")

@@ -89,16 +89,16 @@ def test_trainer_fit_with_checkpointing(group, tmp_path):
                 jnp.asarray(rng.randn(16, 4), np.float32),
             )
 
-    t1 = make()
-    params = init_mlp(jax.random.PRNGKey(0), [8, 16, 4])
-    state = t1.init_state(params)
-    state = t1.fit(state, batches(10), log_every=0)
-    assert int(state.step[0]) == 10
+    with make() as t1:
+        params = init_mlp(jax.random.PRNGKey(0), [8, 16, 4])
+        state = t1.init_state(params)
+        state = t1.fit(state, batches(10), log_every=0)
+        assert int(state.step[0]) == 10
 
     # new trainer resumes from the step-10 checkpoint
-    t2 = make()
-    state2 = t2.init_state(params)
-    assert int(state2.step[0]) == 10
+    with make() as t2:
+        state2 = t2.init_state(params)
+        assert int(state2.step[0]) == 10
 
 
 def test_functional_allreduce_differentiable(group):

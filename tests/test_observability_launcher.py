@@ -121,3 +121,102 @@ def test_launcher_exceeds_max_restarts(tmp_path):
     r = run_launcher(tmp_path, "import sys; sys.exit(3)", max_restarts=1)
     assert r.returncode == 1
     assert "exceeded max_restarts" in r.stderr + r.stdout
+
+
+ELASTIC_WORKER = """
+import json, os, sys
+
+work = os.environ["ELASTIC_WORK_DIR"]
+rank, ws = os.environ["RANK"], int(os.environ["WORLD_SIZE"])
+crash_flag = os.path.join(work, "crashed")
+if rank == "1" and os.path.exists(crash_flag):
+    sys.exit(7)  # this slot's capacity is permanently gone
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import optax
+import bagua_tpu
+from bagua_tpu.algorithms import Algorithm
+from bagua_tpu.checkpoint import (
+    get_latest_iteration, load_checkpoint, remap_world_size, save_checkpoint,
+)
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.distributed import init_from_env
+from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+group = init_from_env()
+assert group.size == ws, (group, ws)
+ddp = DistributedDataParallel(
+    mse_loss, optax.sgd(0.1),
+    Algorithm.init("gradient_allreduce"), process_group=group,
+)
+ckpt_dir = os.path.join(work, "ckpt")
+start = get_latest_iteration(ckpt_dir) or 0
+if start:
+    # Elastic resume: host-restore ignores the old topology, remap re-stacks
+    # the replicated leaves for the new world size.
+    loaded, start = load_checkpoint(ckpt_dir, to_host=True)
+    stacked = remap_world_size(loaded, ws, expert_filter=lambda p: False)
+    state = ddp.init(stacked_params=jax.tree.map(jnp.asarray, stacked))
+else:
+    state = ddp.init(params=init_mlp(jax.random.PRNGKey(0), [8, 8, 2]))
+
+rng = np.random.RandomState(7)  # same stream everywhere; slice per process
+X = rng.randn(8, 8, 8).astype(np.float32)
+Y = rng.randn(8, 8, 2).astype(np.float32)
+loss_log = os.path.join(work, "losses.jsonl")
+for i in range(start, 6):
+    per = 8 // ws
+    local = (
+        X[i][int(rank) * per:(int(rank) + 1) * per],
+        Y[i][int(rank) * per:(int(rank) + 1) * per],
+    )
+    state, losses = ddp.train_step(state, ddp.shard_batch(local))
+    my_loss = float(np.asarray(losses.addressable_shards[0].data).reshape(-1)[0])
+    save_checkpoint(i + 1, ckpt_dir, state.params, moe_split=False)  # all ranks
+    if rank == "0":
+        with open(loss_log, "a") as f:
+            f.write(json.dumps({"iter": i + 1, "ws": ws, "loss": my_loss}) + chr(10))
+    if rank == "1" and i >= 1:
+        open(crash_flag, "w").write("gone")
+        os._exit(7)  # hard crash: a dying node runs no atexit handshakes
+open(os.path.join(work, f"finished_ws{ws}"), "w").write("ok")
+"""
+
+
+def test_elastic_shrink_resumes_from_checkpoint(tmp_path):
+    """VERDICT scenario: one of two workers dies permanently; the launcher
+    benches its slot, re-forms the gang at world size 1 with a fresh
+    rendezvous port, and training resumes from the checkpoint."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(ELASTIC_WORKER))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_WORK_DIR"] = str(tmp_path)
+    env.pop("XLA_FLAGS", None)  # 1 device per process
+    import socket
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    base_port = s.getsockname()[1]; s.close()
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "bagua_tpu.distributed.run",
+            "--nnodes", "1", "--nproc_per_node", "2", "--min_replicas", "1",
+            "--max_restarts", "3", "--monitor_interval", "0.2",
+            "--master_port", str(base_port), str(script),
+        ],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert (tmp_path / "finished_ws1").exists(), r.stderr  # shrunk gang finished
+    assert "benched" in r.stderr + r.stdout
+    import json
+
+    recs = [json.loads(l) for l in (tmp_path / "losses.jsonl").read_text().splitlines()]
+    assert recs[0]["ws"] == 2 and recs[-1]["ws"] == 1  # world size changed
+    assert recs[-1]["iter"] == 6
+    resumed = [r for r in recs if r["ws"] == 1]
+    assert resumed[0]["iter"] == 3  # picked up right after the checkpoint
+    assert min(r["loss"] for r in resumed) < recs[0]["loss"]  # kept converging
