@@ -32,14 +32,17 @@ from bagua_tpu.communication import (
     allgather_inplace,
     axis_size,
 )
-from bagua_tpu.kernels.minmax_uint8 import (
-    compress_minmax_uint8,
-    decompress_minmax_uint8,
-)
+from bagua_tpu.kernels.minmax_uint8 import get_compressors
 
 
-def compressed_allreduce(flat: jnp.ndarray, axes, average: bool = True) -> jnp.ndarray:
-    """The scatter-gather compressed allreduce over ``axes`` (traced)."""
+def compressed_allreduce(
+    flat: jnp.ndarray, axes, average: bool = True, use_pallas=None
+) -> jnp.ndarray:
+    """The scatter-gather compressed allreduce over ``axes`` (traced).
+
+    ``use_pallas`` selects the quantizer implementation (None = auto: Pallas
+    kernels on TPU, jnp elsewhere — see ``kernels.get_compressors``)."""
+    compress_minmax_uint8, decompress_minmax_uint8 = get_compressors(use_pallas)
     n = axis_size(axes)
     if n == 1:
         return flat
@@ -62,9 +65,13 @@ def compressed_allreduce(flat: jnp.ndarray, axes, average: bool = True) -> jnp.n
 
 
 class ByteGradAlgorithmImpl(AlgorithmImpl):
-    def __init__(self, process_group, hierarchical: bool = True, average: bool = True):
+    def __init__(
+        self, process_group, hierarchical: bool = True, average: bool = True,
+        use_pallas=None,
+    ):
         super().__init__(process_group, hierarchical=hierarchical)
         self.average = average
+        self.use_pallas = use_pallas
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
         flats = ctx.plan.bucketize(grads)
@@ -78,21 +85,30 @@ class ByteGradAlgorithmImpl(AlgorithmImpl):
                 continue
             if self.hierarchical and self.process_group.intra_size > 1:
                 intra = allreduce_inplace(flat, op=ReduceOp.SUM, axis=INTRA_AXIS)
-                red = compressed_allreduce(intra, (INTER_AXIS,), average=False)
+                red = compressed_allreduce(
+                    intra, (INTER_AXIS,), average=False, use_pallas=self.use_pallas
+                )
                 if self.average:
                     red = red / self.process_group.size
                 out.append(red.astype(flat.dtype))
             else:
-                out.append(compressed_allreduce(flat, (INTER_AXIS, INTRA_AXIS), self.average))
+                out.append(
+                    compressed_allreduce(
+                        flat, (INTER_AXIS, INTRA_AXIS), self.average,
+                        use_pallas=self.use_pallas,
+                    )
+                )
         return ctx.plan.debucketize(out, grads), params, state
 
 
 class ByteGradAlgorithm(Algorithm):
-    def __init__(self, hierarchical: bool = True, average: bool = True):
+    def __init__(self, hierarchical: bool = True, average: bool = True, use_pallas=None):
         self.hierarchical = hierarchical
         self.average = average
+        self.use_pallas = use_pallas
 
     def reify(self, process_group) -> ByteGradAlgorithmImpl:
         return ByteGradAlgorithmImpl(
-            process_group, hierarchical=self.hierarchical, average=self.average
+            process_group, hierarchical=self.hierarchical, average=self.average,
+            use_pallas=self.use_pallas,
         )

@@ -274,57 +274,25 @@ def alltoall_v_inplace(x: jnp.ndarray, send_counts: jnp.ndarray, axis=None):
 
 def ppermute_apply(x: jnp.ndarray, perm, axis=None) -> jnp.ndarray:
     """Apply an explicit (src, dst) permutation over the (possibly combined)
-    group axes.  Single axis lowers to ``lax.ppermute``; combined axes fall
-    back to all_gather + select (bandwidth-heavy — ring *shifts* should use
-    :func:`ppermute_shift`, which stays point-to-point).  Like
+    group axes — one point-to-point ``collective-permute``, never a gather.
+
+    ``lax.ppermute`` accepts the combined axes tuple directly, with ranks
+    flattened row-major (inter major, intra minor) — exactly this module's
+    rank convention — so arbitrary cross-axis routes lower to a single
+    XLA collective-permute riding ICI/DCN point-to-point.  Like
     ``lax.ppermute``, destinations absent from ``perm`` receive zeros."""
     axes = _axes(axis)
-    if len(axes) == 1:
-        return jax.lax.ppermute(x, axes[0], perm)
-    n = axis_size(axes)
-    gathered = jax.lax.all_gather(x, axes, tiled=False).reshape((n,) + x.shape)
-    src_for_dst = np.full((n,), -1, np.int32)
-    for src, dst in perm:
-        src_for_dst[dst] = src
-    me = rank_id(axes)
-    src = jnp.take(jnp.asarray(src_for_dst), me)
-    value = jnp.take(gathered, jnp.maximum(src, 0), axis=0)
-    return jnp.where(src >= 0, value, jnp.zeros_like(x))
+    return jax.lax.ppermute(x, axes[0] if len(axes) == 1 else axes, perm)
 
 
 def ppermute_shift(x: jnp.ndarray, shift: int, axis=None) -> jnp.ndarray:
     """Ring shift: rank i receives rank (i - shift) mod n's value (ranks
-    row-major over the combined axes).
-
-    Over combined ``(inter, intra)`` axes this stays point-to-point: a shift
-    within the row is one intra-axis ppermute; entries that wrap a row edge
-    additionally hop one step along the inter axis, and the two candidates
-    are merged by position — two cheap collectives instead of an all_gather.
-    Requires ``|shift| < intra_size`` on the combined-axes path (the ring
-    algorithms use ±1); larger shifts fall back to :func:`ppermute_apply`.
-    """
+    row-major over the combined axes).  One collective-permute."""
     axes = _axes(axis)
     n = axis_size(axes)
     shift = shift % n
-    if len(axes) == 1 or shift == 0:
-        perm = [(i, (i + shift) % n) for i in range(n)]
-        return ppermute_apply(x, perm, axes)
-    inter_axis, intra_axis = axes
-    h = jax.lax.axis_size(intra_axis)
-    n_inter = jax.lax.axis_size(inter_axis)
-    if shift >= h and n - shift >= h:
-        perm = [(i, (i + shift) % n) for i in range(n)]
-        return ppermute_apply(x, perm, axes)
-    s, carry = (shift, 1) if shift < h else (shift - n, -1)  # s in (-h, h)
-    # Within-row candidate: from (inter, intra - s).
-    intra_perm = [(i, (i + s) % h) for i in range(h)]
-    within = jax.lax.ppermute(x, intra_axis, intra_perm)
-    # Wrapped candidate additionally comes from the neighboring inter row.
-    inter_perm = [(i, (i + carry) % n_inter) for i in range(n_inter)]
-    wrapped = jax.lax.ppermute(within, inter_axis, inter_perm)
-    me_intra = jax.lax.axis_index(intra_axis)
-    wraps = (me_intra - s < 0) if s > 0 else (me_intra - s >= h)
-    return jnp.where(wraps, wrapped, within)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return ppermute_apply(x, perm, axes)
 
 
 def hierarchical_allreduce_inplace(x: jnp.ndarray, op: ReduceOp = ReduceOp.AVG) -> jnp.ndarray:
@@ -357,8 +325,20 @@ def _eager(group: Optional[BaguaProcessGroup], key: tuple, make_fn: Callable):
     ``(size, ...)`` arrays.  The stacked leading axis is sharded over the
     mesh, so each rank's local block is ``(1, ...)``; we strip/restore that
     axis around the collective.  Compiled callables are cached per
-    ``(mesh, key)`` (jit handles shape/dtype polymorphism internally)."""
+    ``(mesh, key)`` (jit handles shape/dtype polymorphism internally).
+
+    Single-controller only: the stacked input carries *every* rank's send
+    value, which a process in a multi-host group cannot know for remote
+    ranks.  Multi-host callers use the in-step collectives (inside
+    ``shard_map`` over the group mesh) or :func:`broadcast_object`."""
     group = group or get_default_group()
+    if group.spans_processes:
+        raise RuntimeError(
+            "eager collectives take a stacked (size, ...) array holding every "
+            "rank's value — undefined when the group spans processes; use the "
+            "in-step collectives (allreduce_inplace et al. inside shard_map) "
+            "or broadcast_object instead"
+        )
     cache_key = (group.mesh, key)
     cached = _EAGER_CACHE.get(cache_key)
     if cached is None:
@@ -444,16 +424,18 @@ def scatter(send, src: int = 0, comm: Optional[BaguaProcessGroup] = None):
 
 
 def gather(send, dst: int = 0, comm: Optional[BaguaProcessGroup] = None):
-    """All slices concatenated at rank ``dst``; other ranks get their own
-    slice tiled (reference ``communication.py:1081`` leaves recv untouched;
-    a uniform output shape requires *some* value there)."""
+    """All slices concatenated at rank ``dst``.
+
+    The reference (``communication.py:1081``) leaves the recv buffer on
+    non-dst ranks untouched; XLA's uniform output shape forces *some* value
+    there, so non-dst ranks receive **zeros** — an unmistakable "no data"
+    (matching ``lax.ppermute``'s convention for absent sources) rather than
+    fabricated values a caller could mistake for a real gather result."""
 
     def make():
         def fn(x):
             g = allgather_inplace(x, tiled=True)
-            n = axis_size()
-            mine = jnp.tile(x, (n,) + (1,) * (x.ndim - 1))
-            return jnp.where(rank_id() == dst, g, mine)
+            return jnp.where(rank_id() == dst, g, jnp.zeros_like(g))
 
         return fn
 
@@ -461,8 +443,17 @@ def gather(send, dst: int = 0, comm: Optional[BaguaProcessGroup] = None):
 
 
 def barrier(comm: Optional[BaguaProcessGroup] = None):
-    """Barrier as a tiny allreduce (reference ``communication.py:1377-1401``)."""
+    """Barrier as a tiny allreduce (reference ``communication.py:1377-1401``).
+
+    Needs no caller-supplied per-rank data, so unlike the other eager
+    collectives it also works on multi-host groups (via a cross-process
+    device sync there)."""
     group = comm or get_default_group()
+    if group.spans_processes:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("bagua_tpu_barrier")
+        return
     token = jnp.ones((group.size, 1), jnp.float32)
     jax.block_until_ready(allreduce(token, op=ReduceOp.SUM, comm=group))
 

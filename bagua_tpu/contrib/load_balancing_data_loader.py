@@ -1,13 +1,20 @@
 """Load-balancing distributed samplers.
 
-Framework-agnostic reimplementation of the reference's
-``contrib/load_balancing_data_loader.py``: sort samples by a user
-``complexity_fn``, chunk the sorted order into ``num_replicas``-sized groups
-(so one chunk = one per-rank batch row of similar complexity), shuffle whole
-chunks, and hand rank ``r`` the r-th element of each chunk.  ``random_level``
-∈ [0, 1] perturbs complexities before sorting to trade balance for
-randomness (0 = best balance).  numpy RNG replaces torch.Generator; the
-chunking/padding/drop-last arithmetic matches the reference.
+Same contract as the reference's ``contrib/load_balancing_data_loader.py``
+(rank-sliced sampling where every rank's step-``t`` sample has similar
+*complexity* — sequence length, image size — so no rank stalls the gang on a
+long sample), built around a different core: instead of dict-of-complexity
+bookkeeping and chunk generators, an epoch is materialized as a single
+``(steps, ranks)`` **assignment matrix** with vectorized numpy:
+
+1. complexities are jittered (``random_level`` blends in uniform noise — 0 is
+   best balance, 1 trades balance for shuffling freedom),
+2. ``argsort`` of the jittered complexities is wrap-padded to fill the matrix,
+3. each row then holds ``ranks`` samples of adjacent complexity; rows are
+   shuffled as units, and rank ``r`` reads column ``r``.
+
+``drop_last`` keeps only full rows of unique samples; otherwise the sort
+order wraps to pad.  Determinism: (seed, epoch) fully determine the matrix.
 """
 
 import math
@@ -17,6 +24,8 @@ import numpy as np
 
 
 class LoadBalancingDistributedSampler:
+    """Yields this rank's column of the epoch's assignment matrix."""
+
     def __init__(
         self,
         dataset,
@@ -36,88 +45,55 @@ class LoadBalancingDistributedSampler:
             from bagua_tpu.env import get_rank
 
             rank = get_rank()
-        if rank >= num_replicas or rank < 0:
+        if not 0 <= rank < num_replicas:
             raise ValueError(
                 f"Invalid rank {rank}, rank should be in the interval [0, {num_replicas - 1}]"
+            )
+        if not 0.0 <= random_level <= 1.0:
+            raise ValueError(
+                f"Invalid random level {random_level}, should be in the range [0.0, 1.0]"
             )
         self.dataset = dataset
         self.num_replicas = num_replicas
         self.rank = rank
-        self.epoch = 0
-        self.drop_last = drop_last
-
-        dataset_len = len(dataset)
-        if self.drop_last and dataset_len % self.num_replicas != 0:
-            self.num_samples = math.ceil((dataset_len - self.num_replicas) / self.num_replicas)
-        else:
-            self.num_samples = math.ceil(dataset_len / self.num_replicas)
-        self.total_size = self.num_samples * self.num_replicas
         self.shuffle = shuffle
         self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
 
-        self.item_complexity_map = {
-            i: complexity_fn(dataset[i]) for i in range(dataset_len)
-        }
-        self.ordered_item_complexity_map = dict(
-            sorted(self.item_complexity_map.items(), key=lambda t: t[1])
+        n = len(dataset)
+        self.complexities = np.asarray(
+            [complexity_fn(dataset[i]) for i in range(n)], dtype=np.float64
         )
-        if random_level < 0.0 or random_level > 1.0:
-            raise ValueError(
-                f"Invalid random level {random_level}, should be in the range [0.0, 1.0]"
-            )
-        max_c = max(self.item_complexity_map.values())
-        min_c = min(self.item_complexity_map.values())
-        self.random_number = int((max_c - min_c) * random_level + 1)
+        # noise amplitude: random_level as a fraction of the complexity range
+        spread = float(self.complexities.max() - self.complexities.min()) if n else 0.0
+        self.jitter_amplitude = spread * random_level + 1.0
 
-    def shuffle_chunks(self):
-        def chunks_wrap_padding(lst: List[int], n: int):
-            num_chunks = max(1, self.num_samples)
-            num_elements = num_chunks * n
-            current = []
-            for i in range(num_elements):
-                current.append(lst[i % len(lst)])
-                if len(current) == n:
-                    yield current
-                    current = []
+        if drop_last and n % num_replicas != 0:
+            self.num_samples = math.ceil((n - num_replicas) / num_replicas)
+        else:
+            self.num_samples = math.ceil(n / num_replicas)
 
+    def _assignment_matrix(self) -> np.ndarray:
+        """The epoch's ``(num_samples, num_replicas)`` sample-index matrix."""
+        rng = np.random.RandomState(self.seed + self.epoch)
         if self.shuffle:
-            g = np.random.RandomState(self.seed + self.epoch)
-            if self.random_number > 0:
-                perturbed = dict(self.item_complexity_map)
-                noise = g.randint(0, self.random_number, size=len(perturbed))
-                for k, dv in zip(perturbed, noise):
-                    perturbed[k] += int(dv)
-                ordered = dict(sorted(perturbed.items(), key=lambda t: t[1]))
-            else:
-                ordered = self.ordered_item_complexity_map
-            index_chunks = list(chunks_wrap_padding(list(ordered.keys()), self.num_replicas))
-            chunk_indices = list(g.permutation(len(index_chunks)))
-        else:
-            index_chunks = list(
-                chunks_wrap_padding(
-                    list(self.ordered_item_complexity_map.keys()), self.num_replicas
-                )
+            keys = self.complexities + rng.randint(
+                0, int(self.jitter_amplitude), size=self.complexities.shape
             )
-            chunk_indices = list(range(len(index_chunks)))
-
-        if not self.drop_last:
-            padding_size = self.num_samples - len(chunk_indices)
-            if padding_size <= len(chunk_indices):
-                chunk_indices += chunk_indices[:padding_size]
-            else:
-                chunk_indices += (
-                    chunk_indices * math.ceil(padding_size / len(chunk_indices))
-                )[:padding_size]
         else:
-            chunk_indices = chunk_indices[: self.num_samples]
-        assert len(chunk_indices) == self.num_samples
-        return index_chunks, chunk_indices
+            keys = self.complexities
+        order = np.argsort(keys, kind="stable")
+        rows, cols = self.num_samples, self.num_replicas
+        # wrap-pad the sorted order to fill the matrix exactly
+        flat = np.resize(order, rows * cols)
+        matrix = flat.reshape(rows, cols)
+        if self.shuffle:
+            matrix = matrix[rng.permutation(rows)]
+        return matrix
 
     def __iter__(self) -> Iterator[int]:
-        index_chunks, chunk_indices = self.shuffle_chunks()
-        indices = [index_chunks[i][self.rank] for i in chunk_indices]
-        assert len(indices) == self.num_samples
-        return iter(indices)
+        return iter(self._assignment_matrix()[:, self.rank].tolist())
 
     def __len__(self) -> int:
         return self.num_samples
@@ -128,8 +104,13 @@ class LoadBalancingDistributedSampler:
 
 class LoadBalancingDistributedBatchSampler:
     """Variable-size mini-batches on top of the load-balancing sampler
-    (reference ``load_balancing_data_loader.py:202+``); ``batch_fn`` maps a
-    rank's sample indices to a list of batches."""
+    (reference ``load_balancing_data_loader.py:202+``).
+
+    ``batch_fn(indices) -> list[list[int]]`` packs one rank's sample indices
+    into batches (e.g. token-budget packing).  Ranks can end up with
+    different batch counts; every rank must run the same number of steps, so
+    the shorter ranks wrap their batch list (or, with ``drop_last``, all
+    ranks truncate to the shortest)."""
 
     def __init__(self, sampler: LoadBalancingDistributedSampler, batch_fn, drop_last: bool = False):
         if not isinstance(sampler, LoadBalancingDistributedSampler):
@@ -144,18 +125,15 @@ class LoadBalancingDistributedBatchSampler:
         self.generate_batches()
 
     def generate_batches(self) -> None:
-        index_chunks, chunk_indices = self.sampler.shuffle_chunks()
-        batches = []
-        for rank in range(self.num_replicas):
-            sub_indices = [index_chunks[i][rank] for i in chunk_indices]
-            batches.append(self.batch_fn(sub_indices))
-        self.total_batch = (
-            max(len(b) for b in batches)
-            if not self.drop_last
-            else min(len(b) for b in batches)
-        )
+        matrix = self.sampler._assignment_matrix()
+        per_rank: List[List[List[int]]] = [
+            self.batch_fn(matrix[:, r].tolist()) for r in range(self.num_replicas)
+        ]
+        counts = [len(b) for b in per_rank]
+        self.total_batch = min(counts) if self.drop_last else max(counts)
         self.padded_batches = [
-            batch + batch[: self.total_batch - len(batch)] for batch in batches
+            (b * math.ceil(self.total_batch / len(b)))[: self.total_batch] if b else []
+            for b in per_rank
         ]
 
     def __iter__(self):

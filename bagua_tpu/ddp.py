@@ -290,6 +290,52 @@ class DistributedDataParallel:
 
     # -- convenience --------------------------------------------------------
 
+    def profile_bucket_order(self, state: TrainState, batch):
+        """Measure each bucket's gradient-readiness cost (seconds) with real
+        compiled executions — the TPU analog of the reference learning tensor
+        order from measured backward-hook spans (``autotune_service.py:274-294``)
+        rather than assuming the declaration order.
+
+        For every bucket a pruned step is jitted that computes *only* that
+        bucket's gradients (XLA dead-code-eliminates the rest of the backward
+        pass), and its wall time is measured after a compile warmup.  A bucket
+        whose tensors sit late in the backward pass (early in the forward)
+        costs more, so sorting buckets by this cost recovers the true
+        readiness order.  Returns ``times`` aligned with ``plan.specs``.
+
+        This is a profiling pass (one extra compile per bucket); run it once
+        at session start, like the reference's autotune warmup phase.
+        """
+        import time
+
+        assert self.plan is not None, "call init() first"
+        times = []
+        for spec in self.plan.specs:
+            nameset = frozenset(slot.name for slot in spec.slots)
+
+            def local_grads(state, batch, nameset=nameset):
+                params = _local(state.params)
+                grads = jax.grad(self.loss_fn)(params, batch)
+                flat = jax.tree_util.tree_flatten_with_path(grads)[0]
+                sel = [
+                    leaf for path, leaf in flat
+                    if jax.tree_util.keystr(path) in nameset
+                ]
+                return [l[None] for l in sel]
+
+            fn = jax.jit(
+                self.group.shard_map(
+                    local_grads,
+                    in_specs=(P(ALL_AXES), P(ALL_AXES)),
+                    out_specs=P(ALL_AXES),
+                )
+            )
+            jax.block_until_ready(fn(state, batch))  # compile + settle
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(state, batch))
+            times.append(time.perf_counter() - t0)
+        return times
+
     def shard_batch(self, local_batch):
         """Assemble the global batch from this process's local rows.
 
@@ -336,14 +382,23 @@ class AutotuneSession:
         # register the current plan's tensors
         decls = [td for bucket in ddp.plan.declarations() for td in bucket]
         self.client.register_tensors(model_name, decls)
-        # report the execution order implied by the plan (reference learns it
-        # from OTel tensor_ready spans; here the jitted step executes slots in
-        # plan order by construction)
         from bagua_tpu.observability import SpanRecorder
 
         self.spans = SpanRecorder()
-        self.spans.record_plan_order(ddp.plan)
-        self.spans.report_to_autotune(self.client, model_name)
+        # Until profile_and_report runs, the service falls back to the
+        # registration order — which IS the plan's order — so nothing is lost
+        # relative to round-1's (circular) plan-order report.
+        self.profiled = False
+
+    def profile_and_report(self, state, batch) -> None:
+        """Measure the real per-bucket gradient-readiness order and ship it
+        to the service (reference: OTel ``tensor_ready`` spans from backward
+        hooks, ``autotune_service.py:274-294``).  One extra compile per
+        bucket; call once when training starts (the Trainer does)."""
+        times = self.ddp.profile_bucket_order(state, batch)
+        self.spans.record_measured_order(self.ddp.plan, times)
+        self.spans.report_to_autotune(self.client, self.model_name)
+        self.profiled = True
 
     def tick(self, n_samples: int) -> None:
         """Call once per training step with the number of samples processed."""

@@ -5,4 +5,5 @@ from bagua_tpu.kernels.minmax_uint8 import (  # noqa: F401
     decompress_minmax_uint8,
     compress_minmax_uint8_pallas,
     decompress_minmax_uint8_pallas,
+    get_compressors,
 )

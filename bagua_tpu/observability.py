@@ -46,16 +46,17 @@ class SpanRecorder:
                 }
             )
 
-    def record_plan_order(self, plan) -> None:
-        """Derive a tensor partial order from the active bucket plan: slots
-        execute in bucket-then-offset order inside the jitted step (the
-        analog of learning order from backward-hook spans)."""
-        t = time.time()
-        i = 0
-        for spec in plan.specs:
-            for slot in spec.slots:
-                self.record("tensor_ready", slot.name, t + i * 1e-6, t + (i + 1) * 1e-6)
-                i += 1
+    def record_measured_order(self, plan, bucket_times) -> None:
+        """Convert measured per-bucket readiness costs (seconds, aligned with
+        ``plan.specs`` — see ``DistributedDataParallel.profile_bucket_order``)
+        into ``tensor_ready`` spans: a tensor's start time is its bucket's
+        measured cost, with a sub-microsecond offset keeping slots within a
+        bucket in a stable order.  The autotune service sorts by start time,
+        so cheap (early-ready) buckets come first."""
+        for spec, cost in zip(plan.specs, bucket_times):
+            for j, slot in enumerate(spec.slots):
+                start = cost + j * 1e-9
+                self.record("tensor_ready", slot.name, start, start + 1e-9)
 
     def drain(self) -> List[Dict]:
         with self._lock:

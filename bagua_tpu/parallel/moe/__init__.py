@@ -1,11 +1,15 @@
 """Expert-parallel Mixture of Experts (reference ``model_parallel/moe/``)."""
 
-from bagua_tpu.parallel.moe.sharded_moe import (  # noqa: F401
-    top1gating,
-    top2gating,
-    TopKGate,
-    MOELayer,
-    Experts,
+from bagua_tpu.parallel.moe.routing import (  # noqa: F401
+    Routing,
+    expert_capacity,
+    route_top1,
+    route_top2,
 )
-from bagua_tpu.parallel.moe.layer import MoE  # noqa: F401
+from bagua_tpu.parallel.moe.layer import (  # noqa: F401
+    Experts,
+    ExpertParallelFFN,
+    MoE,
+    Router,
+)
 from bagua_tpu.parallel.moe.utils import is_moe_param  # noqa: F401

@@ -1,10 +1,173 @@
-"""User-facing MoE module (reference ``model_parallel/moe/layer.py:22``)."""
+"""Expert-parallel MoE block: router + all-to-all token exchange + experts.
+
+User API is :class:`MoE` (reference ``model_parallel/moe/layer.py:22``); the
+machinery below it:
+
+* :class:`Router` — linear gate feeding :mod:`bagua_tpu.parallel.moe.routing`.
+* :class:`Experts` — the per-expert FFN stack, vmapped over local experts.
+* :class:`ExpertParallelFFN` — dispatch → all-to-all over the expert-parallel
+  mesh axes → expert compute → all-to-all back → combine (the reference's
+  MOELayer, ``sharded_moe.py:306-375``, with ``dist.all_to_all_single``
+  replaced by ``lax.all_to_all`` over whichever mesh axes are bound).
+
+``ep_size`` is declared statically — it fixes the *shape* of the expert
+parameters (each rank owns ``num_experts // ep_size`` experts) so ``init``
+can run outside ``shard_map``; at apply time the bound ``ep_axis`` axes must
+multiply to exactly ``ep_size``.
+"""
 
 from typing import Optional, Tuple, Union
 
 import flax.linen as nn
+import jax
+import jax.numpy as jnp
 
-from bagua_tpu.parallel.moe.sharded_moe import MOELayer
+from bagua_tpu.parallel.moe.routing import Routing, route_top1, route_top2
+
+
+def _bound_axes(axis_name) -> Tuple[str, ...]:
+    """The subset of ``axis_name`` actually bound by an enclosing shard_map."""
+    if axis_name is None:
+        return ()
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    bound = []
+    for a in axes:
+        try:
+            jax.lax.axis_size(a)
+            bound.append(a)
+        except NameError:
+            pass
+    return tuple(bound)
+
+
+class Router(nn.Module):
+    """Linear gate + top-k routing (reference ``TopKGate``,
+    ``sharded_moe.py:241-303``)."""
+
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True, used_token=None, rng=None) -> Routing:
+        if self.k not in (1, 2):
+            raise ValueError("Only top-1 and top-2 gatings are supported.")
+        logits = nn.Dense(self.num_experts, use_bias=False, dtype=jnp.float32)(tokens)
+        factor = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 1:
+            return route_top1(
+                logits, factor, self.min_capacity, used_token,
+                self.noisy_gate_policy if train else None, rng,
+            )
+        return route_top2(logits, factor, rng)
+
+
+class Experts(nn.Module):
+    """Per-expert FFN stack, vmapped over the local experts
+    (reference ``experts.py:16``)."""
+
+    hidden_dim: int
+    num_local_experts: int
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, x):
+        # x: (local_experts, tokens, model_dim)
+        dense = nn.vmap(
+            nn.Dense,
+            in_axes=0,
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )
+        h = dense(self.hidden_dim)(x)
+        h = getattr(jax.nn, self.activation)(h)
+        return dense(x.shape[-1])(h)
+
+
+class ExpertParallelFFN(nn.Module):
+    """Route tokens to experts sharded over the ``ep_axis`` mesh axes."""
+
+    num_experts: int
+    hidden_dim: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    ep_size: int = 1
+    ep_axis: Union[str, Tuple[str, ...], None] = ("inter", "intra")
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, rng=None):
+        orig_shape = x.shape
+        model_dim = x.shape[-1]
+        tokens = x.reshape(-1, model_dim)
+
+        if self.num_experts % self.ep_size != 0:
+            raise ValueError(
+                f"num_experts ({self.num_experts}) must divide evenly by "
+                f"ep_size ({self.ep_size})"
+            )
+        ep_axes = _bound_axes(self.ep_axis) if self.ep_size > 1 else ()
+        if self.ep_size > 1 and not self.is_initializing():
+            bound = 1
+            for a in ep_axes:
+                bound *= jax.lax.axis_size(a)
+            if bound != self.ep_size:
+                raise ValueError(
+                    f"ep_size={self.ep_size} but the bound mesh axes "
+                    f"{ep_axes} have total size {bound}"
+                )
+        local_experts = self.num_experts // self.ep_size
+
+        routing = Router(
+            num_experts=self.num_experts,
+            k=self.k,
+            capacity_factor=self.capacity_factor,
+            eval_capacity_factor=self.eval_capacity_factor,
+            min_capacity=self.min_capacity,
+            noisy_gate_policy=self.noisy_gate_policy,
+            name="gate",
+        )(tokens, train=train, rng=rng)
+
+        # (S,E,C) x (S,M) -> (E,C,M), grouped by owning rank
+        outbound = jnp.einsum(
+            "sec,sm->ecm", routing.dispatch_mask.astype(tokens.dtype), tokens
+        ).reshape(self.ep_size, local_experts, -1, model_dim)
+        if ep_axes:
+            # chunk g of every rank's tokens travels to the rank owning
+            # expert group g (reference dist.all_to_all_single,
+            # sharded_moe.py:77-91)
+            outbound = jax.lax.all_to_all(
+                outbound, ep_axes, split_axis=0, concat_axis=0, tiled=True
+            ).reshape(self.ep_size, local_experts, -1, model_dim)
+        expert_in = jnp.moveaxis(outbound, 0, 1).reshape(local_experts, -1, model_dim)
+
+        expert_out = Experts(
+            hidden_dim=self.hidden_dim,
+            num_local_experts=local_experts,
+            name="experts",
+        )(expert_in)
+
+        inbound = jnp.moveaxis(
+            expert_out.reshape(local_experts, self.ep_size, -1, model_dim), 0, 1
+        )
+        if ep_axes:
+            inbound = jax.lax.all_to_all(
+                inbound, ep_axes, split_axis=0, concat_axis=0, tiled=True
+            )
+        inbound = inbound.reshape(self.num_experts, -1, model_dim)
+
+        out = jnp.einsum(
+            "sec,ecm->sm", routing.combine_weights.astype(tokens.dtype), inbound
+        )
+        self.sow("intermediates", "l_aux", routing.balance_loss)
+        self.sow("intermediates", "exp_counts", routing.tokens_per_expert)
+        return out.reshape(orig_shape), routing.balance_loss
 
 
 class MoE(nn.Module):
@@ -28,7 +191,7 @@ class MoE(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True, rng=None):
-        return MOELayer(
+        return ExpertParallelFFN(
             num_experts=self.num_experts,
             hidden_dim=self.hidden_size,
             k=self.k,

@@ -1,0 +1,154 @@
+"""Token-to-expert routing (gating) for the expert-parallel MoE block.
+
+Computes the same routing function as the reference's DeepSpeed-derived
+gating (``model_parallel/moe/sharded_moe.py:93-239``): softmax router,
+top-1/top-2 expert choice, per-expert capacity truncation, load-balancing
+auxiliary loss, and dense (tokens, experts, capacity) combine/dispatch
+tensors.  The structure here is its own: both routers share three primitives
+— :func:`_claim_slots` (capacity-limited slot assignment via masked cumsum),
+:func:`_combine` (slot one-hots folded into the combine tensor) and
+:func:`_balance_loss` — and return a :class:`Routing` record instead of a
+bare tuple.
+
+One deliberate deviation, as in round 1: the reference's top-1 capacity
+tie-break draws uniform noise from a hidden global RNG; randomness is
+explicit here, so pass ``rng`` to randomize slot claims (``rng=None`` claims
+in token-position order, the rule top-2 always uses).
+"""
+
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class Routing(NamedTuple):
+    """Routing decision for one batch of tokens.
+
+    combine_weights/dispatch_mask have shape (tokens, experts, capacity);
+    tokens_per_expert is the pre-truncation demand histogram (int32, (E,)).
+    """
+
+    balance_loss: jnp.ndarray
+    combine_weights: jnp.ndarray
+    dispatch_mask: jnp.ndarray
+    tokens_per_expert: jnp.ndarray
+
+
+def expert_capacity(num_tokens: int, num_experts: int, factor: float, k: int = 1,
+                    floor: int = 0) -> int:
+    """Slots each expert can accept: ``ceil(k * tokens/experts * factor)``,
+    at least ``floor``."""
+    return max(int(math.ceil(k * num_tokens / num_experts * factor)), floor)
+
+
+def _balance_loss(router_probs, chosen_mask, num_experts: int, scale: float):
+    """Mean router probability x mean routed fraction, summed over experts —
+    pushes the router toward uniform expert load."""
+    prob_share = jnp.mean(router_probs, axis=0)
+    routed_share = jnp.mean(chosen_mask, axis=0)
+    return jnp.sum(prob_share * routed_share) * scale
+
+
+def _claim_slots(chosen_mask, capacity: int, *, start_at=None, priority=None):
+    """Assign capacity slots within each expert column.
+
+    Tokens claim slots in position order (masked cumsum), or — when a
+    ``priority`` array is given — the ``capacity`` highest-priority tokens
+    win.  ``start_at`` (per-expert, e.g. the top-1 column's demand) offsets
+    the slot numbering for second-choice tokens.  Returns
+    ``(kept_mask, slot_of_token)``: the mask with over-capacity tokens
+    dropped, and each surviving token's slot index (int32, (S,))."""
+    if priority is not None:
+        ranked = chosen_mask * priority
+        kth = jnp.sort(ranked, axis=0)[-capacity][None, :]
+        chosen_mask = chosen_mask * ((ranked >= jnp.maximum(kth, 1e-38)) & (chosen_mask > 0))
+        slots = jnp.cumsum(chosen_mask, axis=0) - 1
+    else:
+        slots = jnp.cumsum(chosen_mask, axis=0) - 1
+        if start_at is not None:
+            slots = slots + start_at[None, :]
+        chosen_mask = chosen_mask * (slots < capacity)
+        slots = jnp.cumsum(chosen_mask, axis=0) - 1
+        if start_at is not None:
+            slots = slots + start_at[None, :]
+    slot_of_token = jnp.sum(slots * chosen_mask, axis=1).astype(jnp.int32)
+    return chosen_mask, slot_of_token
+
+
+def _combine(weight_of_token, chosen_mask, slot_of_token, capacity: int):
+    """(S,) weights + (S,E) mask + (S,) slots -> (S,E,C) combine tensor."""
+    slot_one_hot = jax.nn.one_hot(slot_of_token, capacity, dtype=jnp.float32)
+    return jnp.einsum("se,sc->sec", weight_of_token[:, None] * chosen_mask, slot_one_hot)
+
+
+def route_top1(
+    logits: jnp.ndarray,
+    capacity_factor: float,
+    min_capacity: int = 4,
+    used_token: Optional[jnp.ndarray] = None,
+    noisy_gate_policy: Optional[str] = None,
+    rng: Optional[jax.Array] = None,
+) -> Routing:
+    """Top-1 routing (reference ``sharded_moe.py:93-165``): every token goes
+    to its argmax expert, capacity-truncated."""
+    probs = jax.nn.softmax(logits, axis=1)
+    num_tokens, num_experts = probs.shape
+    capacity = expert_capacity(num_tokens, num_experts, capacity_factor, floor=min_capacity)
+
+    if noisy_gate_policy == "RSample":
+        if rng is None:
+            raise ValueError("noisy_gate_policy='RSample' requires an rng key")
+        choice_scores = logits + jax.random.gumbel(rng, logits.shape, dtype=logits.dtype)
+    else:
+        choice_scores = probs
+    chosen = jax.nn.one_hot(jnp.argmax(choice_scores, axis=1), num_experts, dtype=jnp.float32)
+    if used_token is not None:
+        chosen = used_token[:, None] * chosen
+
+    demand = jnp.sum(chosen, axis=0).astype(jnp.int32)
+    loss = _balance_loss(probs, chosen, num_experts, scale=float(num_experts))
+
+    priority = None
+    if rng is not None:
+        # random capacity tie-break, like the reference's uniform sample
+        priority = jax.random.uniform(jax.random.fold_in(rng, 1), chosen.shape)
+    kept, slot_of_token = _claim_slots(chosen, capacity, priority=priority)
+
+    weight_of_token = jnp.sum(probs * kept, axis=1)
+    combine = _combine(weight_of_token, kept, slot_of_token, capacity)
+    return Routing(loss, combine, combine > 0, demand)
+
+
+def route_top2(
+    logits: jnp.ndarray, capacity_factor: float, rng: Optional[jax.Array] = None
+) -> Routing:
+    """Top-2 routing (reference ``sharded_moe.py:168-239``): each token's two
+    best experts share it, with renormalized weights; second choices queue
+    behind every first choice in the capacity count."""
+    probs = jax.nn.softmax(logits, axis=1)
+    num_tokens, num_experts = probs.shape
+    capacity = expert_capacity(num_tokens, num_experts, capacity_factor, k=2)
+
+    first = jax.nn.one_hot(jnp.argmax(probs, axis=1), num_experts, dtype=jnp.float32)
+    second_scores = logits if rng is None else (
+        logits + jax.random.gumbel(rng, logits.shape, dtype=logits.dtype)
+    )
+    second_scores = jnp.where(first > 0, -jnp.inf, second_scores)
+    second = jax.nn.one_hot(jnp.argmax(second_scores, axis=1), num_experts, dtype=jnp.float32)
+
+    demand = jnp.sum(first, axis=0).astype(jnp.int32)
+    # top-2 scaling: mean over experts of (prob share x routed share) x E^2
+    loss = jnp.mean(jnp.mean(probs, axis=0) * jnp.mean(first, axis=0)) * num_experts ** 2
+
+    kept1, slot1 = _claim_slots(first, capacity)
+    kept2, slot2 = _claim_slots(second, capacity, start_at=jnp.sum(first, axis=0))
+
+    w1 = jnp.einsum("se,se->s", probs, kept1)
+    w2 = jnp.einsum("se,se->s", probs, kept2)
+    norm = jnp.clip(w1 + w2, jnp.finfo(probs.dtype).eps, None)
+    combine = _combine(w1 / norm, kept1, slot1, capacity) + _combine(
+        w2 / norm, kept2, slot2, capacity
+    )
+    return Routing(loss, combine, combine > 0, demand)

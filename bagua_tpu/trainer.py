@@ -76,6 +76,13 @@ class Trainer:
         for i, batch in enumerate(batches):
             if n_steps is not None and i >= n_steps:
                 break
+            if self._session and not self._session.profiled:
+                # one-time measured execution-order profile for autotune
+                try:
+                    self._session.profile_and_report(state, batch)
+                except Exception as e:
+                    logger.warning("bucket-order profiling failed: %s", e)
+                    self._session.profiled = True
             n_samples = jax.tree.leaves(batch)[0].shape[0]
             with self.timer.step(n_samples):
                 state, losses = self.ddp.train_step(state, batch)

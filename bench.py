@@ -6,62 +6,22 @@ batch 32 per worker, synthetic ImageNet-shaped data) whose CI floor is
 185 img/sec/GPU for gradient_allreduce
 (``.buildkite/scripts/benchmark_master.sh:81-83``).
 
-Prints JSON lines of the form
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N/185}
-— a provisional line as soon as the first timed step lands, then a final
-line when measurement completes (the last line is authoritative).  Progress
-goes to stderr so a killed run still shows where it was.
+Emission protocol (shared with bench_bert.py, see ``_bench_common``): JSON
+lines on stdout, last line authoritative; provisional line after the first
+timed step; watchdog guarantees a parseable line within the deadline.
 """
 
-import json
 import os
 import sys
-import threading
 import time
 
-_T0 = time.perf_counter()
-_EMITTED = threading.Lock()
-_emitted_any = False
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from _bench_common import BenchHarness
 
-def _watchdog():
-    """Guarantee a parseable JSON line within the deadline even if the TPU
-    backend init (a tunneled device here) hangs indefinitely — that exact
-    hang produced round 1's rc=124 artifact with no output."""
-    # Fires one minute after the measurement loop's soft deadline, so a
-    # healthy run always emits its final line first.
-    deadline = float(os.environ.get("BENCH_DEADLINE_SEC", "420")) + 60.0
-    time.sleep(deadline)
-    with _EMITTED:
-        if _emitted_any:
-            os._exit(0)  # provisional line already out; let it stand
-        print(
-            json.dumps(
-                {
-                    "metric": "vgg16_img_per_sec_per_chip",
-                    "value": 0.0,
-                    "unit": "img/s/chip",
-                    "vs_baseline": 0.0,
-                    "error": f"no measurement within {deadline:.0f}s "
-                    "(device backend init or compile hang)",
-                }
-            ),
-            flush=True,
-        )
-    os._exit(3)
-
-
-threading.Thread(target=_watchdog, daemon=True).start()
-
-# Persistent compilation cache: a cold process re-running this benchmark
-# skips the VGG16 compile (tens of seconds on a tunneled TPU backend).
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+HARNESS = BenchHarness("vgg16_img_per_sec_per_chip", "img/s/chip")
 
 import jax
-
-jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -73,29 +33,16 @@ VGG16_TRAIN_GFLOP_PER_IMG = 15.5 * 3
 PEAK_BF16_TFLOPS = {"tpu": 197.0, "axon": 197.0}  # v5e MXU peak; cpu excluded
 
 
-def _note(msg):
-    print(f"[bench +{time.perf_counter() - _T0:5.1f}s] {msg}", file=sys.stderr, flush=True)
-
-
 def _emit(img_per_sec_per_chip, provisional):
-    global _emitted_any
-    platform = jax.devices()[0].platform
-    peak = PEAK_BF16_TFLOPS.get(platform)
-    line = {
-        "metric": "vgg16_img_per_sec_per_chip",
-        "value": round(img_per_sec_per_chip, 2),
-        "unit": "img/s/chip",
-        "vs_baseline": round(img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+    extra = {
+        "vs_baseline": round(img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3)
     }
+    peak = PEAK_BF16_TFLOPS.get(jax.devices()[0].platform)
     if peak:
-        line["mfu"] = round(
+        extra["mfu"] = round(
             img_per_sec_per_chip * VGG16_TRAIN_GFLOP_PER_IMG / (peak * 1e3), 3
         )
-    if provisional:
-        line["provisional"] = True
-    with _EMITTED:
-        _emitted_any = True
-        print(json.dumps(line), flush=True)
+    HARNESS.emit(img_per_sec_per_chip, provisional=provisional, extra=extra)
 
 
 def main():
@@ -104,8 +51,8 @@ def main():
     from bagua_tpu.ddp import DistributedDataParallel
     from bagua_tpu.models.vgg import init_vgg16, vgg_loss_fn
 
-    deadline = _T0 + float(os.environ.get("BENCH_DEADLINE_SEC", "420"))
-    _note(f"jax ready: {len(jax.devices())} {jax.devices()[0].platform} device(s)")
+    deadline = HARNESS.t0 + float(os.environ.get("BENCH_DEADLINE_SEC", "420"))
+    HARNESS.note(f"jax ready: {len(jax.devices())} {jax.devices()[0].platform} device(s)")
 
     group = bagua_tpu.init_process_group()
     n = group.size
@@ -123,7 +70,7 @@ def main():
         process_group=group,
     )
     state = ddp.init(params)
-    _note("model + DDP state initialized")
+    HARNESS.note("model + DDP state initialized")
 
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(global_batch, 224, 224, 3).astype(np.float32))
@@ -132,7 +79,7 @@ def main():
     # Warmup: compile + one settled step.
     state, losses = ddp.train_step(state, (x, y))
     jax.block_until_ready(losses)
-    _note("compile + warmup step done")
+    HARNESS.note("compile + warmup step done")
 
     # First timed step -> provisional number immediately.
     t0 = time.perf_counter()
@@ -140,7 +87,7 @@ def main():
     jax.block_until_ready(losses)
     first = time.perf_counter() - t0
     _emit(global_batch / first / n, provisional=True)
-    _note(f"first timed step: {first * 1e3:.0f} ms")
+    HARNESS.note(f"first timed step: {first * 1e3:.0f} ms")
 
     # Measured run: as many iters as the deadline allows, up to 12.
     n_iters = 0
@@ -150,7 +97,7 @@ def main():
         n_iters += 1
     jax.block_until_ready(losses)
     elapsed = time.perf_counter() - t0
-    _note(f"measured {n_iters} steps in {elapsed:.2f}s")
+    HARNESS.note(f"measured {n_iters} steps in {elapsed:.2f}s")
 
     _emit(global_batch * n_iters / elapsed / n, provisional=False)
 

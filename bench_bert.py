@@ -1,24 +1,50 @@
 #!/usr/bin/env python3
 """Secondary benchmark: BERT-Large MLM training throughput per chip
 (the reference's second headline workload, ``README.md:50-53``; ByteGrad
-config from BASELINE.json).  Prints ONE JSON line like bench.py."""
+config from BASELINE.json).
 
-import json
+Emission protocol shared with bench.py (see ``_bench_common``).  Also
+compares the ByteGrad compression hot path with the Pallas TPU kernels vs
+the fused-jnp implementation and reports which one actually runs faster.
+"""
+
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _bench_common import BenchHarness
+
+HARNESS = BenchHarness("bert_large_mlm_samples_per_sec_per_chip", "samples/s/chip")
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
 
+# BERT-Large ~334M params incl. MLM head; fwd+bwd ~= 6 * params FLOPs/token.
+TRAIN_GFLOP_PER_SAMPLE = 6 * 334e6 * 128 / 1e9
+PEAK_BF16_TFLOPS = {"tpu": 197.0, "axon": 197.0}
 
-def main():
+
+def _emit(sps, provisional=False, extra=None):
+    extra = dict(extra or {})
+    extra.setdefault("vs_baseline", None)
+    extra["config"] = "seq128 batch32/chip bytegrad bf16"
+    peak = PEAK_BF16_TFLOPS.get(jax.devices()[0].platform)
+    if peak:
+        extra["mfu"] = round(sps * TRAIN_GFLOP_PER_SAMPLE / (peak * 1e3), 3)
+    HARNESS.emit(sps, provisional=provisional, extra=extra)
+
+
+def run(use_pallas, n_iters):
     import bagua_tpu
     from bagua_tpu.algorithms import Algorithm
     from bagua_tpu.ddp import DistributedDataParallel
     from bagua_tpu.models.bert import BertForPreTraining, bert_large_config, mlm_loss_fn
 
-    group = bagua_tpu.init_process_group()
+    group = bagua_tpu.get_default_group()
     n = group.size
     seq, per_chip_batch = 128, 32
 
@@ -26,7 +52,8 @@ def main():
     model = BertForPreTraining(cfg)
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, seq), jnp.int32))["params"]
     ddp = DistributedDataParallel(
-        mlm_loss_fn(model), optax.sgd(1e-3), Algorithm.init("bytegrad"), process_group=group
+        mlm_loss_fn(model), optax.sgd(1e-3),
+        Algorithm.init("bytegrad", use_pallas=use_pallas), process_group=group,
     )
     state = ddp.init(params)
 
@@ -35,28 +62,47 @@ def main():
     x = jnp.asarray(rng.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
     y = jnp.asarray(rng.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
 
-    for _ in range(3):
-        state, losses = ddp.train_step(state, (x, y))
+    state, losses = ddp.train_step(state, (x, y))
     jax.block_until_ready(losses)
+    HARNESS.note(f"compile + warmup done (pallas={use_pallas})")
 
-    n_iters = 15
+    t0 = time.perf_counter()
+    state, losses = ddp.train_step(state, (x, y))
+    jax.block_until_ready(losses)
+    first = bs / (time.perf_counter() - t0) / n
+
     t0 = time.perf_counter()
     for _ in range(n_iters):
         state, losses = ddp.train_step(state, (x, y))
     jax.block_until_ready(losses)
-    elapsed = time.perf_counter() - t0
+    sps = bs * n_iters / (time.perf_counter() - t0) / n
+    return first, sps
 
-    sps = bs * n_iters / elapsed / n
-    print(
-        json.dumps(
-            {
-                "metric": "bert_large_mlm_samples_per_sec_per_chip",
-                "value": round(sps, 2),
-                "unit": "samples/s/chip",
-                "vs_baseline": None,
-                "config": "seq128 batch32/chip bytegrad bf16",
-            }
-        )
+
+def main():
+    import bagua_tpu
+
+    HARNESS.note(f"jax ready: {len(jax.devices())} {jax.devices()[0].platform} device(s)")
+    bagua_tpu.init_process_group()
+    on_tpu = jax.devices()[0].platform != "cpu"
+
+    first, sps_jnp = run(use_pallas=False, n_iters=10)
+    _emit(max(first, sps_jnp), provisional=True, extra={"compressor": "jnp"})
+    HARNESS.note(f"jnp compressor: {sps_jnp:.1f} samples/s/chip")
+
+    sps_pallas = None
+    if on_tpu:
+        _, sps_pallas = run(use_pallas=True, n_iters=10)
+        HARNESS.note(f"pallas compressor: {sps_pallas:.1f} samples/s/chip")
+
+    best = max(sps_jnp, sps_pallas or 0.0)
+    _emit(
+        best,
+        extra={
+            "compressor": "pallas" if sps_pallas and sps_pallas >= sps_jnp else "jnp",
+            "samples_per_sec_jnp": round(sps_jnp, 2),
+            "samples_per_sec_pallas": round(sps_pallas, 2) if sps_pallas else None,
+        },
     )
 
 

@@ -26,32 +26,34 @@ def main():
     p.add_argument("--steps", type=int, default=200)
     args = p.parse_args()
 
-    bagua_tpu.init_process_group()
-    trainer = Trainer(
+    from bagua_tpu.distributed import init_from_env
+
+    init_from_env()  # launcher-exported env (multi-host ready); local fallback
+    with Trainer(
         mse_loss,
         optax.adam(1e-3),
         Algorithm.init("gradient_allreduce"),
         ckpt_dir=args.ckpt_dir,
         ckpt_interval=50,
         watchdog_timeout_s=120.0,
-    )
-    params = init_mlp(jax.random.PRNGKey(0), [32, 64, 8])
-    state = trainer.init_state(params)
-    start = int(state.step[0])
-    print(f"starting at step {start}")
+    ) as trainer:
+        params = init_mlp(jax.random.PRNGKey(0), [32, 64, 8])
+        state = trainer.init_state(params)
+        start = int(state.step[0])
+        print(f"starting at step {start}")
 
-    rng = np.random.RandomState(0)
-    n = bagua_tpu.get_default_group().size
+        rng = np.random.RandomState(0)
+        n = bagua_tpu.get_default_group().size
 
-    def batches():
-        for _ in range(args.steps - start):
-            yield (
-                jnp.asarray(rng.randn(16 * n, 32), jnp.float32),
-                jnp.asarray(rng.randn(16 * n, 8), jnp.float32),
-            )
+        def batches():
+            for _ in range(args.steps - start):
+                yield (
+                    jnp.asarray(rng.randn(16 * n, 32), jnp.float32),
+                    jnp.asarray(rng.randn(16 * n, 8), jnp.float32),
+                )
 
-    state = trainer.fit(state, batches(), log_every=50)
-    print(f"done at step {int(state.step[0])}")
+        state = trainer.fit(state, batches(), log_every=50)
+        print(f"done at step {int(state.step[0])}")
 
 
 if __name__ == "__main__":

@@ -156,3 +156,78 @@ def test_autotune_session_rebuckets(group):
         assert np.isfinite(np.asarray(losses)).all()
     finally:
         srv.shutdown()
+
+
+def test_profile_bucket_order_measures_backward_depth(group):
+    """Measured bucket costs reflect real backward depth: the first layer's
+    gradients (deepest in backprop) cost more than the last layer's — the
+    measurement the circular plan-order report could never make."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+    params = init_mlp(jax.random.PRNGKey(0), [64, 768, 768, 768, 768, 8])
+    ddp = DistributedDataParallel(
+        mse_loss, optax.sgd(0.05), GradientAllReduceAlgorithm(), process_group=group,
+        bucket_size_bytes=1,  # one leaf per bucket
+    )
+    state = ddp.init(params)
+    rng = np.random.RandomState(0)
+    batch = (
+        jnp.asarray(rng.randn(64, 64), np.float32),
+        jnp.asarray(rng.randn(64, 8), np.float32),
+    )
+    t1 = ddp.profile_bucket_order(state, batch)
+    t2 = ddp.profile_bucket_order(state, batch)
+    times = [min(a, b) for a, b in zip(t1, t2)]  # noise floor
+
+    def bucket_of(fragment):
+        for i, spec in enumerate(ddp.plan.specs):
+            if any(fragment in slot.name and "'w'" in slot.name for slot in spec.slots):
+                return i
+        raise AssertionError(fragment)
+
+    assert times[bucket_of("layer0")] > times[bucket_of("layer4")], times
+
+
+def test_session_profile_reports_measured_order(group):
+    """profile_and_report ships measured spans; the service's learned partial
+    order puts early-ready (late-layer) tensors first even though they were
+    declared last."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.ddp import AutotuneSession, DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+    service = AutotuneService(world_size=1, autotune_level=1)
+    srv = start_autotune_server(service, port=0)
+    try:
+        client = AutotuneClient(port=srv.server_address[1])
+        params = init_mlp(jax.random.PRNGKey(0), [64, 768, 768, 768, 768, 8])
+        ddp = DistributedDataParallel(
+            mse_loss, optax.sgd(0.05), GradientAllReduceAlgorithm(),
+            process_group=group, bucket_size_bytes=1,
+        )
+        state = ddp.init(params)
+        session = AutotuneSession(ddp, "prof_model", client=client)
+        rng = np.random.RandomState(0)
+        batch = (
+            jnp.asarray(rng.randn(64, 64), np.float32),
+            jnp.asarray(rng.randn(64, 8), np.float32),
+        )
+        session.profile_and_report(state, batch)
+        assert session.profiled
+        order = service._managers["prof_model"].tensor_partial_order
+        assert order, "no measured order arrived at the service"
+        w0 = next(k for k in order if "layer0" in k and "'w'" in k)
+        w4 = next(k for k in order if "layer4" in k and "'w'" in k)
+        assert order[w4] < order[w0]  # late layer ready earlier
+    finally:
+        srv.shutdown()

@@ -101,6 +101,11 @@ def test_gather(group):
     x = stacked_input()
     out = np.asarray(bagua_tpu.gather(jnp.asarray(x), dst=5))
     np.testing.assert_allclose(out[5], x.reshape(-1), rtol=1e-6)
+    # non-dst ranks receive zeros, never fabricated data (the reference
+    # leaves their recv buffers untouched)
+    for r in range(8):
+        if r != 5:
+            assert not np.any(out[r])
 
 
 def test_barrier(group):

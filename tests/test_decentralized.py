@@ -196,3 +196,26 @@ def test_low_precision_decentralized_matches_oracle(group):
         [np.asarray(ddp.plan.bucketize(ddp.params_unstacked(state, r))[0]) for r in range(N)]
     )
     np.testing.assert_allclose(got, w, rtol=2e-4, atol=2e-4)
+
+
+def test_flat_shift_one_hlo_has_no_all_gather(group):
+    """The flat (combined-axes) shift_one exchange must lower to point-to-point
+    collective-permutes, never an all-gather (VERDICT weak #4)."""
+    import optax
+
+    from bagua_tpu.algorithms.decentralized import DecentralizedAlgorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+    params = init_mlp(jax.random.PRNGKey(0), [6, 8, 2])
+    ddp = DistributedDataParallel(
+        mse_loss, optax.sgd(0.05),
+        DecentralizedAlgorithm(hierarchical=False, peer_selection_mode="shift_one"),
+        process_group=group,
+    )
+    state = ddp.init(params)
+    fn = ddp._step_fns.get("default") or ddp._build_step("default")
+    batch = (jnp.zeros((8, 6), jnp.float32), jnp.zeros((8, 2), jnp.float32))
+    hlo = jax.jit(fn).lower(state, batch).compile().as_text()
+    assert "collective-permute" in hlo
+    assert "all-gather" not in hlo, "shift_one still lowers to an all-gather"

@@ -11,7 +11,7 @@ from jax.sharding import PartitionSpec as P
 
 from bagua_tpu.communication import ALL_AXES
 from bagua_tpu.ddp import DistributedDataParallel
-from bagua_tpu.parallel.moe import MoE, top1gating, top2gating
+from bagua_tpu.parallel.moe import MoE, route_top1, route_top2
 from bagua_tpu.parallel.moe.utils import split_moe_params
 
 N = 8
@@ -19,11 +19,11 @@ MODEL_DIM = 8
 NUM_EXPERTS = 8
 
 
-def test_top1gating_invariants():
+def test_route_top1_invariants():
     rng = np.random.RandomState(0)
     S, E = 16, 4
     logits = jnp.asarray(rng.randn(S, E).astype(np.float32))
-    l_aux, combine, dispatch, exp_counts = top1gating(logits, capacity_factor=1.0, min_capacity=2)
+    l_aux, combine, dispatch, exp_counts = route_top1(logits, capacity_factor=1.0, min_capacity=2)
     C = combine.shape[-1]
     assert combine.shape == (S, E, C) and dispatch.shape == (S, E, C)
     # each token goes to at most one (expert, slot)
@@ -41,11 +41,11 @@ def test_top1gating_invariants():
     np.testing.assert_array_equal(np.asarray(exp_counts), np.asarray(mask1.sum(0), np.int32))
 
 
-def test_top2gating_invariants():
+def test_route_top2_invariants():
     rng = np.random.RandomState(1)
     S, E = 16, 4
     logits = jnp.asarray(rng.randn(S, E).astype(np.float32))
-    l_aux, combine, dispatch, exp_counts = top2gating(logits, capacity_factor=1.0)
+    l_aux, combine, dispatch, exp_counts = route_top2(logits, capacity_factor=1.0)
     # each token dispatched to at most 2 slots, combine weights sum to ~1
     per_token = jnp.sum(dispatch, axis=(1, 2))
     assert int(per_token.max()) <= 2
@@ -59,7 +59,7 @@ def test_top2gating_invariants():
 def test_top1_capacity_truncation():
     # all tokens pick expert 0: capacity must cut the tail
     logits = jnp.tile(jnp.asarray([[10.0, 0.0]]), (8, 1))
-    l_aux, combine, dispatch, exp_counts = top1gating(logits, capacity_factor=1.0, min_capacity=2)
+    l_aux, combine, dispatch, exp_counts = route_top1(logits, capacity_factor=1.0, min_capacity=2)
     C = combine.shape[-1]
     assert int(jnp.sum(dispatch)) == min(8, C)
     assert int(exp_counts[0]) == 8  # pre-capacity count

@@ -128,6 +128,7 @@ DDP_WORKER = textwrap.dedent(
     all_sums = multihost_utils.process_allgather(sums)
     assert all_sums.shape[0] == 2, all_sums.shape
     np.testing.assert_allclose(all_sums[0], all_sums[1], rtol=0, atol=0)
+    bagua_tpu.barrier()  # multi-host barrier path (cross-process device sync)
     print(f"proc {proc_id} DDP OK losses={losses_seen[-1]}")
     """
 )

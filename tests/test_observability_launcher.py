@@ -12,20 +12,24 @@ from bagua_tpu.observability import SpanRecorder, StepTimer, Watchdog
 from bagua_tpu.utils import SpeedMeter
 
 
-def test_span_recorder_plan_order():
+def test_span_recorder_measured_order():
+    """Measured per-bucket costs become tensor_ready spans whose start times
+    sort tensors into the measured readiness order (cheap buckets first)."""
     import jax.numpy as jnp
 
     from bagua_tpu.bucket import BucketPlan
 
     tree = {"a": jnp.zeros((4,)), "b": jnp.zeros((4,)), "c": jnp.zeros((4,))}
     plan = BucketPlan.from_tree(tree, bucket_size_bytes=1)
+    assert plan.num_buckets == 3
     rec = SpanRecorder()
-    rec.record_plan_order(plan)
+    rec.record_measured_order(plan, [0.03, 0.01, 0.02])  # bucket 1 is cheapest
     spans = rec.drain()
     assert len(spans) == 3
     assert [s["action"] for s in spans] == ["tensor_ready"] * 3
-    starts = [s["start_time"] for s in spans]
-    assert starts == sorted(starts)
+    by_start = [s["tensor_name"] for s in sorted(spans, key=lambda s: s["start_time"])]
+    slot_names = [spec.slots[0].name for spec in plan.specs]
+    assert by_start == [slot_names[1], slot_names[2], slot_names[0]]
     assert rec.drain() == []
 
 
