@@ -63,7 +63,24 @@ from bagua_tpu.algorithms.async_model_average import (  # noqa: F401,E402
 GlobalAlgorithmRegistry.register(
     "async",
     AsyncModelAverageAlgorithm,
-    "asynchronous model averaging with host-armed time-scheduled sync",
+    "asynchronous model averaging by a background averager thread",
+)
+
+
+class NoCommAlgorithm(Algorithm):
+    """No gradient communication: every stage is the identity.  Pair with an
+    optimizer that owns the communication itself (ZeRO-2's reduce-scatter,
+    ``contrib.zero.zero2_optimizer``), or use it to debug single-rank math
+    inside the distributed engine."""
+
+    def reify(self, process_group) -> AlgorithmImpl:
+        return AlgorithmImpl(process_group)
+
+
+GlobalAlgorithmRegistry.register(
+    "none",
+    NoCommAlgorithm,
+    "no communication (optimizer-owned comm, e.g. ZeRO-2, or debugging)",
 )
 
 #: algorithms whose schedule is wall-clock-driven (not bitwise-deterministic

@@ -450,9 +450,28 @@ def barrier(comm: Optional[BaguaProcessGroup] = None):
     device sync there)."""
     group = comm or get_default_group()
     if group.spans_processes:
-        from jax.experimental import multihost_utils
+        procs = {d.process_index for d in group.devices}
+        if len(procs) == jax.process_count():
+            from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("bagua_tpu_barrier")
+            multihost_utils.sync_global_devices("bagua_tpu_barrier")
+            return
+        # Group-scoped: a tiny collective over the group's own mesh, so
+        # processes OUTSIDE the group are not involved (a global sync here
+        # would deadlock against them).
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(group.mesh, P(ALL_AXES))
+        n_local = sum(
+            1 for d in group.devices if d.process_index == jax.process_index()
+        )
+        token = jax.make_array_from_process_local_data(
+            sharding, np.ones((n_local, 1), np.float32)
+        )
+        out = jax.jit(
+            jnp.sum, out_shardings=NamedSharding(group.mesh, P())
+        )(token)
+        jax.block_until_ready(out)
         return
     token = jnp.ones((group.size, 1), jnp.float32)
     jax.block_until_ready(allreduce(token, op=ReduceOp.SUM, comm=group))

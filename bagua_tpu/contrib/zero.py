@@ -1,24 +1,39 @@
-"""ZeRO-1: optimizer-state sharding over the data-parallel axes.
+"""ZeRO-1/2: optimizer-state (and gradient) sharding over the DP axes.
 
 Absent from the reference (SURVEY §2.4: "ZeRO-style sharded optimizer — no")
-but a natural capability of the mesh substrate: each rank keeps only its
-``1/n`` shard of the optimizer state, updates its shard of the parameters,
-and allgathers the updates.  Memory for Adam moments drops from ``2 x P`` to
-``2 x P / n`` per chip.
+but a natural capability of the mesh substrate.  Both stages are optax
+wrappers usable inside the DDP engine's shard_mapped step (their ``update``
+issues collectives, so they must run under the group's mesh — which is
+exactly where the engine calls them):
 
-Implemented as an optax wrapper usable inside the DDP engine's shard_mapped
-step (its ``update`` issues collectives, so it must run under the group's
-mesh — which is exactly where the engine calls it):
+* :func:`zero_optimizer` (ZeRO-1) — the algorithm still allreduces
+  gradients; each rank keeps only its ``1/n`` shard of the optimizer state,
+  updates its parameter shard, and allgathers the updates.  Adam moments
+  drop from ``2 x P`` to ``2 x P / n`` per chip.
 
-    ddp = DistributedDataParallel(
-        loss_fn,
-        zero_optimizer(optax.adam(1e-3), n_shards=group.size),
-        Algorithm.init("gradient_allreduce"),
-        process_group=group,
-    )
+      ddp = DistributedDataParallel(
+          loss_fn, zero_optimizer(optax.adam(1e-3), n_shards=group.size),
+          Algorithm.init("gradient_allreduce"), process_group=group)
 
-The wrapper is exact for elementwise optimizers: updates equal the unsharded
-optimizer's to float tolerance.
+* :func:`zero2_optimizer` (ZeRO-2) — gradient sharding too: RAW local
+  gradients are **reduce-scattered** straight into this rank's shard (the
+  full averaged-gradient buffer never materializes), the shard updates, and
+  the updates allgather.  Pair it with the ``"none"`` algorithm so gradients
+  are not also allreduced:
+
+      ddp = DistributedDataParallel(
+          loss_fn, zero2_optimizer(optax.adam(1e-3), n_shards=group.size),
+          Algorithm.init("none"), process_group=group)
+
+  Wire pattern: reduce_scatter + all_gather == one allreduce's bandwidth,
+  but grad memory is ``P / n`` and the reduce rides the same collective.
+
+ZeRO-3 (parameter sharding at rest, gather-at-use) is the FSDP pjit path in
+``bagua_tpu.parallel.fsdp`` — under GSPMD that is a sharding annotation, not
+an optimizer wrapper.
+
+All wrappers are exact for elementwise optimizers: updates equal the
+unsharded optimizer's to float tolerance.
 """
 
 from typing import NamedTuple, Union, Tuple
@@ -27,7 +42,14 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from bagua_tpu.communication import ALL_AXES, allgather_inplace, axis_size, rank_id
+from bagua_tpu.communication import (
+    ALL_AXES,
+    ReduceOp,
+    allgather_inplace,
+    axis_size,
+    rank_id,
+    reduce_scatter_inplace,
+)
 from bagua_tpu.utils import align_size
 
 
@@ -83,6 +105,62 @@ def zero_optimizer(
         gflat = jnp.pad(gflat, (0, padded - gflat.shape[0]))
         pflat = jnp.pad(pflat, (0, padded - pflat.shape[0]))
         g_shard = jax.lax.dynamic_slice(gflat, (me * shard,), (shard,))
+        p_shard = jax.lax.dynamic_slice(pflat, (me * shard,), (shard,))
+
+        upd_shard, inner_state = inner.update(g_shard, state, p_shard)
+        full = allgather_inplace(upd_shard, axis=axis, tiled=True)
+        full = full[: sum(l.size for l in jax.tree.leaves(params))]
+        return _unflatten_like(full, params), inner_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def zero2_optimizer(
+    inner: optax.GradientTransformation,
+    n_shards: int,
+    axis: Union[str, Tuple[str, ...]] = ALL_AXES,
+    average: bool = True,
+) -> optax.GradientTransformation:
+    """ZeRO-2: reduce-scatter RAW local gradients into this rank's shard,
+    update it with ``1/n`` of the optimizer state, allgather the updates.
+
+    ``updates`` passed in must be the rank's **local** (un-reduced)
+    gradients — pair with ``Algorithm.init("none")`` in the DDP engine so no
+    other gradient communication happens.  See the module docstring.
+    """
+
+    def shard_numel(params) -> int:
+        total = sum(l.size for l in jax.tree.leaves(params))
+        return align_size(total, n_shards) // n_shards
+
+    def init_fn(params):
+        proto = jnp.zeros((shard_numel(params),), jnp.float32)
+        return inner.init(proto)
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("zero2_optimizer requires params")
+        shard = shard_numel(params)
+        n = axis_size(axis)
+        if n != n_shards:
+            raise ValueError(
+                f"zero2_optimizer built for {n_shards} shards but bound axes "
+                f"{axis} have size {n}"
+            )
+        me = rank_id(axis)
+
+        from bagua_tpu.utils import flatten
+
+        gflat = flatten(jax.tree.leaves(updates))
+        pflat = flatten(jax.tree.leaves(params))
+        padded = shard * n_shards
+        gflat = jnp.pad(gflat, (0, padded - gflat.shape[0]))
+        pflat = jnp.pad(pflat, (0, padded - pflat.shape[0]))
+        # The reduce and the shard-slice are one collective: this rank
+        # receives only its 1/n chunk of the cross-rank reduction.
+        g_shard = reduce_scatter_inplace(
+            gflat, op=ReduceOp.AVG if average else ReduceOp.SUM, axis=axis
+        )
         p_shard = jax.lax.dynamic_slice(pflat, (me * shard,), (shard,))
 
         upd_shard, inner_state = inner.update(g_shard, state, p_shard)

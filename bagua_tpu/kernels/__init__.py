@@ -7,3 +7,8 @@ from bagua_tpu.kernels.minmax_uint8 import (  # noqa: F401
     decompress_minmax_uint8_pallas,
     get_compressors,
 )
+from bagua_tpu.kernels.flash_attention import (  # noqa: F401
+    block_attention,
+    block_attention_pallas,
+    merge_blocks,
+)

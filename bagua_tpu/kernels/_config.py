@@ -1,0 +1,23 @@
+"""Shared Pallas-kernel selection policy.
+
+Precedence: an explicit ``use_pallas`` argument wins; otherwise the kernel's
+env var (an emergency off/on switch operators can flip without code changes);
+otherwise backend auto-detection (Pallas on TPU, jnp elsewhere).
+"""
+
+import os
+
+
+def _truthy(v: str) -> bool:
+    return v.strip().lower() not in ("", "0", "false", "off", "no")
+
+
+def resolve_use_pallas(explicit, env_var: str) -> bool:
+    if explicit is not None:
+        return bool(explicit)
+    env = os.environ.get(env_var)
+    if env is not None:
+        return _truthy(env)
+    import jax
+
+    return jax.default_backend() not in ("cpu",)

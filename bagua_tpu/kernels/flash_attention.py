@@ -1,0 +1,193 @@
+"""Fused blockwise (flash) attention kernel for ring attention.
+
+The ring-attention loop (``bagua_tpu/parallel/ring_attention.py``) visits one
+K/V block per step and folds its contribution into an online-softmax carry.
+The expensive part of each visit is the block attention itself: materializing
+the ``(b, h, t_q, t_k)`` score matrix in HBM costs more bandwidth than every
+other tensor combined.  This module fuses it:
+
+* :func:`block_attention` — jnp reference: returns the block's
+  **unnormalized** contribution ``(o, l, m)`` (max-shifted weighted values,
+  normalizer, row max).  Carry-free, so the Pallas version needs no awkward
+  cross-call carry layouts.
+* :func:`block_attention_pallas` — Pallas TPU kernel, one grid step per
+  ``(batch x head)``: scores, masking, max, exp and both matmuls stay in
+  VMEM; only ``(t, d)`` tiles and ``(1, t)`` row-stat vectors touch HBM.
+* :func:`merge_blocks` — the cheap elementwise online-softmax combine of two
+  contributions (XLA fuses it; no kernel needed).
+
+TPU layout choice: scores are computed **transposed** — ``(t_k, t_q)`` via
+``dot(k, qᵀ)`` — so the row statistics (max/sum over keys) reduce over the
+*sublane* axis and land as ``(1, t_q)`` lane vectors, which Mosaic stores
+directly; reducing the minor axis would need an unsupported sublane↔lane
+transpose.  Masked entries use a large negative finite (``-1e30``), never
+``-inf``, so fully-masked columns stay NaN-free through the merges.
+
+Padding: ``t_q`` pads to 128 (lanes), ``t_k`` to 8 (sublanes), ``d`` to 128;
+padded keys are masked out, padded queries/channels sliced off after.
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG = -1e30  # large negative finite (a Python float: Pallas kernels cannot capture traced constants)
+
+
+# ---------------------------------------------------------------------------
+# jnp reference implementation
+# ---------------------------------------------------------------------------
+
+
+def block_attention(
+    qf: jnp.ndarray, k_blk: jnp.ndarray, v_blk: jnp.ndarray, mask: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One K/V block's unnormalized attention contribution.
+
+    Args:
+        qf: pre-scaled queries ``(b, t_q, h, d)`` float32.
+        k_blk, v_blk: the block ``(b, t_k, h, d)`` (any float dtype).
+        mask: ``(b, t_q, t_k)`` bool — True = attend (causal x key-padding
+            already combined by the caller).
+
+    Returns:
+        ``(o, l, m)``: ``o (b, h, t_q, d)`` = sum_k exp(s - m) v (unnormalized),
+        ``l (b, h, t_q)`` = sum_k exp(s - m), ``m (b, h, t_q)`` = row max
+        (``NEG`` where every key is masked).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+    s = jnp.where(mask[:, None], s, NEG)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(mask[:, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+    return o, l, m
+
+
+def merge_blocks(carry, block):
+    """Online-softmax combine of two unnormalized contributions."""
+    o, l, m = carry
+    o_b, l_b, m_b = block
+    m_new = jnp.maximum(m, m_b)
+    c = jnp.exp(m - m_new)
+    c_b = jnp.exp(m_b - m_new)
+    return o * c[..., None] + o_b * c_b[..., None], l * c + l_b * c_b, m_new
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+_LANE = 128
+_SUB = 8
+# VMEM budget for one grid step (v5e has ~16MB; leave headroom for Mosaic's
+# own buffers).  Above this the wrapper falls back to the jnp path, which
+# XLA tiles freely — correctness is identical either way.
+_VMEM_BUDGET_BYTES = 10 * 1024 * 1024
+
+
+def flash_block_supported(tq: int, tk: int, d: int) -> bool:
+    """Whether one (batch x head) block fits the kernel's VMEM budget."""
+    tq_p = tq + (-tq) % _LANE
+    tk_p = tk + (-tk) % _SUB
+    d_p = d + (-d) % _LANE
+    scores = tk_p * tq_p * 4 * 2  # s + p
+    tiles = (tq_p * d_p * 2 + tk_p * d_p * 2) * 4  # q, o, k, v
+    mask = tk_p * tq_p
+    return scores + tiles + mask <= _VMEM_BUDGET_BYTES
+
+
+def _pad_to(x, mult, axis):
+    pad = (-x.shape[axis]) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _block_flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, l_ref, m_ref):
+    q = q_ref[0]  # (t_q, d) f32, pre-scaled
+    k = k_ref[0].astype(jnp.float32)  # (t_k, d)
+    v = v_ref[0].astype(jnp.float32)  # (t_k, d)
+    mask = mask_ref[0]  # (t_k, t_q) int8, transposed layout
+
+    # scores transposed: queries along lanes, so row stats are (1, t_q)
+    s = jax.lax.dot_general(
+        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (t_k, t_q)
+    s = jnp.where(mask != 0, s, NEG)
+    m_blk = jnp.max(s, axis=0, keepdims=True)  # (1, t_q)
+    p = jnp.exp(s - m_blk)
+    p = jnp.where(mask != 0, p, 0.0)
+    l_blk = jnp.sum(p, axis=0, keepdims=True)  # (1, t_q)
+    o_blk = jax.lax.dot_general(
+        p, v, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (t_q, d)
+    o_ref[0] = o_blk
+    l_ref[0] = l_blk
+    m_ref[0] = m_blk
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def block_attention_pallas(
+    qf: jnp.ndarray,
+    k_blk: jnp.ndarray,
+    v_blk: jnp.ndarray,
+    mask: jnp.ndarray,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pallas version of :func:`block_attention` (same contract)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, tq, h, d = qf.shape
+    tk = k_blk.shape[1]
+    if not flash_block_supported(tq, tk, d):
+        return block_attention(qf, k_blk, v_blk, mask)
+
+    # (b, t, h, d) -> (b*h, t, d)
+    def to_bh(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], x.shape[3])
+
+    q3 = _pad_to(_pad_to(to_bh(qf.astype(jnp.float32)), _LANE, 1), _LANE, 2)
+    k3 = _pad_to(_pad_to(to_bh(k_blk), _SUB, 1), _LANE, 2)
+    v3 = _pad_to(_pad_to(to_bh(v_blk), _SUB, 1), _LANE, 2)
+    tq_p, d_p = q3.shape[1], q3.shape[2]
+    tk_p = k3.shape[1]
+
+    # mask: (b, t_q, t_k) -> transposed, head-expanded, padded (b*h, t_k, t_q)
+    mT = jnp.transpose(mask, (0, 2, 1)).astype(jnp.int8)  # (b, t_k, t_q)
+    mT = _pad_to(_pad_to(mT, _SUB, 1), _LANE, 2)  # padded keys/queries masked off
+    mT = jnp.broadcast_to(mT[:, None], (b, h, tk_p, tq_p)).reshape(b * h, tk_p, tq_p)
+
+    bh = b * h
+    o3, l3, m3 = pl.pallas_call(
+        _block_flash_kernel,
+        grid=(bh,),
+        in_specs=[
+            pl.BlockSpec((1, tq_p, d_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk_p, d_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk_p, d_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk_p, tq_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tq_p, d_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, tq_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, tq_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq_p, d_p), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, tq_p), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, tq_p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, mT)
+
+    o = o3[:, :tq, :d].reshape(b, h, tq, d)
+    l = l3[:, 0, :tq].reshape(b, h, tq)
+    m = m3[:, 0, :tq].reshape(b, h, tq)
+    return o, l, m

@@ -176,19 +176,15 @@ def get_compressors(use_pallas=None):
     """Pick the (compress, decompress) pair for the bytegrad/low-precision
     hot paths.
 
-    ``use_pallas=None`` auto-selects: the Pallas kernels on a TPU backend
-    (where Mosaic compiles them), the jnp pair elsewhere.  The env var
-    ``BAGUA_PALLAS_COMPRESSION`` ("0"/"1") overrides, and the Pallas entry
-    points themselves still fall back to jnp per-call when a chunk doesn't
-    satisfy TPU tiling — so every configuration is semantically identical.
+    Selection precedence (``kernels._config.resolve_use_pallas``): an
+    explicit ``use_pallas`` argument wins; else the env var
+    ``BAGUA_PALLAS_COMPRESSION`` (operator kill switch); else backend auto
+    (Pallas on TPU).  The Pallas entry points themselves still fall back to
+    jnp per-call when a chunk doesn't satisfy TPU tiling — so every
+    configuration is semantically identical.
     """
-    import os
+    from bagua_tpu.kernels._config import resolve_use_pallas
 
-    env = os.environ.get("BAGUA_PALLAS_COMPRESSION")
-    if env is not None:
-        use_pallas = env.strip().lower() not in ("", "0", "false", "off", "no")
-    if use_pallas is None:
-        use_pallas = jax.default_backend() not in ("cpu",)
-    if use_pallas:
+    if resolve_use_pallas(use_pallas, "BAGUA_PALLAS_COMPRESSION"):
         return compress_minmax_uint8_pallas, decompress_minmax_uint8_pallas
     return compress_minmax_uint8, decompress_minmax_uint8

@@ -7,17 +7,30 @@ K/V block at a time while the K/V blocks rotate around the ring via
 ``lax.ppermute`` (one neighbor send/recv per step, so the memory per chip is
 O(T/sp) and the collective traffic rides ICI neighbor links).
 
-Numerics use the online-softmax (flash-attention style) accumulation:
-running max ``m``, running normalizer ``l``, running output ``o``; each block
-contributes exactly once, so the result equals full attention on the
-gathered sequence up to float roundoff.
+Numerics use the online-softmax (flash-attention style) accumulation, with
+the per-block compute factored into ``kernels.flash_attention``:
+
+* ``block_attention`` — fused jnp (XLA) implementation;
+* ``block_attention_pallas`` — Pallas TPU kernel keeping the (t_q, t_k)
+  score matrix entirely in VMEM (``use_pallas=None`` auto-selects it on
+  TPU backends);
+* ``merge_blocks`` — the cheap elementwise combine.
+
+Each block contributes exactly once, so the result equals full attention on
+the gathered sequence up to float roundoff.
 """
 
-from functools import partial
 from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+
+from bagua_tpu.kernels.flash_attention import (
+    NEG,
+    block_attention,
+    block_attention_pallas,
+    merge_blocks,
+)
 
 
 def _axis_and_size(axis_name):
@@ -33,6 +46,16 @@ def _axis_and_size(axis_name):
     return tuple(bound), n
 
 
+def _pick_block_fn(use_pallas, interpret):
+    from bagua_tpu.kernels._config import resolve_use_pallas
+
+    if resolve_use_pallas(use_pallas, "BAGUA_PALLAS_ATTENTION"):
+        return lambda qf, k, v, mask: block_attention_pallas(
+            qf, k, v, mask, interpret=interpret
+        )
+    return block_attention
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -40,6 +63,8 @@ def ring_attention(
     axis_name: Union[str, Tuple[str, ...]] = "sp",
     causal: bool = False,
     kv_mask: Optional[jnp.ndarray] = None,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """Blockwise ring attention.
 
@@ -51,53 +76,53 @@ def ring_attention(
         kv_mask: optional key-padding mask for the LOCAL block, shape
             ``(batch, t_local)``; True = attend.  It rotates around the ring
             together with its K/V block.
+        use_pallas: force the Pallas TPU block kernel on/off (None = auto:
+            on for TPU backends).  ``interpret`` runs the kernel in
+            interpreter mode (CPU testing).
 
     Returns:
         Attention output for the local queries, same shape as ``q``.
     """
     axes, sp = _axis_and_size(axis_name)
+    b, t, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    qf = q.astype(jnp.float32) * scale
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, k.shape[1]), bool)
+    block_fn = _pick_block_fn(use_pallas, interpret)
+
     if sp == 1:
-        return _block_attention_local(q, k, v, causal=causal, kv_mask=kv_mask)
+        t_k = k.shape[1]
+        mask = jnp.broadcast_to(kv_mask[:, None, :], (b, t, t_k))
+        if causal:
+            mask = mask & (jnp.arange(t)[:, None] >= jnp.arange(t_k)[None, :])[None]
+        o, l, m = block_fn(qf, k, v, mask)
+        l = jnp.where(l == 0.0, 1.0, l)
+        out = (o / l[..., None]).astype(q.dtype)
+        return jnp.transpose(out, (0, 2, 1, 3))
 
     from bagua_tpu.communication import ppermute_shift, rank_id
 
     my = rank_id(axes)
-    b, t, h, d = q.shape
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
-    qf = (q * scale).astype(jnp.float32)
-    if kv_mask is None:
-        kv_mask = jnp.ones((b, t), bool)
 
     def body(i, carry):
         o, l, m, k_blk, v_blk, mask_blk = carry
         # block currently held came from rank (my - i) mod sp
         src = (my - i) % sp
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
-        s = jnp.where(mask_blk[:, None, None, :], s, -jnp.inf)
+        mask = jnp.broadcast_to(mask_blk[:, None, :], (b, t, t))
         if causal:
             q_pos = my * t + jnp.arange(t)
             k_pos = src * t + jnp.arange(t)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None], s, -jnp.inf)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        # guard fully-masked rows: exp(-inf - -inf) -> use safe max
-        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.exp(s - m_safe[..., None])
-        p = jnp.where(jnp.isneginf(s), 0.0, p)
-        corr = jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m) - m_safe)
-        corr = jnp.where(jnp.isneginf(m), 0.0, corr)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
-        )
+            mask = mask & (q_pos[:, None] >= k_pos[None, :])[None]
+        o, l, m = merge_blocks((o, l, m), block_fn(qf, k_blk, v_blk, mask))
         k_next = ppermute_shift(k_blk, 1, axes)
         v_next = ppermute_shift(v_blk, 1, axes)
         mask_next = ppermute_shift(mask_blk, 1, axes)
-        return o_new, l_new, m_new, k_next, v_next, mask_next
+        return o, l, m, k_next, v_next, mask_next
 
     o0 = jnp.zeros((b, h, t, d), jnp.float32)
     l0 = jnp.zeros((b, h, t), jnp.float32)
-    m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    m0 = jnp.full((b, h, t), NEG, jnp.float32)
     o, l, m, _, _, _ = jax.lax.fori_loop(0, sp, body, (o0, l0, m0, k, v, kv_mask))
     l = jnp.where(l == 0.0, 1.0, l)
     out = (o / l[..., None]).astype(q.dtype)
@@ -105,6 +130,7 @@ def ring_attention(
 
 
 def _block_attention_local(q, k, v, causal=False, kv_mask=None):
+    """Plain (quadratic) single-device attention — the test oracle."""
     b, t, h, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32))

@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""MoE CI gate (analog of the reference's MoE MNIST CI run,
+``.buildkite/scripts/benchmark_master.sh:109-144``, which trains a 2-expert
+MoE on MNIST and pins the exact final loss).
+
+No dataset downloads in CI, so the workload is the deterministic synthetic
+classification task from ``examples/moe``: 10 gaussian prototype classes,
+an expert-parallel MoE block with per-rank independently-initialized
+experts (excluded from DP sync via ``dp_filter``).  Gates, per the
+reference's pattern:
+
+1. determinism — two runs produce EXACTLY the same final loss;
+2. convergence — final loss under a fixed threshold;
+3. expert parity — expert parameters stay different across ranks (they are
+   per-rank state), while every other parameter stays bitwise equal.
+
+Run:  JAX_PLATFORMS=cpu python ci/moe_check.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import bagua_tpu
+from bagua_tpu.algorithms import Algorithm
+from bagua_tpu.communication import ALL_AXES
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.parallel.moe import MoE
+
+CONVERGED_LOSS = 0.05  # synthetic-task analog of the reference's pinned 0.000071
+STEPS = 400
+
+
+def run():
+    group = bagua_tpu.init_process_group()
+    n = group.size
+
+    class Model(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = jax.nn.relu(nn.Dense(64)(x))
+            h, l_aux = MoE(
+                hidden_size=128, num_experts=n, k=1, capacity_factor=2.0,
+                ep_size=n, ep_axis=ALL_AXES,
+            )(h)
+            return nn.Dense(10)(h), l_aux
+
+    model = Model()
+
+    def loss_fn(params, batch):
+        x, y = batch
+        logits, l_aux = model.apply({"params": params}, x)
+        ce = -jnp.mean(
+            jnp.take_along_axis(jax.nn.log_softmax(logits), y[:, None], axis=1)
+        )
+        return ce + 0.01 * l_aux
+
+    x0 = jnp.zeros((4, 32))
+    per_rank = [model.init(jax.random.PRNGKey(r), x0)["params"] for r in range(n)]
+    base = per_rank[0]
+    merged = [
+        jax.tree_util.tree_map_with_path(
+            lambda path, b, pr: pr if "experts" in jax.tree_util.keystr(path) else b,
+            base, per_rank[r],
+        )
+        for r in range(n)
+    ]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *merged)
+
+    ddp = DistributedDataParallel(
+        loss_fn, optax.adam(5e-3), Algorithm.init("gradient_allreduce"),
+        process_group=group, dp_filter=lambda name: "experts" not in name,
+    )
+    state = ddp.init(stacked_params=stacked)
+
+    rng = np.random.RandomState(0)
+    protos = rng.rand(10, 32).astype(np.float32)
+    for _ in range(STEPS):
+        y = rng.randint(0, 10, size=64 * n)
+        x = protos[y] + 0.2 * rng.randn(64 * n, 32).astype(np.float32)
+        state, losses = ddp.train_step(
+            state, (jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.int32))
+        )
+    return float(losses.mean()), state
+
+
+def main():
+    loss1, state = run()
+    loss2, _ = run()
+    print(f"moe final loss run1={loss1:.8f} run2={loss2:.8f}")
+    failures = []
+    if loss1 != loss2:
+        failures.append(f"determinism: {loss1} != {loss2}")
+    if loss1 >= CONVERGED_LOSS:
+        failures.append(f"convergence: {loss1} >= {CONVERGED_LOSS}")
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
+        arr = np.asarray(leaf)
+        name = jax.tree_util.keystr(path)
+        if "experts" in name:
+            if all(np.array_equal(arr[0], arr[r]) for r in range(1, arr.shape[0])):
+                failures.append(f"expert leaf {name} identical across ranks")
+        else:
+            for r in range(1, arr.shape[0]):
+                if not np.array_equal(arr[0], arr[r]):
+                    failures.append(f"dense leaf {name} diverged across ranks")
+                    break
+    if failures:
+        print("FAILURES:\n  " + "\n  ".join(failures))
+        sys.exit(1)
+    print("moe check passed")
+
+
+if __name__ == "__main__":
+    main()
