@@ -36,7 +36,7 @@ All wrappers are exact for elementwise optimizers: updates equal the
 unsharded optimizer's to float tolerance.
 """
 
-from typing import NamedTuple, Union, Tuple
+from typing import Tuple, Union
 
 import jax
 import jax.numpy as jnp

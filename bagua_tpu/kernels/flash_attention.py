@@ -28,7 +28,7 @@ padded keys are masked out, padded queries/channels sliced off after.
 """
 
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -17,11 +17,9 @@ Memory per chip: parameters, gradients and optimizer state all ~``P / n``
 (plus transient gathered layers).
 """
 
-from typing import Any, Callable, NamedTuple, Optional
+from typing import Callable, Optional
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -1,0 +1,191 @@
+#!/usr/bin/env python3
+"""4D-parallel GPT pretraining: dp x pp x tp x sp in one training step.
+
+The composition showcase for the mesh substrate (each dimension is tested
+separately in the suite; this wires all four together the way a real LLM
+pretrain would):
+
+* **dp** — data parallelism: batch sharded, gradients averaged.
+* **pp** — GPipe pipeline over uniform transformer stages
+  (``parallel.pipeline.pipeline_apply``; autodiff runs the backward
+  schedule).  Embedding/head stay replicated outside the pipeline.
+* **tp** — Megatron column/row tensor parallelism inside every block
+  (``parallel.tensor_parallel``).
+* **sp** — ring attention over the sequence axis
+  (``parallel.ring_attention``; context length scales with sp).
+
+Gradient sync rules (the interesting part — see ``sync_grads``):
+embedding grads flow only into pipeline stage 0, so they **psum** over pp;
+stage params are pp-local; everything replicated averages over (dp, sp).
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        JAX_PLATFORMS=cpu python examples/gpt_pretrain/main.py \
+        --dp 1 --pp 2 --tp 2 --sp 2 --steps 5
+"""
+
+import argparse
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bagua_tpu.models.gpt import GPTBlock, GPTConfig
+from bagua_tpu.parallel.pipeline import pipeline_apply
+
+
+class GPTStage(nn.Module):
+    """A uniform chunk of GPT blocks — one pipeline stage."""
+
+    cfg: GPTConfig
+    n_blocks: int
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.n_blocks):
+            x = GPTBlock(self.cfg, name=f"block{i}")(x)
+        return x
+
+
+def build(args):
+    cfg = GPTConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        num_layers=args.blocks_per_stage, num_heads=args.heads,
+        max_position_embeddings=args.seq,
+        tp_size=args.tp, tp_axis="tp",
+        sp_axis="sp" if args.sp > 1 else None,
+    )
+    stage = GPTStage(cfg, n_blocks=args.blocks_per_stage)
+    embed = nn.Embed(args.vocab, args.hidden, name="embed")
+    head = nn.Dense(args.vocab, use_bias=False, name="head")
+    return cfg, stage, embed, head
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=2)
+    p.add_argument("--tp", type=int, default=2)
+    p.add_argument("--sp", type=int, default=2)
+    p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--hidden", type=int, default=32)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--seq", type=int, default=32, help="GLOBAL sequence length")
+    p.add_argument("--blocks-per-stage", type=int, default=1)
+    p.add_argument("--batch", type=int, default=8, help="global batch")
+    p.add_argument("--microbatches", type=int, default=2)
+    p.add_argument("--steps", type=int, default=5)
+    args = p.parse_args(argv)
+
+    n = args.dp * args.pp * args.tp * args.sp
+    devices = np.array(jax.devices()[:n]).reshape(args.dp, args.pp, args.tp, args.sp)
+    mesh = Mesh(devices, ("dp", "pp", "tp", "sp"))
+    cfg, stage, embed, head = build(args)
+
+    t_local = args.seq // args.sp
+    b_local = args.batch // args.dp
+    rng0 = jax.random.PRNGKey(0)
+    x0 = jnp.zeros((2, t_local, args.hidden), jnp.float32)
+    ids0 = jnp.zeros((2, t_local), jnp.int32)
+
+    # one stage's params per pp rank (same structure; stacked for sharding)
+    stage_params = [
+        stage.init(jax.random.PRNGKey(100 + s), x0)["params"] for s in range(args.pp)
+    ]
+    stacked_stage = jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
+    embed_params = embed.init(rng0, ids0)["params"]
+    head_params = head.init(jax.random.PRNGKey(1), x0)["params"]
+
+    # Separate optimizers per component: stage moments are pp-LOCAL state
+    # (each rank's Adam moments describe its own stage's gradients), so they
+    # live stacked over pp exactly like the stage params — declaring them
+    # replicated would clobber every stage's moments with one rank's.
+    opt = optax.adam(1e-3)
+    embed_opt_state = opt.init(embed_params)
+    stage_opt_state = jax.vmap(opt.init)(stacked_stage)  # leading pp axis
+    head_opt_state = opt.init(head_params)
+
+    def local_step(embed_p, stage_stacked, head_p, e_opt, s_opt_stacked, h_opt, ids, labels):
+        my_stage = jax.tree.map(lambda x: x[0], stage_stacked)  # this rank's slice
+        my_s_opt = jax.tree.map(lambda x: x[0], s_opt_stacked)
+
+        def loss_fn(triple):
+            e_p, s_p, h_p = triple
+            x = embed.apply({"params": e_p}, ids)  # (b_local, t_local, hidden)
+            micro = x.reshape(
+                args.microbatches, b_local // args.microbatches, t_local, args.hidden
+            )
+            y = pipeline_apply(
+                lambda sp_, u: stage.apply({"params": sp_}, u), s_p, micro,
+                axis_name="pp",
+            )
+            h = y.reshape(b_local, t_local, args.hidden)
+            logits = head.apply({"params": h_p}, h)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+
+        loss, grads = jax.value_and_grad(loss_fn)((embed_p, my_stage, head_p))
+        g_embed, g_stage, g_head = grads
+
+        # -- gradient sync rules ------------------------------------------
+        # embedding: grads enter the pipeline only on pp rank 0 -> psum over
+        # pp recovers the full gradient; then average over (dp, sp).
+        g_embed = jax.tree.map(
+            lambda g: jax.lax.pmean(jax.lax.psum(g, "pp"), ("dp", "sp")), g_embed
+        )
+        # stage params: pp-local (each rank owns its stage); average (dp, sp).
+        g_stage = jax.tree.map(lambda g: jax.lax.pmean(g, ("dp", "sp")), g_stage)
+        # head: computed identically on every pp rank (pipeline output is
+        # broadcast); average everywhere it is replicated.
+        g_head = jax.tree.map(lambda g: jax.lax.pmean(g, ("dp", "pp", "sp")), g_head)
+
+        e_upd, e_opt = opt.update(g_embed, e_opt, embed_p)
+        s_upd, my_s_opt = opt.update(g_stage, my_s_opt, my_stage)
+        h_upd, h_opt = opt.update(g_head, h_opt, head_p)
+        embed_p = optax.apply_updates(embed_p, e_upd)
+        my_stage = optax.apply_updates(my_stage, s_upd)
+        head_p = optax.apply_updates(head_p, h_upd)
+        # the global training loss: local losses vary over (dp, sp) shards
+        loss = jax.lax.pmean(loss, ("dp", "sp"))
+        return (
+            embed_p,
+            jax.tree.map(lambda x: x[None], my_stage),
+            head_p,
+            e_opt,
+            jax.tree.map(lambda x: x[None], my_s_opt),
+            h_opt,
+            loss,
+        )
+
+    step = jax.jit(
+        jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(), P("pp"), P(), P(), P("pp"), P(), P("dp", "sp"), P("dp", "sp")),
+            out_specs=(P(), P("pp"), P(), P(), P("pp"), P(), P()),
+            check_vma=False,
+        )
+    )
+
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, args.vocab, size=(args.steps, args.batch, args.seq + 1))
+    losses = []
+    for i in range(args.steps):
+        ids = jnp.asarray(data[i, :, :-1], jnp.int32)
+        labels = jnp.asarray(data[i, :, 1:], jnp.int32)
+        (
+            embed_params, stacked_stage, head_params,
+            embed_opt_state, stage_opt_state, head_opt_state, loss,
+        ) = step(
+            embed_params, stacked_stage, head_params,
+            embed_opt_state, stage_opt_state, head_opt_state, ids, labels,
+        )
+        losses.append(float(loss))
+        print(f"step {i}: loss {losses[-1]:.4f}", flush=True)
+    return losses
+
+
+if __name__ == "__main__":
+    main()
