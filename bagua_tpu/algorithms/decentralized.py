@@ -47,10 +47,7 @@ from bagua_tpu.communication import (
     ppermute_apply,
     ppermute_shift,
 )
-from bagua_tpu.kernels.minmax_uint8 import (
-    compress_minmax_uint8,
-    decompress_minmax_uint8,
-)
+from bagua_tpu.kernels.minmax_uint8 import get_compressors
 
 
 def _shift_one_perm(step: int, n: int) -> List[Tuple[int, int]]:
@@ -167,9 +164,13 @@ class LowPrecisionDecentralizedAlgorithmImpl(AlgorithmImpl):
     #: desync them (DistributedDataParallel.rebucket refuses).
     holds_bucketized_state = True
 
-    def __init__(self, process_group, hierarchical: bool = True, communication_interval: int = 1):
+    def __init__(
+        self, process_group, hierarchical: bool = True,
+        communication_interval: int = 1, use_pallas=None,
+    ):
         super().__init__(process_group, hierarchical=hierarchical)
         self.communication_interval = communication_interval
+        self.use_pallas = use_pallas  # compressor impl (kernels.get_compressors)
 
     def tensors_to_buckets(self, tree, bucket_size_bytes=None, filter_fn=None):
         return super().tensors_to_buckets(tree, bucket_size_bytes=1 << 62, filter_fn=filter_fn)
@@ -194,6 +195,8 @@ class LowPrecisionDecentralizedAlgorithmImpl(AlgorithmImpl):
 
     def on_step_end(self, params, state, ctx: StepContext):
         axes = self._axes()
+
+        compress_minmax_uint8, decompress_minmax_uint8 = get_compressors(self.use_pallas)
 
         def communicate(operand):
             params, state = operand
@@ -239,13 +242,18 @@ class LowPrecisionDecentralizedAlgorithmImpl(AlgorithmImpl):
 
 
 class LowPrecisionDecentralizedAlgorithm(Algorithm):
-    def __init__(self, hierarchical: bool = True, communication_interval: int = 1):
+    def __init__(
+        self, hierarchical: bool = True, communication_interval: int = 1,
+        use_pallas=None,
+    ):
         self.hierarchical = hierarchical
         self.communication_interval = communication_interval
+        self.use_pallas = use_pallas
 
     def reify(self, process_group) -> LowPrecisionDecentralizedAlgorithmImpl:
         return LowPrecisionDecentralizedAlgorithmImpl(
             process_group,
             hierarchical=self.hierarchical,
             communication_interval=self.communication_interval,
+            use_pallas=self.use_pallas,
         )

@@ -144,3 +144,41 @@ def test_alltoall_v(group):
             np.testing.assert_allclose(recv[r, s], data[s, r])
         # counts received: what each rank s sends to r = send_counts[r]
         np.testing.assert_array_equal(rc[r], np.full(n, send_counts[r]))
+
+
+def test_pinned_weight_norm_regression(group):
+    """Exact weight-norm pins per algorithm (seed 13, 8 steps) — the analog
+    of the reference's Lightning-strategy regression values
+    (``tests/pytorch_lightning/test_bagua_strategy.py:46-60``,
+    BASELINE.md rows).  Any numerical drift in an algorithm's math, the
+    bucketing layout, or the engine's step composition trips this."""
+    from bagua_tpu.algorithms import build_algorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+    PINS = {
+        "gradient_allreduce": 6.278911590576172,
+        "bytegrad": 6.278995990753174,
+        "decentralized": 6.269926071166992,
+        "low_precision_decentralized": 6.272532939910889,
+        "qadam": 6.088754653930664,
+    }
+
+    for name, expected in PINS.items():
+        algo = build_algorithm(name, lr=1e-2, qadam_warmup_steps=3)
+        opt = None if name == "qadam" else optax.sgd(0.05)
+        ddp = DistributedDataParallel(mse_loss, opt, algo, process_group=group)
+        params = init_mlp(jax.random.PRNGKey(13), [8, 16, 4])
+        state = ddp.init(params)
+        rng = np.random.RandomState(13)
+        for _ in range(8):
+            b = (
+                jnp.asarray(rng.randn(16, 8), np.float32),
+                jnp.asarray(rng.randn(16, 4), np.float32),
+            )
+            state, _ = ddp.train_step(state, b)
+        one_copy = ddp.params_unstacked(state)
+        norm = float(
+            jnp.sqrt(sum(jnp.sum(l ** 2) for l in jax.tree.leaves(one_copy)))
+        )
+        assert norm == expected, f"{name}: {norm!r} != pinned {expected!r}"
