@@ -81,6 +81,10 @@ def parse_args(argv=None):
     p.add_argument("--max_restarts", type=int, default=3)
     p.add_argument("--monitor_interval", type=float, default=1.0)
     p.add_argument("--autotune_level", type=int, default=0)
+    # reference CLI parity (bagua/distributed/run.py autotune args)
+    p.add_argument("--autotune_max_samples", type=int, default=60)
+    p.add_argument("--autotune_warmup_time_s", type=float, default=30.0)
+    p.add_argument("--autotune_sampling_confidence_time_s", type=float, default=5.0)
     p.add_argument("--bagua_service_port", type=int, default=29501)
     p.add_argument("--no_python", action="store_true")
     p.add_argument("training_script", type=str)
@@ -206,6 +210,9 @@ def main(argv=None) -> int:
         service = AutotuneService(
             world_size=args.max_nodes * args.nproc_per_node,
             autotune_level=args.autotune_level,
+            max_samples=args.autotune_max_samples,
+            warmup_time_s=args.autotune_warmup_time_s,
+            sampling_confidence_time_s=args.autotune_sampling_confidence_time_s,
         )
         autotune_server = start_autotune_server(service, port=args.bagua_service_port)
         logger.info("autotune service on port %d", args.bagua_service_port)

@@ -54,6 +54,16 @@ class AutotuneService:
         # per-model, per-rank latest reported speed (averaged when sampling,
         # reference keeps a check board per rank, autotune_service.py:35-45)
         self._speeds: Dict[str, Dict[int, float]] = {}
+        # Multi-process plan-change agreement.  Ranks must adopt a new bucket
+        # plan at the SAME training step or their collective patterns desync
+        # (hang).  The service tracks each rank's LATEST asked train_iter; a
+        # sample fires only once every rank has asked, and the proposal
+        # becomes *effective from* max(latest asked) + 1 — past the furthest
+        # iter any rank has already been answered for, so every rank first
+        # sees the new plan at the same (future) ask step, regardless of how
+        # far ahead a fast host loop runs.
+        self._rank_latest_ask: Dict[str, Dict[int, int]] = {}
+        self._hp_effective: Dict[str, list] = {}  # [(effective_from, hp, final)]
 
     def _manager(self, model_name: str) -> AutotuneTaskManager:
         if model_name not in self._managers:
@@ -92,31 +102,55 @@ class AutotuneService:
             self._speeds[model_name][rank] = speed
         return {"status": "ok"}
 
+    def _effective_hp(self, model_name: str, train_iter: int, mgr):
+        """The hyperparameters in force for asks at ``train_iter`` — the last
+        history entry whose effective_from <= train_iter."""
+        history = self._hp_effective.setdefault(
+            model_name, [(0, mgr.hyperparameter, False)]
+        )
+        current = history[0]
+        for entry in history:
+            if entry[0] <= train_iter:
+                current = entry
+        return current  # (effective_from, hp, is_final)
+
     def ask_hyperparameters(self, payload: Dict) -> Dict:
         model_name = payload["model_name"]
+        rank = int(payload.get("rank", 0))
         train_iter = int(payload.get("train_iter", 0))
         with self._lock:
             mgr = self._manager(model_name)
             now = time.time()
-            completed = mgr.sampling_counter >= self.max_samples
-            if self.autotune_level >= 1 and not completed:
+            _, hp, is_final = self._effective_hp(model_name, train_iter, mgr)
+            if self.autotune_level >= 1 and not is_final:
+                latest = self._rank_latest_ask.setdefault(model_name, {})
+                latest[rank] = max(latest.get(rank, 0), train_iter)
                 in_warmup = now - self._start_time[model_name] < self.warmup_time_s
                 confident = (
                     now - self._last_sample_time[model_name]
                     >= self.sampling_confidence_time_s
                 )
                 speeds = self._speeds[model_name]
-                if not in_warmup and confident and len(speeds) >= self.world_size:
+                sampling_open = mgr.sampling_counter < self.max_samples
+                if (
+                    sampling_open
+                    and not in_warmup
+                    and confident
+                    and len(speeds) >= self.world_size
+                    and len(latest) >= self.world_size
+                ):
                     score = sum(speeds.values()) / len(speeds)
                     mgr.tell_and_ask(score, train_iter)
                     self._last_sample_time[model_name] = now
                     self._speeds[model_name] = {}
-                    if mgr.sampling_counter >= self.max_samples:
-                        mgr.lock_best()
-                        completed = True
+                    final = mgr.sampling_counter >= self.max_samples
+                    new_hp = mgr.lock_best() if final else mgr.hyperparameter
+                    self._hp_effective[model_name].append(
+                        (max(latest.values()) + 1, new_hp, final)
+                    )
             return {
-                "recommended_hyperparameters": mgr.hyperparameter.model_dump(),
-                "is_autotune_completed": completed,
+                "recommended_hyperparameters": hp.model_dump(),
+                "is_autotune_completed": is_final,
             }
 
     def report_tensor_execution_order(self, payload: Dict) -> Dict:

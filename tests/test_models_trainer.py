@@ -181,4 +181,8 @@ def test_pinned_weight_norm_regression(group):
         norm = float(
             jnp.sqrt(sum(jnp.sum(l ** 2) for l in jax.tree.leaves(one_copy)))
         )
-        assert norm == expected, f"{name}: {norm!r} != pinned {expected!r}"
+        # tight tolerance (not bitwise): survives last-ulp reassociation from
+        # jaxlib/CPU-kernel changes while catching real numerical drift
+        np.testing.assert_allclose(
+            norm, expected, rtol=1e-6, err_msg=f"{name} drifted from pin"
+        )

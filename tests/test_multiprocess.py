@@ -208,3 +208,76 @@ def test_baguarun_subprocess_fanout(tmp_path):
     )
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     assert (tmp_path / "node0").exists() and (tmp_path / "node1").exists()
+
+
+AUTOTUNE_WORKER = textwrap.dedent(
+    """
+    import os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import bagua_tpu
+    from bagua_tpu.algorithms import Algorithm
+    from bagua_tpu.ddp import AutotuneSession, DistributedDataParallel
+    from bagua_tpu.distributed import init_from_env
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+    from bagua_tpu.service.autotune_client import get_hyperparameters_service_client
+
+    group = init_from_env()
+    assert group.size == 2, group
+    # the client must resolve the service from launcher-exported env
+    client = get_hyperparameters_service_client()
+    assert client.wait_until_ready(30), "autotune service unreachable via AUTO_TUNE_SERVER_ADDR"
+
+    ddp = DistributedDataParallel(
+        mse_loss, optax.sgd(0.05), Algorithm.init("gradient_allreduce"),
+        process_group=group, bucket_size_bytes=1 << 10,
+    )
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), [16, 64, 64, 4]))
+    n0 = ddp.plan.num_buckets
+    session = AutotuneSession(ddp, "mh_model", client=client, interval=1)
+    rng = np.random.RandomState(int(os.environ["RANK"]))
+    changed = False
+    for i in range(80):
+        local = (rng.randn(8, 16).astype(np.float32), rng.randn(8, 4).astype(np.float32))
+        state, _ = ddp.train_step(state, ddp.shard_batch(local))
+        session.tick(16)
+        if session.completed or ddp.plan.num_buckets != n0:
+            changed = True
+            break
+        time.sleep(0.02)
+    assert changed, "autotune never tuned: the per-rank check board never filled"
+    marker = os.path.join(os.environ["AT_WORK"], f"tuned_{os.environ['RANK']}")
+    open(marker, "w").write(str(ddp.plan.num_buckets))
+    """
+)
+
+
+def test_multiprocess_autotune_tunes(tmp_path):
+    """Launcher-hosted autotune service + 2 worker processes: the service's
+    per-rank check board only fills because each process reports its own
+    jax.process_index() (ADVICE fix), the client resolves the service from
+    AUTO_TUNE_SERVER_ADDR, and both workers adopt a re-bucketed plan."""
+    script = tmp_path / "worker.py"
+    script.write_text(AUTOTUNE_WORKER)
+    import os
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env["AT_WORK"] = str(tmp_path)
+    env.pop("XLA_FLAGS", None)  # 1 device per process
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "bagua_tpu.distributed.run",
+            "--nproc_per_node", "2", "--autotune_level", "1",
+            "--autotune_warmup_time_s", "0", "--autotune_sampling_confidence_time_s", "0",
+            "--autotune_max_samples", "3",
+            "--master_port", str(free_port()), "--bagua_service_port", str(free_port()),
+            "--monitor_interval", "0.2", str(script),
+        ],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert (tmp_path / "tuned_0").exists() and (tmp_path / "tuned_1").exists()
