@@ -91,6 +91,16 @@ class AutotuneService:
                     }
                 )
                 mgr.hyperparameter.bucket_size = self.default_bucket_size
+            # (Re-)registration = a (re)started gang whose train_iter restarts
+            # from 0: reset the per-rank ask ratchet and re-base the
+            # effective-from history on the current hyperparameters, or new
+            # proposals would only take effect past the pre-restart iteration
+            # and speeds would be attributed to never-adopted plans.
+            self._rank_latest_ask.pop(model_name, None)
+            self._speeds[model_name] = {}
+            self._hp_effective[model_name] = [
+                (0, mgr.hyperparameter, mgr.sampling_counter >= self.max_samples)
+            ]
             return {"recommended_hyperparameters": mgr.hyperparameter.model_dump()}
 
     def report_metrics(self, payload: Dict) -> Dict:
@@ -106,7 +116,10 @@ class AutotuneService:
         """The hyperparameters in force for asks at ``train_iter`` — the last
         history entry whose effective_from <= train_iter."""
         history = self._hp_effective.setdefault(
-            model_name, [(0, mgr.hyperparameter, False)]
+            model_name,
+            # seed marks final when sampling is already closed (e.g.
+            # max_samples=0 disables tuning -> completed from the first ask)
+            [(0, mgr.hyperparameter, mgr.sampling_counter >= self.max_samples)],
         )
         current = history[0]
         for entry in history:

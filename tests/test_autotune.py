@@ -231,3 +231,46 @@ def test_session_profile_reports_measured_order(group):
         assert order[w4] < order[w0]  # late layer ready earlier
     finally:
         srv.shutdown()
+
+
+def test_plan_changes_are_step_agreed_under_drift():
+    """Ranks must adopt each sampled plan at the same train_iter even when
+    one rank's host loop runs rounds ahead (async dispatch drift) — the
+    effective-from history guarantees identical answers per iter."""
+    from bagua_tpu.defs import TensorDeclaration
+
+    svc = AutotuneService(
+        world_size=2, autotune_level=1, warmup_time_s=0,
+        sampling_confidence_time_s=0, max_samples=4,
+    )
+    srv = start_autotune_server(svc, port=0)
+    try:
+        c = AutotuneClient(port=srv.server_address[1])
+        decls = [
+            TensorDeclaration(name=f"t{i}", num_elements=256, dtype="f32")
+            for i in range(6)
+        ]
+        c.register_tensors("drift", decls)
+        seen = {0: {}, 1: {}}
+
+        def ask(rank, it):
+            c.report_metrics("drift", rank, it, 100.0)
+            hp, done = c.ask_hyperparameters("drift", rank, it)
+            seen[rank][it] = (len(hp.buckets), hp.bucket_size, done)
+
+        for it in range(1, 10):  # rank 0 races two rounds ahead
+            ask(0, it)
+            if it >= 3:
+                ask(1, it - 2)
+        for it in range(8, 10):
+            ask(1, it)
+
+        common = sorted(set(seen[0]) & set(seen[1]))
+        assert len(common) >= 9
+        for it in common:
+            assert seen[0][it] == seen[1][it], (it, seen[0][it], seen[1][it])
+        # sampling really happened and eventually locked
+        assert svc._managers["drift"].sampling_counter == 4
+        assert any(done for (_, _, done) in seen[0].values())
+    finally:
+        srv.shutdown()
