@@ -50,26 +50,48 @@ def free_port():
     return port
 
 
-def test_two_process_rendezvous_and_broadcast_object(tmp_path):
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER)
-    coordinator = f"127.0.0.1:{free_port()}"
+def worker_env(**extra):
+    """Env for spawned workers: repo on PYTHONPATH, one device per process."""
     import os
 
     env = dict(os.environ)
     env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("XLA_FLAGS", None)  # one device per process
+    env.pop("XLA_FLAGS", None)
+    env.update(extra)
+    return env
+
+
+def spawn_and_collect(cmds, env, timeout=180):
+    """Fan out worker commands and collect (rc, stdout, stderr) per worker.
+    Always kills stragglers — a regression that deadlocks a worker must fail
+    the test, not hang CI holding the rendezvous port."""
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), coordinator, str(i)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            c, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True
         )
-        for i in range(2)
+        for c in cmds
     ]
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=150)
-        outs.append((p.returncode, out, err))
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return outs
+
+
+def test_two_process_rendezvous_and_broadcast_object(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    coordinator = f"127.0.0.1:{free_port()}"
+    outs = spawn_and_collect(
+        [[sys.executable, str(script), coordinator, str(i)] for i in range(2)],
+        worker_env(), timeout=150,
+    )
     for code, out, err in outs:
         assert code == 0, f"worker failed:\n{out}\n{err}"
         assert "OK size=2" in out
@@ -143,22 +165,11 @@ def test_two_process_ddp_train_step(tmp_path):
     script = tmp_path / "ddp_worker.py"
     script.write_text(DDP_WORKER)
     coordinator = f"127.0.0.1:{free_port()}"
-    import os
-
-    env = dict(os.environ)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), coordinator, str(i)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=240)
-        outs.append((p.returncode, out, err))
+    outs = spawn_and_collect(
+        [[sys.executable, str(script), coordinator, str(i)] for i in range(2)],
+        worker_env(XLA_FLAGS="--xla_force_host_platform_device_count=4"),
+        timeout=240,
+    )
     for code, out, err in outs:
         assert code == 0, f"worker failed:\n{out}\n{err}"
         assert "DDP OK" in out
@@ -281,3 +292,49 @@ def test_multiprocess_autotune_tunes(tmp_path):
     )
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     assert (tmp_path / "tuned_0").exists() and (tmp_path / "tuned_1").exists()
+
+
+SUBGROUP_BARRIER_WORKER = textwrap.dedent(
+    """
+    import sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import bagua_tpu
+    from bagua_tpu.communication import new_group
+
+    coordinator, proc_id = sys.argv[1], int(sys.argv[2])
+    bagua_tpu.init_process_group(
+        coordinator_address=coordinator, num_processes=3, process_id=proc_id
+    )
+    if proc_id == 2:
+        # outside the subgroup: never calls barrier; a process-global sync
+        # here would deadlock the others against this sleep
+        time.sleep(8)
+        print("proc 2 done (never joined the barrier)", flush=True)
+        sys.exit(0)
+    sub = new_group(ranks=[0, 1])
+    assert sub.spans_processes and sub.size == 2
+    t0 = time.monotonic()
+    bagua_tpu.barrier(comm=sub)
+    dt = time.monotonic() - t0
+    assert dt < 6.0, f"barrier waited on the out-of-group process ({dt:.1f}s)"
+    print(f"proc {proc_id} subgroup barrier OK in {dt:.2f}s", flush=True)
+    """
+)
+
+
+def test_subgroup_barrier_excludes_outside_processes(tmp_path):
+    """barrier() on a group spanning a strict subset of processes must
+    synchronize only that subset — a process-global sync would deadlock
+    against the third process, which never calls it."""
+    script = tmp_path / "worker.py"
+    script.write_text(SUBGROUP_BARRIER_WORKER)
+    coordinator = f"127.0.0.1:{free_port()}"
+    outs = spawn_and_collect(
+        [[sys.executable, str(script), coordinator, str(i)] for i in range(3)],
+        worker_env(),
+    )
+    for code, out, err in outs:
+        assert code == 0, f"worker failed:\n{out}\n{err}"
+    assert "proc 0 subgroup barrier OK" in outs[0][1]
+    assert "proc 1 subgroup barrier OK" in outs[1][1]
