@@ -63,6 +63,30 @@ class BenchHarness:
             flush=True,
         )
 
+    def guard(self, main_fn) -> None:
+        """Run the benchmark body; on ANY exception emit a parseable error
+        line first (the tunneled TPU backend has been seen raising
+        UNAVAILABLE after minutes of init), then re-raise."""
+        try:
+            main_fn()
+        except BaseException as e:  # noqa: BLE001 — always leave a JSON line
+            with self._lock:
+                if not self._emitted:
+                    print(
+                        json.dumps(
+                            {
+                                "metric": self.metric,
+                                "value": 0.0,
+                                "unit": self.unit,
+                                "vs_baseline": None,
+                                "error": f"{type(e).__name__}: {e}"[:500],
+                            }
+                        ),
+                        flush=True,
+                    )
+                    self._emitted = True
+            raise
+
     def emit(self, value: float, provisional: bool = False, extra: dict = None) -> None:
         line = {
             "metric": self.metric,

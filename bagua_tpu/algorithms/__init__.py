@@ -83,6 +83,11 @@ GlobalAlgorithmRegistry.register(
     "no communication (optimizer-owned comm, e.g. ZeRO-2, or debugging)",
 )
 
+from bagua_tpu.algorithms.grad_accumulation import (  # noqa: F401,E402
+    GradientAccumulation,
+    GradientAccumulationImpl,
+)
+
 #: algorithms whose schedule is wall-clock-driven (not bitwise-deterministic
 #: across runs by design) — determinism gates skip these.
 WALL_CLOCK_ALGORITHMS = frozenset({"async"})

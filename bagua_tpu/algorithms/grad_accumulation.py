@@ -1,0 +1,196 @@
+"""Gradient accumulation without communication — the ``no_sync`` analog.
+
+The reference's torch-DDP-compatible wrapper exposes ``no_sync()``
+(``data_parallel/distributed.py:174-195``): gradients accumulate locally for
+k-1 steps with NO inter-worker communication, and the k-th step communicates
+the accumulated gradient and applies one optimizer update — the standard
+large-batch recipe when the per-step batch doesn't fit.
+
+Context managers don't map onto a jitted step, so the same contract is a
+declarative wrapper around any inner algorithm::
+
+    ddp = DistributedDataParallel(
+        loss_fn, optax.adam(1e-3),
+        GradientAccumulation(Algorithm.init("bytegrad"), every=4),
+        process_group=group,
+    )
+
+Per step: the local gradient folds into an accumulator carried in the
+algorithm state; on non-boundary steps the step performs **zero collectives
+and no optimizer update** (the engine skips the update via
+``skips_optimizer_update`` + ``is_update_step``); on every ``every``-th step
+the inner algorithm's full communication pipeline runs on the accumulated
+mean and the optimizer applies once.  Numerically, k accumulated microbatches
+equal one step on their concatenation (for mean-style losses).
+"""
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
+
+
+class GradientAccumulationImpl(AlgorithmImpl):
+    def __init__(self, inner: AlgorithmImpl, every: int):
+        if every < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        # inner must exist before super().__init__: the base assigns
+        # self.hierarchical, which this class forwards to the inner impl
+        # (pass the inner's own value so the write is a no-op).
+        self.inner = inner
+        self.every = every
+        super().__init__(inner.process_group, hierarchical=inner.hierarchical)
+
+    # the engine gates the optimizer update on is_update_step
+    skips_optimizer_update = True
+
+    def is_update_step(self, step):
+        """Traced predicate: does this step communicate + update?"""
+        return (step % self.every) == (self.every - 1)
+
+    def _inner_ctx(self, ctx: StepContext) -> StepContext:
+        """The inner algorithm's schedules (QAdam warmup, shift_one peer
+        cycling, Adam bias correction) count OPTIMIZER steps, not
+        microbatches — hand it the update-step counter."""
+        return dataclasses.replace(ctx, step=ctx.step // self.every)
+
+    # -- attribute protocols the engine reads off the impl -------------------
+
+    @property
+    def holds_bucketized_state(self):
+        # re-bucketing safety guard must see the inner algorithm's flag
+        return getattr(self.inner, "holds_bucketized_state", False)
+
+    @property
+    def optimizer(self):
+        # QAdam bundles its own optimizer; the engine discovers it here
+        return getattr(self.inner, "optimizer", None)
+
+    @property
+    def hierarchical(self):
+        return self.inner.hierarchical
+
+    @hierarchical.setter
+    def hierarchical(self, value):
+        # autotune toggles this on ddp.impl; the inner impl's collectives
+        # read it, so the write must land there
+        self.inner.hierarchical = value
+
+    # -- delegate structure --------------------------------------------------
+
+    def tensors_to_buckets(self, tree, bucket_size_bytes=None, filter_fn=None):
+        return self.inner.tensors_to_buckets(
+            tree, bucket_size_bytes=bucket_size_bytes, filter_fn=filter_fn
+        )
+
+    def bind_plan(self, plan):
+        super().bind_plan(plan)
+        self.inner.bind_plan(plan)
+
+    def init_state(self, params) -> Any:
+        return {
+            "acc": jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), params),
+            "inner": self.inner.init_state(params),
+        }
+
+    # -- traced stages -------------------------------------------------------
+
+    def on_step_start(self, params, state, ctx: StepContext):
+        # The reference's no_sync disables ALL hook machinery off-boundary;
+        # the inner stages (some communicate here, e.g. async averaging)
+        # likewise only run on update steps.
+        inner_ctx = self._inner_ctx(ctx)
+        params, inner_state = jax.lax.cond(
+            self.is_update_step(ctx.step),
+            lambda op: self.inner.on_step_start(op[0], op[1], inner_ctx),
+            lambda op: op,
+            (params, state["inner"]),
+        )
+        return params, {"acc": state["acc"], "inner": inner_state}
+
+    def transform_gradients(self, grads, params, state, ctx: StepContext):
+        acc = jax.tree.map(
+            lambda a, g: a + g.astype(jnp.float32), state["acc"], grads
+        )
+        boundary = self.is_update_step(ctx.step)
+
+        inner_ctx = self._inner_ctx(ctx)
+
+        def flush(operand):
+            acc, params, inner_state = operand
+            mean = jax.tree.map(lambda a: a / self.every, acc)
+            g, params, inner_state = self.inner.transform_gradients(
+                mean, params, inner_state, inner_ctx
+            )
+            zeroed = jax.tree.map(jnp.zeros_like, acc)
+            return g, params, inner_state, zeroed
+
+        def hold(operand):
+            acc, params, inner_state = operand
+            # grads are unused (the engine skips the update off-boundary)
+            return jax.tree.map(jnp.zeros_like, acc), params, inner_state, acc
+
+        g, params, inner_state, acc = jax.lax.cond(
+            boundary, flush, hold, (acc, params, state["inner"])
+        )
+        grads = jax.tree.map(lambda g_, t: g_.astype(t.dtype), g, grads)
+        return grads, params, {"acc": acc, "inner": inner_state}
+
+    def on_step_end(self, params, state, ctx: StepContext):
+        inner_ctx = self._inner_ctx(ctx)
+        params, inner_state = jax.lax.cond(
+            self.is_update_step(ctx.step),
+            lambda op: self.inner.on_step_end(op[0], op[1], inner_ctx),
+            lambda op: op,
+            (params, state["inner"]),
+        )
+        return params, {"acc": state["acc"], "inner": inner_state}
+
+    # -- host-side / control: delegate ---------------------------------------
+
+    def need_reset(self, step: int) -> bool:
+        return self.inner.need_reset(step // self.every)
+
+    def step_variant(self, step: int) -> str:
+        return self.inner.step_variant(step // self.every)
+
+    def abort(self):
+        if hasattr(self.inner, "abort"):
+            self.inner.abort()
+
+    def resume(self):
+        if hasattr(self.inner, "resume"):
+            self.inner.resume()
+
+    @property
+    def host_dispatch_lock(self):
+        return self.inner.host_dispatch_lock
+
+    def host_pre_dispatch(self, state):
+        return self.inner.host_pre_dispatch(state)
+
+    def host_post_dispatch(self, state, step: int) -> None:
+        self.inner.host_post_dispatch(state, step)
+
+    def host_shutdown(self) -> None:
+        self.inner.host_shutdown()
+
+
+class GradientAccumulation(Algorithm):
+    """Wrap ``inner`` so communication + the optimizer update run every
+    ``every``-th step on the accumulated gradient mean (``no_sync`` analog)."""
+
+    def __init__(self, inner: Algorithm, every: int):
+        self.inner = inner
+        self.every = every
+
+    def reify(self, process_group) -> GradientAccumulationImpl:
+        inner_impl = (
+            self.inner.reify(process_group)
+            if isinstance(self.inner, Algorithm)
+            else self.inner
+        )
+        return GradientAccumulationImpl(inner_impl, self.every)

@@ -217,8 +217,26 @@ class DistributedDataParallel:
             grads, params, algo_state = impl.transform_gradients(
                 grads, params, algo_state, ctx
             )
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            if getattr(impl, "skips_optimizer_update", False):
+                # Accumulating algorithms (no_sync analog) apply the optimizer
+                # only on their boundary steps — a zero-grad update would
+                # still mutate momentum/bias-correction state.
+                def apply_update(operand):
+                    grads, params, opt_state = operand
+                    updates, opt_state = self.optimizer.update(
+                        grads, opt_state, params
+                    )
+                    return optax.apply_updates(params, updates), opt_state
+
+                params, opt_state = jax.lax.cond(
+                    impl.is_update_step(step),
+                    apply_update,
+                    lambda operand: (operand[1], operand[2]),
+                    (grads, params, opt_state),
+                )
+            else:
+                updates, opt_state = self.optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
             params, algo_state = impl.on_step_end(params, algo_state, ctx)
 
             new_state = TrainState(

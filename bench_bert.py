@@ -109,4 +109,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    HARNESS.guard(main)

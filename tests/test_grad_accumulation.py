@@ -1,0 +1,94 @@
+"""Gradient accumulation (no_sync analog): k accumulated microbatches equal
+one step on their concatenation, no optimizer-state mutation off-boundary,
+and centralized determinism is preserved."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from bagua_tpu.algorithms import Algorithm, GradientAccumulation
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+N = 8
+
+
+def _batches(rng, n, rows):
+    return [
+        (
+            jnp.asarray(rng.randn(rows, 10), np.float32),
+            jnp.asarray(rng.randn(rows, 4), np.float32),
+        )
+        for _ in range(n)
+    ]
+
+
+def test_accumulation_matches_concatenated_batches(group):
+    """every=2 over half-batches == plain algorithm over the full batches
+    (mean-reduction loss: the accumulated mean IS the full-batch gradient)."""
+    params = init_mlp(jax.random.PRNGKey(0), [10, 16, 4])
+    rng = np.random.RandomState(0)
+    full = _batches(rng, 4, 32)
+    halves = []
+    for x, y in full:
+        halves.append((x[:16], y[:16]))
+        halves.append((x[16:], y[16:]))
+
+    def run(algo, batches):
+        ddp = DistributedDataParallel(
+            mse_loss, optax.adam(1e-2), algo, process_group=group
+        )
+        state = ddp.init(params)
+        for b in batches:
+            state, _ = ddp.train_step(state, b)
+        return ddp.params_unstacked(state)
+
+    ref = run(Algorithm.init("gradient_allreduce"), full)
+    acc = run(
+        GradientAccumulation(Algorithm.init("gradient_allreduce"), every=2), halves
+    )
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(acc)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)
+
+
+def test_no_update_off_boundary(group):
+    """Off-boundary steps leave params AND optimizer state untouched."""
+    params = init_mlp(jax.random.PRNGKey(1), [10, 16, 4])
+    ddp = DistributedDataParallel(
+        mse_loss, optax.adam(1e-2),
+        GradientAccumulation(Algorithm.init("bytegrad"), every=4),
+        process_group=group,
+    )
+    state = ddp.init(params)
+    rng = np.random.RandomState(1)
+    b = _batches(rng, 1, 16)[0]
+    before = jax.tree.map(np.asarray, (state.params, state.opt_state))
+    for i in range(3):  # steps 0..2 of every=4: no boundary
+        state, _ = ddp.train_step(state, b)
+    after = jax.tree.map(np.asarray, (state.params, state.opt_state))
+    for x, y in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(x, y)
+    state, _ = ddp.train_step(state, b)  # step 3: boundary
+    changed = any(
+        not np.array_equal(x, np.asarray(y))
+        for x, y in zip(jax.tree.leaves(before[0]), jax.tree.leaves(state.params))
+    )
+    assert changed, "boundary step applied no update"
+
+
+def test_accumulated_bytegrad_keeps_ranks_equal(group):
+    params = init_mlp(jax.random.PRNGKey(2), [10, 16, 4])
+    ddp = DistributedDataParallel(
+        mse_loss, optax.sgd(0.05),
+        GradientAccumulation(Algorithm.init("bytegrad"), every=2),
+        process_group=group,
+    )
+    state = ddp.init(params)
+    rng = np.random.RandomState(2)
+    for b in _batches(rng, 6, 16):
+        state, _ = ddp.train_step(state, b)
+    for l in jax.tree.leaves(state.params):
+        arr = np.asarray(l)
+        for r in range(1, N):
+            np.testing.assert_array_equal(arr[0], arr[r])
