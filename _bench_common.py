@@ -26,7 +26,10 @@ class BenchHarness:
         self._emitted = False
         threading.Thread(target=self._watchdog, daemon=True).start()
         # Persistent compilation cache: a cold re-run skips the compile.
-        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+        os.environ.setdefault(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+        )
         import jax
 
         jax.config.update(

@@ -173,7 +173,10 @@ class GradientAccumulationImpl(AlgorithmImpl):
         return self.inner.host_pre_dispatch(state)
 
     def host_post_dispatch(self, state, step: int) -> None:
-        self.inner.host_post_dispatch(state, step)
+        # The inner impl counts optimizer steps, not microbatch steps — the
+        # traced inner stages see step // every, so the host hooks must too
+        # (otherwise async warmup gates trip ``every``x early).
+        self.inner.host_post_dispatch(state, step // self.every)
 
     def host_shutdown(self) -> None:
         self.inner.host_shutdown()

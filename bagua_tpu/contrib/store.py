@@ -112,10 +112,17 @@ class FileStore(Store):
                         return cand, True, (pickle.load(f) if load_value else None)
             except FileNotFoundError:
                 return cand, False, None
-        return next(self._candidates(key)), False, None  # exhausted: reuse slot 0
+        return None, False, None  # chain exhausted: no slot holds (or can hold) key
 
     def set(self, key, value):
         target, _, _ = self._slot(key, load_value=False)
+        if target is None:
+            # 64 colliding keys on a 64-bit hash is pathological; overwriting
+            # an occupied slot would silently evict an unrelated key's data.
+            raise RuntimeError(
+                f"FileStore probe chain exhausted for key {key!r}: 64 slots "
+                "occupied by colliding keys"
+            )
         fd, tmp = tempfile.mkstemp(dir=self.path)
         with os.fdopen(fd, "wb") as f:
             pickle.dump(key, f)
@@ -124,7 +131,7 @@ class FileStore(Store):
 
     def get(self, key):
         _, found, value = self._slot(key, load_value=True)
-        return value if found else None
+        return value if found else None  # exhausted chain with no match = miss
 
     def num_keys(self):
         return len([f for f in os.listdir(self.path) if f.endswith(".blob")])
