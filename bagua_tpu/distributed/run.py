@@ -17,10 +17,16 @@ reference's etcd rendezvous).  Workers are expected to checkpoint and resume
 via ``bagua_tpu.checkpoint`` (reference pattern ``run.py:149-159``), using
 :func:`bagua_tpu.checkpoint.remap_world_size` when the world size changed.
 
-Node-level membership across hosts needs a shared rendezvous store; this
-launcher implements elasticity over its local worker slots (the testable
-single-host analog), and ``bagua_tpu.distributed.baguarun`` fans launchers
-out across hosts.
+**Cross-host membership** (reference ``run.py:606-627``): with ``--nnodes
+MIN:MAX`` the launcher coordinates through the rendezvous store
+(:mod:`bagua_tpu.distributed.rendezvous`) — hosted by the ``node_rank 0``
+launcher by default, or externally via ``--rdzv_endpoint``.  Every launcher
+announces its healthy slot count; ``WORLD_SIZE``/``RANK`` come from the
+store's published assignment (never from symmetric-shrink assumptions), the
+worker rendezvous port rotates with the store's epoch (identical on every
+host), and node join/leave/death (heartbeat TTL) re-forms the gang
+everywhere.  ``bagua_tpu.distributed.baguarun`` fans launchers out across
+hosts.
 
 Env exported to workers (reference ``set_bagua_env``, ``run.py:578-603``):
 ``RANK``, ``WORLD_SIZE``, ``LOCAL_RANK``, ``LOCAL_WORLD_SIZE``, ``NODE_RANK``,
@@ -78,6 +84,26 @@ def parse_args(argv=None):
     )
     p.add_argument("--master_addr", default="127.0.0.1")
     p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument(
+        "--rdzv_endpoint", type=str, default=None,
+        help="host:port of an externally hosted rendezvous store; default is "
+        "for the node_rank-0 launcher to host one at master_addr:rdzv_port "
+        "when --nnodes is elastic (MIN:MAX) or > 1",
+    )
+    p.add_argument("--rdzv_port", type=int, default=29400)
+    p.add_argument(
+        "--rdzv_settle_s", type=float, default=1.0,
+        help="quiet window after a membership change before the store "
+        "publishes a new assignment (batches simultaneous joins)",
+    )
+    p.add_argument(
+        "--rdzv_ttl_s", type=float, default=30.0,
+        help="heartbeat TTL after which a silent node is reaped",
+    )
+    p.add_argument(
+        "--rdzv_timeout_s", type=float, default=300.0,
+        help="max wait for the gang to reach min_nodes and settle",
+    )
     p.add_argument("--max_restarts", type=int, default=3)
     p.add_argument("--monitor_interval", type=float, default=1.0)
     p.add_argument("--autotune_level", type=int, default=0)
@@ -91,17 +117,9 @@ def parse_args(argv=None):
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     args.min_nodes, args.max_nodes = parse_nnodes(args.nnodes)
-    if args.min_nodes != args.max_nodes:
-        # Node-level membership change needs a shared rendezvous store that
-        # every node launcher consults (the reference uses etcd); silently
-        # assuming max_nodes would hang jax.distributed.initialize waiting
-        # for phantom processes.  Use --min_replicas for (local) slot-level
-        # elasticity instead.
-        raise SystemExit(
-            "--nnodes MIN:MAX requires a shared rendezvous backend, which "
-            "this launcher does not provide; launch with the exact node "
-            "count and use --min_replicas for worker-slot elasticity"
-        )
+    # The rendezvous store coordinates membership whenever more than one
+    # node can participate; a single static node keeps the store-free path.
+    args.use_rdzv = args.max_nodes > 1 or args.rdzv_endpoint is not None
     if args.min_replicas is None:
         args.min_replicas = args.nproc_per_node
     return args
@@ -109,20 +127,9 @@ def parse_args(argv=None):
 
 def worker_env(
     args, slot: int, rank: int, local_rank: int, local_world: int,
-    world_size: int, attempt: int,
+    world_size: int, attempt: int, master_port: int,
 ) -> dict:
     env = dict(os.environ)
-    # Single-node gangs rotate the rendezvous port per gang epoch so a fresh
-    # gang never trips over a lingering listener; the rotation skips the
-    # autotune service port.  Multi-node gangs keep it CONSTANT — launchers on
-    # different hosts cannot observe each other's epoch counters, and a
-    # desynced rotation would rendezvous them onto different ports forever.
-    if args.max_nodes == 1:
-        master_port = args.master_port + attempt
-        while master_port == args.bagua_service_port:
-            master_port += 1
-    else:
-        master_port = args.master_port
     env.update(
         RANK=str(rank),
         WORLD_SIZE=str(world_size),
@@ -137,27 +144,51 @@ def worker_env(
         BAGUA_ATTEMPT=str(attempt),
         AUTO_TUNE_SERVER_ADDR=f"{args.master_addr}:{args.bagua_service_port}",
     )
+    if args.use_rdzv:
+        env["BAGUA_RDZV_ENDPOINT"] = args.rdzv_endpoint or (
+            f"{args.master_addr}:{args.rdzv_port}"
+        )
     return env
 
 
-def spawn_workers(args, slots: List[int], attempt: int) -> Dict[int, subprocess.Popen]:
-    """Spawn one worker per active slot; ranks are contiguous over ``slots``.
+def single_node_master_port(args, attempt: int) -> int:
+    """Single-node gangs rotate the rendezvous port per gang epoch so a fresh
+    gang never trips over a lingering listener; the rotation skips the
+    autotune service port.  (Multi-node gangs rotate by the *store's* epoch
+    instead — see ``_run_rendezvous`` / ``rotated_master_port`` — which every
+    host observes.)"""
+    master_port = args.master_port + attempt
+    while master_port == args.bagua_service_port:
+        master_port += 1
+    return master_port
 
-    Multi-node: every node launcher is assumed to shrink symmetrically (a
-    shared rendezvous store would relax this); world size is nodes x active
-    slots."""
-    world_size = args.max_nodes * len(slots)
+
+def spawn_workers(
+    args,
+    slots: List[int],
+    attempt: int,
+    world_size: Optional[int] = None,
+    rank_offset: int = 0,
+    master_port: Optional[int] = None,
+) -> Dict[int, subprocess.Popen]:
+    """Spawn one worker per active slot; ranks are contiguous over ``slots``
+    starting at ``rank_offset`` (this node's offset in the gang-wide
+    assignment; 0 for single-node)."""
+    if world_size is None:
+        world_size = len(slots)
+    if master_port is None:
+        master_port = single_node_master_port(args, attempt)
     procs = {}
     for local_rank, slot in enumerate(slots):
         if args.no_python:
             cmd = [args.training_script] + args.training_script_args
         else:
             cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
-        global_rank = args.node_rank * len(slots) + local_rank
         procs[slot] = subprocess.Popen(
             cmd,
             env=worker_env(
-                args, slot, global_rank, local_rank, len(slots), world_size, attempt
+                args, slot, rank_offset + local_rank, local_rank, len(slots),
+                world_size, attempt, master_port,
             ),
         )
     return procs
@@ -199,6 +230,220 @@ def monitor(
         time.sleep(interval)
 
 
+class _GangController:
+    """Shared slot-benching bookkeeping for both launcher loops."""
+
+    def __init__(self, args):
+        self.args = args
+        self.consecutive_failures = {s: 0 for s in range(args.nproc_per_node)}
+        self.benched = set()
+        self.failures = 0  # restart budget: consumed by blamed failures only
+
+    def active_slots(self) -> List[int]:
+        return [s for s in range(self.args.nproc_per_node) if s not in self.benched]
+
+    def below_floor(self) -> bool:
+        if len(self.active_slots()) < self.args.min_replicas:
+            logger.error(
+                "only %d healthy worker slots left (< --min_replicas %d)",
+                len(self.active_slots()), self.args.min_replicas,
+            )
+            return True
+        return False
+
+    def reset_counters(self):
+        for s in self.consecutive_failures:
+            self.consecutive_failures[s] = 0
+
+    def blame(self, slots: List[int], failed_slots: List[int]) -> bool:
+        """Count a locally-blamed gang failure.  Returns True when the bench
+        set changed (the node's slot count shrinks)."""
+        self.failures += 1
+        for s in slots:
+            if s in failed_slots:
+                self.consecutive_failures[s] += 1
+            else:
+                self.consecutive_failures[s] = 0
+        shrunk = False
+        for s in failed_slots:
+            if self.consecutive_failures[s] >= self.args.slot_failure_tolerance:
+                self.benched.add(s)
+                shrunk = True
+                logger.warning(
+                    "slot %d benched after %d consecutive failures; gang shrinks",
+                    s, self.consecutive_failures[s],
+                )
+        logger.warning(
+            "worker slot(s) %s failed (failure %d/%d); restarting gang",
+            failed_slots, self.failures, self.args.max_restarts + 1,
+        )
+        return shrunk
+
+    def scale_up(self):
+        logger.info(
+            "SIGUSR1: un-benching %s, re-forming at full size", sorted(self.benched)
+        )
+        self.benched.clear()
+        self.reset_counters()
+
+
+def _run_single_node(args, service, scale_up) -> int:
+    gang = _GangController(args)
+    epoch = 0  # every gang formation (drives single-node port rotation)
+    while gang.failures <= args.max_restarts:
+        slots = gang.active_slots()
+        if gang.below_floor():
+            return 1
+        if service is not None:
+            # keep the autotune check board sized to the LIVE world, or
+            # benched ranks would block tuning forever
+            service.world_size = len(slots)
+        logger.info(
+            "gang epoch %d: %d worker(s) (slots %s), world re-formed",
+            epoch, len(slots), slots,
+        )
+        procs = spawn_workers(args, slots, epoch)
+        outcome, failed_slots = monitor(
+            procs, args.monitor_interval, interrupt=lambda: scale_up["armed"]
+        )
+        epoch += 1
+        if outcome == "done":
+            logger.info("all workers finished")
+            return 0
+        kill_all(procs)
+        if outcome == "interrupted":
+            scale_up["armed"] = False
+            gang.scale_up()
+            continue
+        gang.blame(slots, failed_slots)
+    logger.error("exceeded max_restarts=%d", args.max_restarts)
+    return 1
+
+
+def _run_rendezvous(args, service, scale_up) -> int:
+    """Store-coordinated gang loop (reference membership contract,
+    ``run.py:116-148``: any membership change stops ALL workers everywhere
+    and restarts them with fresh ``RANK``/``WORLD_SIZE``).
+
+    Every launcher announces its healthy slot count to the store and spawns
+    workers from the published assignment.  Local failures are *blamed*
+    (slot benching + restart budget) only when no other node initiated a
+    re-form around the same time — a worker killed by a peer node's crash
+    (distributed-runtime collateral) must not bench a healthy local slot."""
+    from bagua_tpu.distributed.rendezvous import (
+        RendezvousClient, RendezvousState, rotated_master_port,
+        start_rendezvous_server,
+    )
+
+    rdzv_server = None
+    if args.rdzv_endpoint is None:
+        endpoint = f"{args.master_addr}:{args.rdzv_port}"
+        if args.node_rank == 0:
+            state = RendezvousState(
+                min_nodes=args.min_nodes,
+                max_nodes=args.max_nodes,
+                settle_s=args.rdzv_settle_s,
+                ttl_s=args.rdzv_ttl_s,
+            )
+            rdzv_server = start_rendezvous_server(state, args.rdzv_port)
+            logger.info("hosting rendezvous store on port %d", args.rdzv_port)
+    else:
+        endpoint = args.rdzv_endpoint
+    client = RendezvousClient(endpoint, args.node_rank, timeout_s=args.rdzv_timeout_s)
+    # Distinguishes this launcher process from a previous holder of the same
+    # node_rank whose stale membership the store may still carry.
+    incarnation = os.getpid()
+    gang = _GangController(args)
+    reserved = [args.bagua_service_port, args.rdzv_port]
+    try:
+        while gang.failures <= args.max_restarts:
+            slots = gang.active_slots()
+            if gang.below_floor():
+                client.leave()
+                return 1
+            try:
+                asn = client.wait_assignment(len(slots), incarnation)
+            except TimeoutError as e:
+                logger.error("rendezvous failed: %s", e)
+                client.leave()
+                return 1
+            mine = next(m for m in asn["members"] if m["node_rank"] == args.node_rank)
+            master_port = rotated_master_port(args.master_port, asn["epoch"], reserved)
+            if service is not None:
+                service.world_size = asn["world_size"]
+            logger.info(
+                "gang generation %d epoch %d: world_size=%d, node %d ranks "
+                "[%d..%d), port %d",
+                asn["generation"], asn["epoch"], asn["world_size"], args.node_rank,
+                mine["rank_offset"], mine["rank_offset"] + len(slots), master_port,
+            )
+            procs = spawn_workers(
+                args, slots, asn["epoch"], world_size=asn["world_size"],
+                rank_offset=mine["rank_offset"], master_port=master_port,
+            )
+            outcome, failed_slots = monitor(
+                procs, args.monitor_interval,
+                interrupt=lambda: scale_up["armed"] or client.epoch_changed(asn["epoch"]),
+            )
+            if outcome == "done":
+                logger.info("all workers finished")
+                client.leave(completed=True)
+                return 0
+            kill_all(procs)
+            if outcome == "interrupted":
+                if scale_up["armed"]:
+                    scale_up["armed"] = False
+                    gang.scale_up()
+                    # Move the epoch FIRST so peer launchers take the clean
+                    # "membership changed elsewhere" path; otherwise their
+                    # workers die of collateral at an unmoved epoch and the
+                    # first to report would be mis-ruled the crash origin.
+                    client.request_restart(asn["epoch"])
+                else:
+                    # Remote membership/epoch change: collateral, not local.
+                    logger.info("membership changed elsewhere; re-forming")
+                    gang.reset_counters()
+                continue
+            # Failed: ask the store who crashed first.  The origin's worker
+            # exits before the collateral deaths it causes on other nodes, so
+            # the first reporter per epoch takes the blame; everyone else
+            # re-forms without benching healthy local slots.
+            if client.report_crash(asn["epoch"]):
+                shrunk = gang.blame(slots, failed_slots)
+                if not shrunk:
+                    # Same membership: ask the store for a gang-wide restart
+                    # so every node re-forms on a fresh (epoch-rotated) port.
+                    client.request_restart(asn["epoch"])
+                # A shrink re-announces automatically via wait_assignment.
+                continue
+            # Collateral: wait for the origin's membership change / restart
+            # to land, then re-form.  Fall back to local blame if nothing
+            # moves (e.g. the origin node lost power before acting — its
+            # heartbeat TTL will eventually reap it, which also moves the
+            # epoch).
+            logger.info("collateral worker failure; waiting for the gang to re-form")
+            deadline = time.time() + max(10.0 * args.rdzv_settle_s, 5.0)
+            moved = False
+            while time.time() < deadline:
+                if client.epoch_changed(asn["epoch"]):
+                    moved = True
+                    break
+                time.sleep(0.1)
+            gang.reset_counters()
+            if not moved:
+                logger.warning(
+                    "no membership change after collateral failure; "
+                    "restarting the gang"
+                )
+                client.request_restart(asn["epoch"])
+        logger.error("exceeded max_restarts=%d", args.max_restarts)
+        client.leave()
+        return 1
+    finally:
+        if rdzv_server is not None:
+            rdzv_server.shutdown()
+
+
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO, format="[bagua_tpu.launcher] %(message)s")
     args = parse_args(argv)
@@ -220,62 +465,10 @@ def main(argv=None) -> int:
     scale_up = {"armed": False}
     signal.signal(signal.SIGUSR1, lambda *_: scale_up.__setitem__("armed", True))
 
-    consecutive_failures = {s: 0 for s in range(args.nproc_per_node)}
-    benched = set()
-    failures = 0  # restart budget: consumed by failures only, not scale-ups
-    epoch = 0  # every gang formation (drives single-node port rotation)
     try:
-        while failures <= args.max_restarts:
-            slots = [s for s in range(args.nproc_per_node) if s not in benched]
-            if len(slots) < args.min_replicas:
-                logger.error(
-                    "only %d healthy worker slots left (< --min_replicas %d)",
-                    len(slots), args.min_replicas,
-                )
-                return 1
-            if service is not None:
-                # keep the autotune check board sized to the LIVE world, or
-                # benched ranks would block tuning forever
-                service.world_size = args.max_nodes * len(slots)
-            logger.info(
-                "gang epoch %d: %d worker(s) (slots %s), world re-formed",
-                epoch, len(slots), slots,
-            )
-            procs = spawn_workers(args, slots, epoch)
-            outcome, failed_slots = monitor(
-                procs, args.monitor_interval, interrupt=lambda: scale_up["armed"]
-            )
-            epoch += 1
-            if outcome == "done":
-                logger.info("all workers finished")
-                return 0
-            kill_all(procs)
-            if outcome == "interrupted":
-                scale_up["armed"] = False
-                logger.info("SIGUSR1: un-benching %s, re-forming at full size", sorted(benched))
-                benched.clear()
-                for s in consecutive_failures:
-                    consecutive_failures[s] = 0
-                continue
-            failures += 1
-            for s in slots:
-                if s in failed_slots:
-                    consecutive_failures[s] += 1
-                else:
-                    consecutive_failures[s] = 0
-            for s in failed_slots:
-                if consecutive_failures[s] >= args.slot_failure_tolerance:
-                    benched.add(s)
-                    logger.warning(
-                        "slot %d benched after %d consecutive failures; gang shrinks",
-                        s, consecutive_failures[s],
-                    )
-            logger.warning(
-                "worker slot(s) %s failed (failure %d/%d); restarting gang",
-                failed_slots, failures, args.max_restarts + 1,
-            )
-        logger.error("exceeded max_restarts=%d", args.max_restarts)
-        return 1
+        if args.use_rdzv:
+            return _run_rendezvous(args, service, scale_up)
+        return _run_single_node(args, service, scale_up)
     finally:
         if autotune_server is not None:
             autotune_server.shutdown()
