@@ -26,6 +26,7 @@ from bagua_tpu.communication import (  # noqa: F401
     gather,
     barrier,
     broadcast_object,
+    local_ranks,
 )
 from bagua_tpu.env import (  # noqa: F401
     get_rank,

@@ -24,8 +24,11 @@ Two collective surfaces are provided:
    path; algorithms compose these.
 2. **Eager** (:func:`allreduce`, :func:`allgather`, ...): drop-in analogs of
    the reference's explicit collectives (``communication.py:573-1401``).
-   They operate on *stacked per-rank* arrays — shape ``(group.size, ...)`` —
-   because single-controller JAX sees every rank's value at once; each output
+   They operate on *stacked per-rank* arrays: single-controller groups pass
+   the full ``(group.size, ...)`` stack (JAX sees every rank's value at
+   once); multi-host groups pass each process's *local view*
+   ``(len(local_ranks(group)), ...)`` and get back their own ranks' results
+   (assembled via ``make_array_from_process_local_data``).  Each output
    slice is what that rank would hold after the collective.
 """
 
@@ -320,25 +323,7 @@ def hierarchical_allreduce_inplace(x: jnp.ndarray, op: ReduceOp = ReduceOp.AVG) 
 _EAGER_CACHE: dict = {}
 
 
-def _eager(group: Optional[BaguaProcessGroup], key: tuple, make_fn: Callable):
-    """Lift ``make_fn()(local_value) -> local_value`` over stacked
-    ``(size, ...)`` arrays.  The stacked leading axis is sharded over the
-    mesh, so each rank's local block is ``(1, ...)``; we strip/restore that
-    axis around the collective.  Compiled callables are cached per
-    ``(mesh, key)`` (jit handles shape/dtype polymorphism internally).
-
-    Single-controller only: the stacked input carries *every* rank's send
-    value, which a process in a multi-host group cannot know for remote
-    ranks.  Multi-host callers use the in-step collectives (inside
-    ``shard_map`` over the group mesh) or :func:`broadcast_object`."""
-    group = group or get_default_group()
-    if group.spans_processes:
-        raise RuntimeError(
-            "eager collectives take a stacked (size, ...) array holding every "
-            "rank's value — undefined when the group spans processes; use the "
-            "in-step collectives (allreduce_inplace et al. inside shard_map) "
-            "or broadcast_object instead"
-        )
+def _eager_compiled(group: BaguaProcessGroup, key: tuple, make_fn: Callable):
     cache_key = (group.mesh, key)
     cached = _EAGER_CACHE.get(cache_key)
     if cached is None:
@@ -352,6 +337,71 @@ def _eager(group: Optional[BaguaProcessGroup], key: tuple, make_fn: Callable):
         )
         _EAGER_CACHE[cache_key] = cached
     return cached
+
+
+def local_ranks(group: Optional[BaguaProcessGroup] = None) -> List[int]:
+    """Ranks of ``group`` whose devices this process owns, in rank order —
+    the order of the slices this process passes to (and receives from) the
+    eager collectives on a multi-host group."""
+    group = group or get_default_group()
+    me = jax.process_index()
+    return [r for r, d in enumerate(group.devices) if d.process_index == me]
+
+
+def _eager(group: Optional[BaguaProcessGroup], key: tuple, make_fn: Callable):
+    """Lift ``make_fn()(local_value) -> local_value`` over stacked per-rank
+    arrays.  The stacked leading axis is sharded over the mesh, so each
+    rank's local block is ``(1, ...)``; we strip/restore that axis around the
+    collective.  Compiled callables are cached per ``(mesh, key)`` (jit
+    handles shape/dtype polymorphism internally).
+
+    **Single-controller groups** take and return the full ``(size, ...)``
+    stack — the caller sees every rank's value at once.
+
+    **Multi-host groups** (reference explicit collectives work across nodes,
+    ``communication.py:573-1401``) take the *local view*: each process passes
+    a ``(n_local_ranks, ...)`` array holding the send values for its own
+    ranks (order :func:`local_ranks`) and receives back a numpy array with
+    its own ranks' results.  The stacks are assembled into one global array
+    with ``jax.make_array_from_process_local_data`` — every process in the
+    group must call collectives in the same order (the usual SPMD
+    contract)."""
+    group = group or get_default_group()
+    compiled = _eager_compiled(group, key, make_fn)
+    if not group.spans_processes:
+        return compiled
+
+    # The local-view wrapper is cached alongside the compiled fn — rebuilding
+    # the sharding and rescanning group.devices per call would put O(devices)
+    # python work on the eager hot path.
+    cache_key = (group.mesh, key, "local_view")
+    cached = _EAGER_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(group.mesh, P(ALL_AXES))
+    n_local = len(local_ranks(group))
+
+    def call_local_view(local):
+        local = np.asarray(local)
+        if local.shape[0] != n_local:
+            raise ValueError(
+                f"multi-host eager collective: expected this process's "
+                f"({jax.process_index()}) local stack of shape ({n_local}, ...) "
+                f"for its {n_local} rank(s), got {local.shape}"
+            )
+        global_shape = (group.size,) + local.shape[1:]
+        garr = jax.make_array_from_process_local_data(sharding, local, global_shape)
+        out = compiled(garr)
+        shards = sorted(
+            out.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+    _EAGER_CACHE[cache_key] = call_local_view
+    return call_local_view
 
 
 def allreduce(send, op: ReduceOp = ReduceOp.AVG, comm: Optional[BaguaProcessGroup] = None):

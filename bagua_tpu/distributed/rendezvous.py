@@ -59,12 +59,13 @@ def rotated_master_port(base_port: int, epoch: int, reserved: List[int]) -> int:
 
 
 class _Member:
-    __slots__ = ("node_rank", "nslots", "incarnation", "last_seen")
+    __slots__ = ("node_rank", "nslots", "incarnation", "addr", "last_seen")
 
-    def __init__(self, node_rank: int, nslots: int, incarnation: int):
+    def __init__(self, node_rank: int, nslots: int, incarnation: int, addr=None):
         self.node_rank = node_rank
         self.nslots = nslots
         self.incarnation = incarnation
+        self.addr = addr
         self.last_seen = time.monotonic()
 
 
@@ -94,14 +95,14 @@ class RendezvousState:
 
     # -- membership ops (all called under HTTP handler threads) -------------
 
-    def join(self, node_rank: int, nslots: int, incarnation: int) -> dict:
+    def join(self, node_rank: int, nslots: int, incarnation: int, addr=None) -> dict:
         with self._lock:
             self._reap_locked()
             m = self._members.get(node_rank)
             if m is None and len(self._members) >= self.max_nodes:
                 return {"accepted": False, "reason": "max_nodes reached"}
             if m is None or (m.nslots, m.incarnation) != (nslots, incarnation):
-                self._members[node_rank] = _Member(node_rank, nslots, incarnation)
+                self._members[node_rank] = _Member(node_rank, nslots, incarnation, addr)
                 self._mark_dirty_locked()
                 logger.info(
                     "join: node %d nslots=%d inc=%d -> membership change",
@@ -235,6 +236,7 @@ class RendezvousState:
                     "node_rank": m.node_rank,
                     "nslots": m.nslots,
                     "incarnation": m.incarnation,
+                    "addr": m.addr,
                     "rank_offset": offset,
                 }
             )
@@ -244,6 +246,12 @@ class RendezvousState:
             "epoch": self.epoch,
             "world_size": offset,
             "members": table,
+            # The gang's jax.distributed coordinator lives on the node that
+            # owns rank 0 — which, after membership changes, need not be the
+            # node the job was launched with (the round-3 MASTER_ADDR-pinning
+            # review finding).  None when that node didn't advertise an addr
+            # (callers fall back to their static --master_addr).
+            "master_addr": table[0]["addr"] if table else None,
         }
         self._dirty_since = None
         logger.info(
@@ -294,6 +302,7 @@ class _Handler(BaseHTTPRequestHandler):
                     int(payload["node_rank"]),
                     int(payload["nslots"]),
                     int(payload.get("incarnation", 0)),
+                    payload.get("addr"),
                 )
             )
         elif self.path == "/rdzv/leave":
@@ -336,12 +345,19 @@ class RendezvousClient:
     """Launcher-side client.  Pure stdlib (urllib) so workers could use the
     KV too without extra deps."""
 
-    def __init__(self, endpoint: str, node_rank: int, timeout_s: float = 300.0):
+    def __init__(
+        self,
+        endpoint: str,
+        node_rank: int,
+        timeout_s: float = 300.0,
+        addr: Optional[str] = None,
+    ):
         if "://" not in endpoint:
             endpoint = "http://" + endpoint
         self.endpoint = endpoint.rstrip("/")
         self.node_rank = node_rank
         self.timeout_s = timeout_s
+        self.addr = addr  # this node's reachable address, advertised on join
 
     def _call(self, path: str, payload: Optional[dict] = None) -> dict:
         import urllib.request
@@ -363,7 +379,12 @@ class RendezvousClient:
     def announce(self, nslots: int, incarnation: int = 0) -> dict:
         out = self._call(
             "/rdzv/join",
-            {"node_rank": self.node_rank, "nslots": nslots, "incarnation": incarnation},
+            {
+                "node_rank": self.node_rank,
+                "nslots": nslots,
+                "incarnation": incarnation,
+                "addr": self.addr,
+            },
         )
         if not out.get("accepted", True):
             raise RuntimeError(f"rendezvous rejected node {self.node_rank}: {out.get('reason')}")

@@ -85,6 +85,13 @@ def parse_args(argv=None):
     p.add_argument("--master_addr", default="127.0.0.1")
     p.add_argument("--master_port", type=int, default=29500)
     p.add_argument(
+        "--host_addr", type=str, default=None,
+        help="this node's address as reachable by the other nodes, advertised "
+        "through the rendezvous store so the gang's coordinator (the node "
+        "owning rank 0 after a membership change) can move; defaults to "
+        "--master_addr on node_rank 0 and this host's resolved name elsewhere",
+    )
+    p.add_argument(
         "--rdzv_endpoint", type=str, default=None,
         help="host:port of an externally hosted rendezvous store; default is "
         "for the node_rank-0 launcher to host one at master_addr:rdzv_port "
@@ -120,6 +127,16 @@ def parse_args(argv=None):
     # The rendezvous store coordinates membership whenever more than one
     # node can participate; a single static node keeps the store-free path.
     args.use_rdzv = args.max_nodes > 1 or args.rdzv_endpoint is not None
+    if args.host_addr is None:
+        if args.node_rank == 0:
+            args.host_addr = args.master_addr
+        else:
+            import socket
+
+            try:
+                args.host_addr = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                args.host_addr = args.master_addr
     if args.min_replicas is None:
         args.min_replicas = args.nproc_per_node
     return args
@@ -128,6 +145,7 @@ def parse_args(argv=None):
 def worker_env(
     args, slot: int, rank: int, local_rank: int, local_world: int,
     world_size: int, attempt: int, master_port: int,
+    master_addr: Optional[str] = None,
 ) -> dict:
     env = dict(os.environ)
     env.update(
@@ -136,7 +154,7 @@ def worker_env(
         LOCAL_RANK=str(local_rank),
         LOCAL_WORLD_SIZE=str(local_world),
         NODE_RANK=str(args.node_rank),
-        MASTER_ADDR=args.master_addr,
+        MASTER_ADDR=master_addr or args.master_addr,
         MASTER_PORT=str(master_port),
         BAGUA_SERVICE_PORT=str(args.bagua_service_port),
         BAGUA_AUTOTUNE=str(args.autotune_level),
@@ -170,6 +188,7 @@ def spawn_workers(
     world_size: Optional[int] = None,
     rank_offset: int = 0,
     master_port: Optional[int] = None,
+    master_addr: Optional[str] = None,
 ) -> Dict[int, subprocess.Popen]:
     """Spawn one worker per active slot; ranks are contiguous over ``slots``
     starting at ``rank_offset`` (this node's offset in the gang-wide
@@ -188,7 +207,7 @@ def spawn_workers(
             cmd,
             env=worker_env(
                 args, slot, rank_offset + local_rank, local_rank, len(slots),
-                world_size, attempt, master_port,
+                world_size, attempt, master_port, master_addr,
             ),
         )
     return procs
@@ -349,7 +368,10 @@ def _run_rendezvous(args, service, scale_up) -> int:
             logger.info("hosting rendezvous store on port %d", args.rdzv_port)
     else:
         endpoint = args.rdzv_endpoint
-    client = RendezvousClient(endpoint, args.node_rank, timeout_s=args.rdzv_timeout_s)
+    client = RendezvousClient(
+        endpoint, args.node_rank, timeout_s=args.rdzv_timeout_s,
+        addr=args.host_addr,
+    )
     # Distinguishes this launcher process from a previous holder of the same
     # node_rank whose stale membership the store may still carry.
     incarnation = os.getpid()
@@ -380,35 +402,40 @@ def _run_rendezvous(args, service, scale_up) -> int:
             procs = spawn_workers(
                 args, slots, asn["epoch"], world_size=asn["world_size"],
                 rank_offset=mine["rank_offset"], master_port=master_port,
+                master_addr=asn.get("master_addr"),
             )
             outcome, failed_slots = monitor(
                 procs, args.monitor_interval,
                 interrupt=lambda: scale_up["armed"] or client.epoch_changed(asn["epoch"]),
             )
+            # The store is notified BEFORE kill_all: SIGTERM grace can take
+            # up to 10 s, and while the epoch is unmoved a peer whose workers
+            # die of collateral in that window would be mis-ruled the crash
+            # origin (and bench a healthy slot).
             if outcome == "done":
                 logger.info("all workers finished")
                 client.leave(completed=True)
                 return 0
-            kill_all(procs)
             if outcome == "interrupted":
                 if scale_up["armed"]:
                     scale_up["armed"] = False
                     gang.scale_up()
                     # Move the epoch FIRST so peer launchers take the clean
-                    # "membership changed elsewhere" path; otherwise their
-                    # workers die of collateral at an unmoved epoch and the
-                    # first to report would be mis-ruled the crash origin.
+                    # "membership changed elsewhere" path.
                     client.request_restart(asn["epoch"])
                 else:
                     # Remote membership/epoch change: collateral, not local.
                     logger.info("membership changed elsewhere; re-forming")
                     gang.reset_counters()
+                kill_all(procs)
                 continue
             # Failed: ask the store who crashed first.  The origin's worker
             # exits before the collateral deaths it causes on other nodes, so
             # the first reporter per epoch takes the blame; everyone else
             # re-forms without benching healthy local slots.
-            if client.report_crash(asn["epoch"]):
+            origin = client.report_crash(asn["epoch"])
+            kill_all(procs)
+            if origin:
                 shrunk = gang.blame(slots, failed_slots)
                 if not shrunk:
                     # Same membership: ask the store for a gang-wide restart

@@ -1,8 +1,14 @@
 #!/usr/bin/env python3
 """Exercise every eager collective (analog of the reference's
-``examples/communication_primitives/main.py``, the 2-node CI smoke test)."""
+``examples/communication_primitives/main.py``, the 2-node CI smoke test).
 
-import jax.numpy as jnp
+Single process: each collective takes/returns the full ``(group.size, ...)``
+stack.  Multi-host (launch via ``bagua_tpu.distributed.run`` with
+``WORLD_SIZE > 1``): each process passes its *local view* — a stack of its
+own ranks' send values (``bagua_tpu.local_ranks``) — and receives its own
+ranks' results, exactly like the reference's per-process explicit
+collectives (reference ``communication.py:573-1401``)."""
+
 import numpy as np
 
 import bagua_tpu
@@ -10,21 +16,27 @@ from bagua_tpu import ReduceOp
 
 
 def main():
-    group = bagua_tpu.init_process_group()
-    n = group.size
-    x = jnp.asarray(np.arange(n * 8, dtype=np.float32).reshape(n, 8))
+    from bagua_tpu.distributed import init_from_env
 
-    print("group:", group)
+    group = init_from_env()
+    n = group.size
+    mine = bagua_tpu.local_ranks(group) if group.spans_processes else range(n)
+    # every rank's send value: rows of an (n, 8) arange, rank r holds row r
+    x = np.stack(
+        [np.arange(r * 8, (r + 1) * 8, dtype=np.float32) for r in mine]
+    )
+
+    print("group:", group, "local ranks:", list(mine))
     print("allreduce SUM :", np.asarray(bagua_tpu.allreduce(x, op=ReduceOp.SUM))[0][:4])
     print("allreduce AVG :", np.asarray(bagua_tpu.allreduce(x, op=ReduceOp.AVG))[0][:4])
-    print("allgather     :", bagua_tpu.allgather(x).shape)
-    print("reducescatter :", bagua_tpu.reducescatter(x).shape)
+    print("allgather     :", np.asarray(bagua_tpu.allgather(x)).shape)
+    print("reducescatter :", np.asarray(bagua_tpu.reducescatter(x)).shape)
     print("broadcast     :", np.asarray(bagua_tpu.broadcast(x, src=0))[-1][:4])
-    print("alltoall      :", bagua_tpu.alltoall(x).shape)
+    print("alltoall      :", np.asarray(bagua_tpu.alltoall(x)).shape)
     print("reduce(dst=0) :", np.asarray(bagua_tpu.reduce(x, dst=0))[0][:4])
-    print("scatter(src=0):", bagua_tpu.scatter(x, src=0).shape)
-    print("gather(dst=0) :", bagua_tpu.gather(x, dst=0).shape)
-    bagua_tpu.barrier()
+    print("scatter(src=0):", np.asarray(bagua_tpu.scatter(x, src=0)).shape)
+    print("gather(dst=0) :", np.asarray(bagua_tpu.gather(x, dst=0)).shape)
+    bagua_tpu.barrier(comm=group)
     print("barrier OK")
 
 

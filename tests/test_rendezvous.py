@@ -7,7 +7,6 @@ change ALL workers everywhere are stopped and restarted with fresh
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import textwrap
@@ -15,20 +14,13 @@ import time
 
 import pytest
 
+from helpers import free_port
 from bagua_tpu.distributed.rendezvous import (
     RendezvousClient,
     RendezvousState,
     rotated_master_port,
     start_rendezvous_server,
 )
-
-
-def free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 # ---------------- state machine ----------------------------------------------
@@ -271,11 +263,10 @@ open(os.path.join(work, f"finished_node{node}_ws{ws}"), "w").write("ok")
 """
 
 
-def _launch_node(tmp_path, script, node_rank, ports, timeout_note=""):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
-    env["ELASTIC_WORK_DIR"] = str(tmp_path)
-    env.pop("XLA_FLAGS", None)  # 1 device per worker process
+def _launch_node(tmp_path, script, node_rank, ports):
+    from helpers import worker_env
+
+    env = worker_env(ELASTIC_WORK_DIR=str(tmp_path))  # 1 device per worker
     return subprocess.Popen(
         [
             sys.executable, "-m", "bagua_tpu.distributed.run",
