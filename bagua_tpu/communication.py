@@ -406,7 +406,9 @@ def _eager(group: Optional[BaguaProcessGroup], key: tuple, make_fn: Callable):
 
 def allreduce(send, op: ReduceOp = ReduceOp.AVG, comm: Optional[BaguaProcessGroup] = None):
     """Eager allreduce (reference ``communication.py:848``). ``send`` is a
-    stacked per-rank array of shape ``(group.size, ...)``."""
+    stacked per-rank array: ``(group.size, ...)`` on a single-controller
+    group, or this process's ``(len(local_ranks(group)), ...)`` local view on
+    a multi-host group (see :func:`_eager`)."""
     op = ReduceOp(op)
     return _eager(
         comm, ("allreduce", op), lambda: functools.partial(allreduce_inplace, op=op)
@@ -415,7 +417,8 @@ def allreduce(send, op: ReduceOp = ReduceOp.AVG, comm: Optional[BaguaProcessGrou
 
 def allgather(send, comm: Optional[BaguaProcessGroup] = None):
     """Each output slice is the concatenation of every rank's slice
-    (reference ``communication.py:1038``)."""
+    (reference ``communication.py:1038``).  ``send`` as in :func:`allreduce`
+    (local view on multi-host groups)."""
     return _eager(
         comm, ("allgather",), lambda: functools.partial(allgather_inplace, tiled=True)
     )(send)

@@ -134,9 +134,16 @@ def parse_args(argv=None):
             import socket
 
             try:
-                args.host_addr = socket.gethostbyname(socket.gethostname())
+                resolved = socket.gethostbyname(socket.gethostname())
             except OSError:
+                resolved = None
+            # Debian-style /etc/hosts maps the hostname to 127.0.1.1 —
+            # advertising loopback as this node's gang-reachable address
+            # would strand peers if this node ever owns rank 0.
+            if resolved is None or resolved.startswith("127."):
                 args.host_addr = args.master_addr
+            else:
+                args.host_addr = resolved
     if args.min_replicas is None:
         args.min_replicas = args.nproc_per_node
     return args

@@ -55,6 +55,21 @@ class Router(nn.Module):
     def __call__(self, tokens, train: bool = True, used_token=None, rng=None) -> Routing:
         if self.k not in (1, 2):
             raise ValueError("Only top-1 and top-2 gatings are supported.")
+        if self.noisy_gate_policy not in (None, "Jitter", "RSample"):
+            raise ValueError(
+                f"unknown noisy_gate_policy {self.noisy_gate_policy!r} "
+                "(expected None, 'Jitter' or 'RSample')"
+            )
+        if self.noisy_gate_policy == "Jitter" and train and not self.is_initializing():
+            # Input jittering (reference multiplicative_jitter,
+            # sharded_moe.py:37-59 via TopKGate.forward:288-289): multiply the
+            # gate input by uniform(1-eps, 1+eps), eps=1e-2.
+            if rng is None:
+                raise ValueError("noisy_gate_policy='Jitter' requires an rng key")
+            jitter_rng = jax.random.fold_in(rng, 2)
+            tokens = tokens * jax.random.uniform(
+                jitter_rng, tokens.shape, tokens.dtype, 1.0 - 1e-2, 1.0 + 1e-2
+            )
         logits = nn.Dense(self.num_experts, use_bias=False, dtype=jnp.float32)(tokens)
         factor = self.capacity_factor if train else self.eval_capacity_factor
         if self.k == 1:
@@ -62,7 +77,7 @@ class Router(nn.Module):
                 logits, factor, self.min_capacity, used_token,
                 self.noisy_gate_policy if train else None, rng,
             )
-        return route_top2(logits, factor, rng)
+        return route_top2(logits, factor, rng, used_token)
 
 
 class Experts(nn.Module):
@@ -102,10 +117,12 @@ class ExpertParallelFFN(nn.Module):
     ep_axis: Union[str, Tuple[str, ...], None] = ("inter", "intra")
 
     @nn.compact
-    def __call__(self, x, train: bool = True, rng=None):
+    def __call__(self, x, train: bool = True, used_token=None, rng=None):
         orig_shape = x.shape
         model_dim = x.shape[-1]
         tokens = x.reshape(-1, model_dim)
+        if used_token is not None:
+            used_token = used_token.reshape(-1).astype(jnp.float32)
 
         if self.num_experts % self.ep_size != 0:
             raise ValueError(
@@ -132,7 +149,7 @@ class ExpertParallelFFN(nn.Module):
             min_capacity=self.min_capacity,
             noisy_gate_policy=self.noisy_gate_policy,
             name="gate",
-        )(tokens, train=train, rng=rng)
+        )(tokens, train=train, used_token=used_token, rng=rng)
 
         # (S,E,C) x (S,M) -> (E,C,M), grouped by owning rank
         outbound = jnp.einsum(
@@ -190,7 +207,10 @@ class MoE(nn.Module):
     ep_axis: Union[str, Tuple[str, ...], None] = ("inter", "intra")
 
     @nn.compact
-    def __call__(self, x, train: bool = True, rng=None):
+    def __call__(self, x, train: bool = True, used_token=None, rng=None):
+        """``used_token``: optional 0/1 mask over tokens (any shape reshaping
+        to ``x``'s token count) — masked-out tokens are not routed (reference
+        ``MoE.forward``'s ``used_token``, ``layer.py:90-96``)."""
         return ExpertParallelFFN(
             num_experts=self.num_experts,
             hidden_dim=self.hidden_size,
@@ -202,4 +222,4 @@ class MoE(nn.Module):
             ep_size=self.ep_size,
             ep_axis=self.ep_axis,
             name="moe_layer",
-        )(x, train=train, rng=rng)
+        )(x, train=train, used_token=used_token, rng=rng)

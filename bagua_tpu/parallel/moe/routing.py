@@ -122,11 +122,18 @@ def route_top1(
 
 
 def route_top2(
-    logits: jnp.ndarray, capacity_factor: float, rng: Optional[jax.Array] = None
+    logits: jnp.ndarray,
+    capacity_factor: float,
+    rng: Optional[jax.Array] = None,
+    used_token: Optional[jnp.ndarray] = None,
 ) -> Routing:
     """Top-2 routing (reference ``sharded_moe.py:168-239``): each token's two
     best experts share it, with renormalized weights; second choices queue
-    behind every first choice in the capacity count."""
+    behind every first choice in the capacity count.
+
+    ``used_token`` masks tokens out of routing entirely — a deliberate
+    extension: the reference's ``top2gating`` silently ignores the mask its
+    ``TopKGate.forward`` accepts (``sharded_moe.py:298-303``)."""
     probs = jax.nn.softmax(logits, axis=1)
     num_tokens, num_experts = probs.shape
     capacity = expert_capacity(num_tokens, num_experts, capacity_factor, k=2)
@@ -137,6 +144,9 @@ def route_top2(
     )
     second_scores = jnp.where(first > 0, -jnp.inf, second_scores)
     second = jax.nn.one_hot(jnp.argmax(second_scores, axis=1), num_experts, dtype=jnp.float32)
+    if used_token is not None:
+        first = used_token[:, None] * first
+        second = used_token[:, None] * second
 
     demand = jnp.sum(first, axis=0).astype(jnp.int32)
     # top-2 scaling: mean over experts of (prob share x routed share) x E^2

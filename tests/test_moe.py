@@ -65,6 +65,116 @@ def test_top1_capacity_truncation():
     assert int(exp_counts[0]) == 8  # pre-capacity count
 
 
+def test_top1_used_token_masks_routing():
+    """used_token=0 tokens are not routed at all and do not consume capacity
+    (reference top1gating's used_token einsum, sharded_moe.py:122-123)."""
+    rng = np.random.RandomState(2)
+    S, E = 16, 4
+    logits = jnp.asarray(rng.randn(S, E).astype(np.float32))
+    used = jnp.asarray((np.arange(S) % 2 == 0).astype(np.float32))  # every other
+    l_aux, combine, dispatch, exp_counts = route_top1(
+        logits, capacity_factor=1.0, min_capacity=2, used_token=used
+    )
+    per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    assert (per_token[1::2] == 0).all()  # masked tokens never dispatched
+    # demand histogram counts only used tokens
+    mask1 = jax.nn.one_hot(jnp.argmax(jax.nn.softmax(logits, 1), axis=1), E)
+    np.testing.assert_array_equal(
+        np.asarray(exp_counts), np.asarray((used[:, None] * mask1).sum(0), np.int32)
+    )
+
+
+def test_top2_used_token_masks_routing():
+    """used_token also masks top-2 routing (deliberate extension — the
+    reference's top2gating drops the mask its TopKGate accepts)."""
+    rng = np.random.RandomState(6)
+    S, E = 16, 4
+    logits = jnp.asarray(rng.randn(S, E).astype(np.float32))
+    used = jnp.asarray((np.arange(S) < 8).astype(np.float32))
+    _, combine, dispatch, exp_counts = route_top2(
+        logits, capacity_factor=2.0, used_token=used
+    )
+    per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    assert (per_token[8:] == 0).all() and (per_token[:8] > 0).all()
+    assert int(np.asarray(exp_counts).sum()) == 8  # only used first-choices
+
+
+def test_top1_rsample_uses_noised_argmax_but_clean_weights():
+    """RSample: argmax over gumbel-noised logits; combine weights and l_aux
+    still come from the un-noised softmax (reference sharded_moe.py:101-117)."""
+    rng = np.random.RandomState(3)
+    S, E = 32, 4
+    logits = jnp.asarray(rng.randn(S, E).astype(np.float32) * 0.1)  # near-uniform
+    key = jax.random.PRNGKey(0)
+    l_clean, c_clean, d_clean, _ = route_top1(logits, 2.0, min_capacity=2)
+    l_noise, c_noise, d_noise, _ = route_top1(
+        logits, 2.0, min_capacity=2, noisy_gate_policy="RSample", rng=key
+    )
+    # noise must change at least one token's expert choice on near-uniform logits
+    assert not np.array_equal(np.asarray(d_clean), np.asarray(d_noise))
+    # every dispatched token's combine weight equals its clean softmax prob
+    probs = np.asarray(jax.nn.softmax(logits, axis=1))
+    combine = np.asarray(c_noise)
+    for s, e in zip(*np.nonzero(combine.sum(2))):
+        np.testing.assert_allclose(combine[s, e].sum(), probs[s, e], rtol=1e-5)
+    with pytest.raises(ValueError, match="requires an rng"):
+        route_top1(logits, 2.0, noisy_gate_policy="RSample")
+
+
+def test_min_capacity_floor_default():
+    """Default min_capacity=4 (reference TopKGate default, sharded_moe.py:271):
+    tiny batches still give each expert at least 4 slots."""
+    logits = jnp.zeros((4, 8), jnp.float32)  # 4 tokens, 8 experts -> ceil=1
+    _, combine, _, _ = route_top1(logits, capacity_factor=1.0)
+    assert combine.shape[-1] == 4
+
+
+def test_router_jitter_and_eval_capacity(group):
+    """Jitter multiplies the gate input by uniform(1-1e-2, 1+1e-2) in training
+    only; eval uses eval_capacity_factor (reference TopKGate.forward:282-303)."""
+    from bagua_tpu.parallel.moe.layer import Router
+
+    rng = np.random.RandomState(4)
+    tokens = jnp.asarray(rng.randn(16, MODEL_DIM).astype(np.float32))
+    router = Router(
+        num_experts=4, k=1, capacity_factor=2.0, eval_capacity_factor=0.5,
+        min_capacity=1, noisy_gate_policy="Jitter",
+    )
+    params = router.init(jax.random.PRNGKey(0), tokens)
+    key = jax.random.PRNGKey(7)
+    train_routing = router.apply(params, tokens, train=True, rng=key)
+    eval_routing = router.apply(params, tokens, train=False)
+    # capacity: train ceil(16/4*2)=8 vs eval max(ceil(16/4*0.5), 1)=2
+    assert train_routing.combine_weights.shape[-1] == 8
+    assert eval_routing.combine_weights.shape[-1] == 2
+    # jitter is bounded: dispatch demand may shift but the weights stay within
+    # the clean softmax's neighborhood; eval (no jitter) is deterministic
+    eval2 = router.apply(params, tokens, train=False)
+    np.testing.assert_array_equal(
+        np.asarray(eval_routing.combine_weights), np.asarray(eval2.combine_weights)
+    )
+    # train=True without rng must fail loudly for Jitter
+    with pytest.raises(ValueError, match="requires an rng"):
+        router.apply(params, tokens, train=True)
+    bad = Router(num_experts=4, noisy_gate_policy="Wiggle")
+    with pytest.raises(ValueError, match="unknown noisy_gate_policy"):
+        bad.init(jax.random.PRNGKey(0), tokens)
+
+
+def test_moe_used_token_end_to_end(group):
+    """used_token flows MoE -> ExpertParallelFFN -> Router: masked tokens
+    produce zero MoE output."""
+    x = jnp.asarray(np.random.RandomState(5).randn(2, 8, MODEL_DIM), jnp.float32)
+    used = jnp.ones((2, 8), jnp.float32).at[0, :4].set(0.0)
+    moe = MoE(hidden_size=MODEL_DIM * 2, num_experts=4, capacity_factor=4.0,
+              ep_size=1, ep_axis=None)
+    params = moe.init(jax.random.PRNGKey(0), x)
+    out, _ = moe.apply(params, x, used_token=used)
+    out = np.asarray(out)
+    assert np.all(out[0, :4] == 0.0)  # masked tokens: nothing routed back
+    assert np.any(out[0, 4:] != 0.0)  # unmasked tokens flow through experts
+
+
 class MoEModel(nn.Module):
     num_experts: int
     ep_size: int

@@ -161,12 +161,7 @@ def test_baguarun_subprocess_fanout(tmp_path):
     gangs rendezvous into one jax.distributed world."""
     script = tmp_path / "worker.py"
     script.write_text(BAGUARUN_WORKER)
-    import os
-
-    env = dict(os.environ)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
-    env["BAGUARUN_WORK"] = str(tmp_path)
-    env.pop("XLA_FLAGS", None)
+    env = worker_env(BAGUARUN_WORK=str(tmp_path))
     r = subprocess.run(
         [
             sys.executable, "-m", "bagua_tpu.distributed.baguarun",
@@ -232,12 +227,7 @@ def test_multiprocess_autotune_tunes(tmp_path):
     AUTO_TUNE_SERVER_ADDR, and both workers adopt a re-bucketed plan."""
     script = tmp_path / "worker.py"
     script.write_text(AUTOTUNE_WORKER)
-    import os
-
-    env = dict(os.environ)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
-    env["AT_WORK"] = str(tmp_path)
-    env.pop("XLA_FLAGS", None)  # 1 device per process
+    env = worker_env(AT_WORK=str(tmp_path))  # 1 device per process
     r = subprocess.run(
         [
             sys.executable, "-m", "bagua_tpu.distributed.run",
@@ -333,19 +323,19 @@ def test_communication_primitives_example_two_process(tmp_path):
     under a real 2-process launch."""
     import os
 
-    env = dict(os.environ)
+    from helpers import REPO_ROOT
+
+    env = worker_env(JAX_PLATFORMS="cpu")  # 1 device per process
     # The example is backend-agnostic (no jax.config override of its own), so
     # pin the workers to CPU: drop the axon sitecustomize dir from PYTHONPATH
-    # and set JAX_PLATFORMS, giving each worker one CPU device.
-    env["PYTHONPATH"] = "/root/repo"
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)  # 1 device per process
+    # (it force-registers the TPU plugin) and set JAX_PLATFORMS.
+    env["PYTHONPATH"] = REPO_ROOT
     r = subprocess.run(
         [
             sys.executable, "-m", "bagua_tpu.distributed.run",
             "--nproc_per_node", "2", "--master_port", str(free_port()),
             "--monitor_interval", "0.2",
-            "/root/repo/examples/communication_primitives/main.py",
+            os.path.join(REPO_ROOT, "examples", "communication_primitives", "main.py"),
         ],
         env=env, capture_output=True, text=True, timeout=240,
     )

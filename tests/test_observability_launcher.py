@@ -80,9 +80,9 @@ def run_launcher(tmp_path, script_body: str, extra_args=None, max_restarts=1):
         "--nproc_per_node", "2", "--max_restarts", str(max_restarts),
         "--monitor_interval", "0.2",
     ] + (extra_args or []) + [str(script)]
-    env = dict(os.environ)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
+    from helpers import worker_env
+
+    env = worker_env(JAX_PLATFORMS="cpu")
     return subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=120)
 
 
@@ -196,14 +196,10 @@ def test_elastic_shrink_resumes_from_checkpoint(tmp_path):
     rendezvous port, and training resumes from the checkpoint."""
     script = tmp_path / "worker.py"
     script.write_text(textwrap.dedent(ELASTIC_WORKER))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
-    env["ELASTIC_WORK_DIR"] = str(tmp_path)
-    env.pop("XLA_FLAGS", None)  # 1 device per process
-    import socket
+    from helpers import free_port, worker_env
 
-    s = socket.socket(); s.bind(("127.0.0.1", 0))
-    base_port = s.getsockname()[1]; s.close()
+    env = worker_env(ELASTIC_WORK_DIR=str(tmp_path))  # 1 device per process
+    base_port = free_port()
     r = subprocess.run(
         [
             sys.executable, "-m", "bagua_tpu.distributed.run",
