@@ -141,6 +141,14 @@ def parse_args(argv=None):
             # advertising loopback as this node's gang-reachable address
             # would strand peers if this node ever owns rank 0.
             if resolved is None or resolved.startswith("127."):
+                if args.use_rdzv:
+                    logger.warning(
+                        "cannot resolve a non-loopback address for this host "
+                        "(got %s); advertising --master_addr %s instead — if "
+                        "this node is ever elected coordinator, peers will "
+                        "dial the wrong host.  Pass --host_addr explicitly.",
+                        resolved, args.master_addr,
+                    )
                 args.host_addr = args.master_addr
             else:
                 args.host_addr = resolved

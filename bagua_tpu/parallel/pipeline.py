@@ -1,22 +1,67 @@
-"""Pipeline parallelism: GPipe-style microbatch streaming over a ``pp`` axis.
+"""Pipeline parallelism: microbatch streaming over a ``pp`` mesh axis.
 
 Not in the reference (SURVEY §2.4: PP "no") — provided as a first-class mesh
 capability.  SPMD formulation: every rank holds ONE stage's parameters
-(stages must share a structure, e.g. uniform transformer blocks).  Time is
-``T = n_stages + n_microbatches - 1`` ticks; at tick ``t`` stage ``s`` is
-active for microbatch ``m = t - s``.  Activations hop to the next stage with
-a single neighbor ``ppermute`` per tick, so in-flight memory per chip is one
-microbatch and the wire pattern is the classic pipeline bubble.
+(stages must share a structure, e.g. uniform transformer blocks).
+Activations hop to the next stage with a single neighbor ``ppermute`` per
+tick.
 
-Because the whole schedule is one traced ``fori_loop``, ``jax.grad``
-differentiates straight through it — the backward pipeline (reverse
-``ppermute``s) falls out of autodiff instead of hand-written scheduling.
+Two schedules:
+
+* **GPipe** (:func:`pipeline_apply` / :func:`pipeline_loss`): time is
+  ``T = n_stages + n_microbatches - 1`` ticks; at tick ``t`` stage ``s`` is
+  active for microbatch ``m = t - s``.  The whole schedule is one traced
+  ``fori_loop``, so ``jax.grad`` differentiates straight through it — the
+  backward pipeline (reverse ``ppermute``s) falls out of autodiff.  Autodiff
+  stores residuals for every tick, so activation memory grows with the
+  microbatch count (``remat=True`` shrinks the per-tick residual to the
+  stage *input*).
+
+* **1F1B** (:func:`pipeline_train_1f1b`): the forward AND backward pipelines
+  are hand-scheduled into one loop — at tick ``t`` stage ``s`` runs forward
+  for microbatch ``t - s`` and backward for ``t - (2(S-1) - s)``, so the
+  last stage alternates F/B immediately (the classic one-forward-one-backward
+  steady state).  Only stage *inputs* are stashed, in a ring buffer of
+  ``2S - 1`` slots — live memory is **independent of the microbatch count**,
+  and the backward recomputes the stage forward from the stashed input
+  (rematerialization is built into the schedule, the standard 1F1B+remat
+  pairing).  Loss cotangents seed at the last stage and ride the reverse
+  neighbor ``ppermute``; no activation is ever broadcast — the only
+  cross-stage value outside the hops is the scalar loss (one ``psum``).
 """
 
-from typing import Callable, Tuple, Union
+from typing import Callable, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+
+
+class PipelineGrads(NamedTuple):
+    """Gradients from :func:`pipeline_train_1f1b`.
+
+    ``stage``: THIS rank's stage-parameter grads.
+    ``inputs``: d(loss)/d(microbatches) — real on pipeline rank 0, zeros
+        elsewhere (``psum`` over the pp axis recovers it; only requested via
+        ``with_input_grads``).  Feeds the backward of whatever produced the
+        microbatches (e.g. an embedding outside the pipeline).
+    ``loss_params``: grads of ``loss_params`` (e.g. an LM head applied inside
+        ``loss_fn``) — real on the LAST rank, zeros elsewhere (``psum`` over
+        pp recovers)."""
+
+    stage: object
+    inputs: Optional[jnp.ndarray] = None
+    loss_params: Optional[object] = None
+
+
+def _pipeline_axes(axis_name) -> Tuple[Tuple[str, ...], int]:
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    try:
+        n_stages = 1
+        for a in axes:
+            n_stages *= jax.lax.axis_size(a)
+    except NameError:
+        n_stages = 1
+    return axes, n_stages
 
 
 def pipeline_apply(
@@ -24,8 +69,9 @@ def pipeline_apply(
     stage_params,
     microbatches: jnp.ndarray,
     axis_name: Union[str, Tuple[str, ...]] = "pp",
+    remat: bool = False,
 ):
-    """Run ``microbatches`` through the pipeline.
+    """Run ``microbatches`` through the pipeline (GPipe schedule).
 
     Args:
         stage_fn: ``stage_fn(stage_params, x) -> y``; both ``x`` and ``y``
@@ -34,53 +80,24 @@ def pipeline_apply(
         microbatches: ``(n_microbatches, mb, ...)``, consumed by stage 0
             (other ranks ignore the values but must pass the same shape).
         axis_name: the pipeline mesh axis.
+        remat: wrap ``stage_fn`` in ``jax.checkpoint`` so autodiff through
+            the schedule stores only each tick's stage input, recomputing
+            internals in the backward pass.
 
     Returns:
         ``(n_microbatches, mb, ...)`` outputs of the LAST stage, broadcast to
-        every pp rank (so the loss can be computed anywhere).
+        every pp rank (so the loss can be computed anywhere).  Training loops
+        that only need the loss should use :func:`pipeline_loss` (scalar
+        traffic) or :func:`pipeline_train_1f1b` (bounded memory) instead.
     """
-    from bagua_tpu.communication import broadcast_inplace, ppermute_shift, rank_id
+    from bagua_tpu.communication import broadcast_inplace
 
-    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
-    try:
-        n_stages = 1
-        for a in axes:
-            n_stages *= jax.lax.axis_size(a)
-    except NameError:
-        n_stages = 1
-    n_micro = microbatches.shape[0]
+    axes, n_stages = _pipeline_axes(axis_name)
     if n_stages == 1:
+        if remat:
+            stage_fn = jax.checkpoint(stage_fn)
         return jax.vmap(lambda x: stage_fn(stage_params, x))(microbatches)
-
-    my = rank_id(axes)
-    ticks = n_stages + n_micro - 1
-    mb_shape = microbatches.shape[1:]
-
-    def tick(t, carry):
-        outbuf, collected = carry
-        # activation from the previous stage (computed last tick)
-        recv = ppermute_shift(outbuf, 1, axes)
-        m = t - my  # microbatch index this stage works on now
-        active = (m >= 0) & (m < n_micro)
-        m_clipped = jnp.clip(m, 0, n_micro - 1)
-        x_first = jax.lax.dynamic_index_in_dim(
-            microbatches, m_clipped, axis=0, keepdims=False
-        )
-        x_in = jnp.where(my == 0, x_first, recv)
-        y = stage_fn(stage_params, x_in)
-        y = jnp.where(active, y, jnp.zeros_like(y))
-        is_last = my == n_stages - 1
-        collected = jax.lax.cond(
-            active & is_last,
-            lambda c: jax.lax.dynamic_update_index_in_dim(c, y, m_clipped, axis=0),
-            lambda c: c,
-            collected,
-        )
-        return y, collected
-
-    out0 = jnp.zeros(mb_shape, microbatches.dtype)
-    collected0 = jnp.zeros((n_micro,) + mb_shape, microbatches.dtype)
-    _, collected = jax.lax.fori_loop(0, ticks, tick, (out0, collected0))
+    collected = _gpipe_collect(stage_fn, stage_params, microbatches, axes, remat)
     # Ship the last stage's outputs to every pp rank.  Every rank then
     # computes an IDENTICAL loss on them (the natural SPMD usage); since the
     # broadcast's psum-transpose would sum those replicated cotangents,
@@ -104,3 +121,239 @@ def _scale_grad_bwd(scale, g):
 
 
 _scale_grad.defvjp(_scale_grad_fwd, _scale_grad_bwd)
+
+
+def pipeline_loss(
+    stage_fn: Callable,
+    stage_params,
+    microbatches: jnp.ndarray,
+    targets: jnp.ndarray,
+    loss_fn: Callable,
+    axis_name: Union[str, Tuple[str, ...]] = "pp",
+    remat: bool = False,
+):
+    """Mean microbatch loss of the pipeline — GPipe schedule, but only a
+    SCALAR crosses stages: the last stage's per-microbatch losses are summed
+    and ``psum``'d, so no ``(n_micro, mb, ...)`` activation broadcast happens
+    (the round-2 ``pipeline_apply`` perf note).  Differentiable:
+    ``jax.grad(pipeline_loss)`` runs the reverse pipeline; the psum transpose
+    seeds cotangents only at the last stage (masked by rank)."""
+    from bagua_tpu.communication import allreduce_inplace, rank_id
+    from bagua_tpu.defs import ReduceOp
+
+    axes, n_stages = _pipeline_axes(axis_name)
+    if n_stages == 1:
+        out = pipeline_apply(stage_fn, stage_params, microbatches, axis_name, remat)
+        return jnp.mean(jax.vmap(loss_fn)(out, targets))
+    collected = _gpipe_collect(stage_fn, stage_params, microbatches, axes, remat)
+    per_mb = jax.vmap(loss_fn)(collected, targets)  # real only on the last stage
+    mine = jnp.where(rank_id(axes) == n_stages - 1, jnp.mean(per_mb), 0.0)
+    total = allreduce_inplace(mine, op=ReduceOp.SUM, axis=axes)
+    # Every rank returns the replicated scalar, so jax.grad seeds a cotangent
+    # of 1 on each of the n_stages ranks and the psum transpose sums them —
+    # scale the backward by 1/n_stages so gradients match the sequential
+    # program (same trick as pipeline_apply's broadcast).
+    return _scale_grad(total, 1.0 / n_stages)
+
+
+def _gpipe_collect(stage_fn, stage_params, microbatches, axes, remat):
+    """The GPipe forward loop without the output broadcast: returns the
+    collected last-stage outputs (zeros on every other rank)."""
+    from bagua_tpu.communication import ppermute_shift, rank_id
+
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+    _, n_stages = _pipeline_axes(axes)
+    n_micro = microbatches.shape[0]
+    my = rank_id(axes)
+    ticks = n_stages + n_micro - 1
+    mb_shape = microbatches.shape[1:]
+
+    def tick(t, carry):
+        outbuf, collected = carry
+        recv = ppermute_shift(outbuf, 1, axes)
+        m = t - my
+        active = (m >= 0) & (m < n_micro)
+        m_clipped = jnp.clip(m, 0, n_micro - 1)
+        x_first = jax.lax.dynamic_index_in_dim(
+            microbatches, m_clipped, axis=0, keepdims=False
+        )
+        x_in = jnp.where(my == 0, x_first, recv)
+        y = stage_fn(stage_params, x_in)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        collected = jax.lax.cond(
+            active & (my == n_stages - 1),
+            lambda c: jax.lax.dynamic_update_index_in_dim(c, y, m_clipped, axis=0),
+            lambda c: c,
+            collected,
+        )
+        return y, collected
+
+    out0 = jnp.zeros(mb_shape, microbatches.dtype)
+    collected0 = jnp.zeros((n_micro,) + mb_shape, microbatches.dtype)
+    _, collected = jax.lax.fori_loop(0, ticks, tick, (out0, collected0))
+    return collected
+
+
+def pipeline_train_1f1b(
+    stage_fn: Callable,
+    stage_params,
+    microbatches: jnp.ndarray,
+    targets: jnp.ndarray,
+    loss_fn: Callable,
+    axis_name: Union[str, Tuple[str, ...]] = "pp",
+    loss_params=None,
+    with_input_grads: bool = False,
+):
+    """One-forward-one-backward pipeline training step.
+
+    Hand-scheduled forward+backward (NOT autodiff through the loop): at tick
+    ``t`` stage ``s`` runs the forward for microbatch ``mf = t - s`` and the
+    backward for ``mb = t - (2(S-1) - s)`` — on the last stage ``mf == mb``,
+    the classic 1F1B cadence.  Only the stage *input* of each in-flight
+    microbatch is stashed (ring buffer, ``2S - 1`` slots); the backward
+    re-runs ``stage_fn`` from the stash under ``jax.vjp``
+    (rematerialization).  Peak live activations are therefore ``O(S)``
+    microbatches per rank regardless of ``n_micro`` — vs the GPipe autodiff
+    path whose residual stack grows with ``n_micro + S``.
+
+    Args:
+        stage_fn: ``stage_fn(stage_params, x) -> y`` (uniform stages).
+        stage_params: THIS rank's stage parameters.
+        microbatches: ``(n_micro, mb, ...)`` consumed by stage 0.
+        targets: ``(n_micro, ...)`` consumed by the LAST stage
+            (other ranks must pass the same shape).
+        loss_fn: ``loss_fn(y, target) -> scalar``, or — with ``loss_params``
+            — ``loss_fn(loss_params, y, target) -> scalar`` (e.g. an LM head
+            + cross entropy evaluated on the last stage's output).
+        axis_name: the pipeline mesh axis (or tuple of axes).
+        loss_params: optional parameters used inside ``loss_fn``; their
+            grads come back in ``PipelineGrads.loss_params``.
+        with_input_grads: also return d(loss)/d(microbatches) (for a model
+            front like an embedding living outside the pipeline).
+
+    Returns:
+        ``(loss, grads)``: the scalar mean microbatch loss (identical on
+        every pp rank — one scalar psum), and this rank's gradients.
+        ``grads`` is the bare stage pytree in the simple case, or a
+        :class:`PipelineGrads` when ``loss_params``/``with_input_grads``
+        are used.  Values match ``jax.grad(pipeline_loss)`` exactly.
+    """
+    from bagua_tpu.communication import allreduce_inplace, ppermute_shift, rank_id
+    from bagua_tpu.defs import ReduceOp
+
+    extended = loss_params is not None or with_input_grads
+    if loss_params is None:
+        full_loss_fn = lambda _none, y, t: loss_fn(y, t)  # noqa: E731
+        loss_params = ()
+    else:
+        full_loss_fn = loss_fn
+
+    axes, n_stages = _pipeline_axes(axis_name)
+    n_micro = microbatches.shape[0]
+    if n_stages == 1:
+        def total(p, lp, mbs):
+            out = jax.vmap(lambda x: stage_fn(p, x))(mbs)
+            return jnp.mean(jax.vmap(lambda y, t: full_loss_fn(lp, y, t))(out, targets))
+
+        loss, (dstage, dlp, dmb) = jax.value_and_grad(total, argnums=(0, 1, 2))(
+            stage_params, loss_params, microbatches
+        )
+        if not extended:
+            return loss, dstage
+        return loss, PipelineGrads(
+            stage=dstage,
+            inputs=dmb if with_input_grads else None,
+            loss_params=dlp,
+        )
+
+    my = rank_id(axes)
+    is_first = my == 0
+    is_last = my == n_stages - 1
+    mb_shape = microbatches.shape[1:]
+    stash_slots = 2 * n_stages - 1  # max in-flight microbatches per rank + 1
+    ticks = n_micro + 2 * n_stages - 2
+
+    def tick(t, carry):
+        y_prev, dx_prev, stash, dgrads, dlp_acc, dinputs, loss_acc = carry
+        # neighbor hops from LAST tick's compute: activations go s-1 -> s,
+        # cotangents go s+1 -> s
+        recv_f = ppermute_shift(y_prev, 1, axes)
+        recv_g = ppermute_shift(dx_prev, -1, axes)
+
+        # ---- forward: microbatch mf = t - s --------------------------------
+        mf = t - my
+        active_f = (mf >= 0) & (mf < n_micro)
+        mf_c = jnp.clip(mf, 0, n_micro - 1)
+        x_first = jax.lax.dynamic_index_in_dim(microbatches, mf_c, 0, keepdims=False)
+        x_in = jnp.where(is_first, x_first, recv_f)
+        y = stage_fn(stage_params, x_in)
+        y = jnp.where(active_f, y, jnp.zeros_like(y))
+        stash = jax.lax.cond(
+            active_f,
+            lambda s_: jax.lax.dynamic_update_index_in_dim(
+                s_, x_in, mf_c % stash_slots, axis=0
+            ),
+            lambda s_: s_,
+            stash,
+        )
+
+        # ---- backward: microbatch mb = t - (2(S-1) - s) --------------------
+        mb = t - (2 * (n_stages - 1) - my)
+        active_b = (mb >= 0) & (mb < n_micro)
+        mb_c = jnp.clip(mb, 0, n_micro - 1)
+        x_saved = jax.lax.dynamic_index_in_dim(
+            stash, mb_c % stash_slots, 0, keepdims=False
+        )
+        target = jax.lax.dynamic_index_in_dim(targets, mb_c, 0, keepdims=False)
+
+        # Cotangent feeding this stage: the last stage seeds from the loss on
+        # the y it just computed (mf == mb there); others take the hop.
+        loss_m, (dlp, dy_loss) = jax.value_and_grad(full_loss_fn, argnums=(0, 1))(
+            loss_params, y, target
+        )
+        g_in = jnp.where(is_last, dy_loss / n_micro, recv_g)
+
+        # Recompute the stage forward from the stashed input and pull back
+        # (the remat: nothing but x_in was kept from the forward pass).
+        _, pullback = jax.vjp(stage_fn, stage_params, x_saved)
+        dp, dx = pullback(g_in)
+        # where (select), NOT a 0/1 multiply: inactive ticks can produce
+        # non-finite dp (e.g. a loss gradient undefined at the zero
+        # placeholder y), and 0 * inf = NaN would poison the accumulator.
+        dgrads = jax.tree.map(
+            lambda a, d: a + jnp.where(active_b, d, jnp.zeros_like(d)), dgrads, dp
+        )
+        seed_b = active_b & is_last
+        dlp_acc = jax.tree.map(
+            lambda a, d: a + jnp.where(seed_b, d / n_micro, jnp.zeros_like(d)),
+            dlp_acc, dlp,
+        )
+        dx = jnp.where(active_b, dx, jnp.zeros_like(dx))
+        if dinputs is not None:
+            dinputs = jax.lax.cond(
+                active_b,
+                lambda b: jax.lax.dynamic_update_index_in_dim(
+                    b, jnp.where(is_first, dx, jnp.zeros_like(dx)), mb_c, axis=0
+                ),
+                lambda b: b,
+                dinputs,
+            )
+        loss_acc = loss_acc + jnp.where(active_b & is_last, loss_m, 0.0)
+        return y, dx, stash, dgrads, dlp_acc, dinputs, loss_acc
+
+    y0 = jnp.zeros(mb_shape, microbatches.dtype)
+    stash0 = jnp.zeros((stash_slots,) + mb_shape, microbatches.dtype)
+    dgrads0 = jax.tree.map(jnp.zeros_like, stage_params)
+    dlp0 = jax.tree.map(jnp.zeros_like, loss_params)
+    dinputs0 = jnp.zeros_like(microbatches) if with_input_grads else None
+    _, _, _, dgrads, dlp_acc, dinputs, loss_acc = jax.lax.fori_loop(
+        0, ticks, tick,
+        (y0, y0, stash0, dgrads0, dlp0, dinputs0, jnp.zeros((), jnp.float32)),
+    )
+    loss = allreduce_inplace(
+        jnp.where(is_last, loss_acc / n_micro, 0.0), op=ReduceOp.SUM, axis=axes
+    )
+    if not extended:
+        return loss, dgrads
+    return loss, PipelineGrads(stage=dgrads, inputs=dinputs, loss_params=dlp_acc)

@@ -33,7 +33,7 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from bagua_tpu.models.gpt import GPTBlock, GPTConfig
-from bagua_tpu.parallel.pipeline import pipeline_apply
+from bagua_tpu.parallel.pipeline import pipeline_apply, pipeline_train_1f1b
 
 
 class GPTStage(nn.Module):
@@ -77,6 +77,10 @@ def main(argv=None):
     p.add_argument("--batch", type=int, default=8, help="global batch")
     p.add_argument("--microbatches", type=int, default=2)
     p.add_argument("--steps", type=int, default=5)
+    p.add_argument(
+        "--schedule", choices=["1f1b", "gpipe"], default="1f1b",
+        help="pipeline schedule: 1F1B (bounded-memory, remat) or GPipe",
+    )
     args = p.parse_args(argv)
 
     n = args.dp * args.pp * args.tp * args.sp
@@ -111,35 +115,69 @@ def main(argv=None):
         my_stage = jax.tree.map(lambda x: x[0], stage_stacked)  # this rank's slice
         my_s_opt = jax.tree.map(lambda x: x[0], s_opt_stacked)
 
-        def loss_fn(triple):
-            e_p, s_p, h_p = triple
-            x = embed.apply({"params": e_p}, ids)  # (b_local, t_local, hidden)
-            micro = x.reshape(
-                args.microbatches, b_local // args.microbatches, t_local, args.hidden
-            )
-            y = pipeline_apply(
-                lambda sp_, u: stage.apply({"params": sp_}, u), s_p, micro,
-                axis_name="pp",
-            )
-            h = y.reshape(b_local, t_local, args.hidden)
-            logits = head.apply({"params": h_p}, h)
+        mb_rows = b_local // args.microbatches
+
+        def head_loss(h_p, y, lbl):
+            # the LM head + cross entropy, evaluated on the LAST pipeline
+            # stage's output only (1F1B's loss_params surface)
+            logits = head.apply({"params": h_p}, y)
             logp = jax.nn.log_softmax(logits)
-            return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+            return -jnp.mean(jnp.take_along_axis(logp, lbl[..., None], axis=-1))
 
-        loss, grads = jax.value_and_grad(loss_fn)((embed_p, my_stage, head_p))
-        g_embed, g_stage, g_head = grads
+        if args.schedule == "1f1b":
+            # Hand-scheduled 1F1B: stage inputs stashed in a bounded ring
+            # buffer, backward recomputes (remat); only the scalar loss is
+            # psum'd across stages.  Embedding backward is fed by the input
+            # cotangents the schedule returns on pp rank 0.
+            x, embed_vjp = jax.vjp(
+                lambda e_p: embed.apply({"params": e_p}, ids), embed_p
+            )
+            micro = x.reshape(args.microbatches, mb_rows, t_local, args.hidden)
+            labels_m = labels.reshape(args.microbatches, mb_rows, t_local)
+            loss, grads = pipeline_train_1f1b(
+                lambda sp_, u: stage.apply({"params": sp_}, u), my_stage,
+                micro, labels_m, head_loss, axis_name="pp",
+                loss_params=head_p, with_input_grads=True,
+            )
+            g_stage = grads.stage
+            # input cotangents are real on pp rank 0 (zeros elsewhere): psum
+            # over pp, then pull back through the embedding
+            dx = jax.lax.psum(grads.inputs, "pp")
+            (g_embed,) = embed_vjp(dx.reshape(b_local, t_local, args.hidden))
+            g_embed = jax.tree.map(lambda g: jax.lax.pmean(g, ("dp", "sp")), g_embed)
+            # head grads live on the LAST pp rank (zeros elsewhere): psum
+            # over pp recovers, then average the data axes.
+            g_head = jax.tree.map(
+                lambda g: jax.lax.pmean(jax.lax.psum(g, "pp"), ("dp", "sp")),
+                grads.loss_params,
+            )
+            g_stage = jax.tree.map(lambda g: jax.lax.pmean(g, ("dp", "sp")), g_stage)
+        else:
+            def loss_fn(triple):
+                e_p, s_p, h_p = triple
+                x = embed.apply({"params": e_p}, ids)  # (b_local, t_local, hidden)
+                micro = x.reshape(args.microbatches, mb_rows, t_local, args.hidden)
+                y = pipeline_apply(
+                    lambda sp_, u: stage.apply({"params": sp_}, u), s_p, micro,
+                    axis_name="pp",
+                )
+                h = y.reshape(b_local, t_local, args.hidden)
+                return head_loss(h_p, h, labels)
 
-        # -- gradient sync rules ------------------------------------------
-        # embedding: grads enter the pipeline only on pp rank 0 -> psum over
-        # pp recovers the full gradient; then average over (dp, sp).
-        g_embed = jax.tree.map(
-            lambda g: jax.lax.pmean(jax.lax.psum(g, "pp"), ("dp", "sp")), g_embed
-        )
-        # stage params: pp-local (each rank owns its stage); average (dp, sp).
-        g_stage = jax.tree.map(lambda g: jax.lax.pmean(g, ("dp", "sp")), g_stage)
-        # head: computed identically on every pp rank (pipeline output is
-        # broadcast); average everywhere it is replicated.
-        g_head = jax.tree.map(lambda g: jax.lax.pmean(g, ("dp", "pp", "sp")), g_head)
+            loss, grads = jax.value_and_grad(loss_fn)((embed_p, my_stage, head_p))
+            g_embed, g_stage, g_head = grads
+
+            # -- gradient sync rules --------------------------------------
+            # embedding: grads enter the pipeline only on pp rank 0 -> psum
+            # over pp recovers the full gradient; then average over (dp, sp).
+            g_embed = jax.tree.map(
+                lambda g: jax.lax.pmean(jax.lax.psum(g, "pp"), ("dp", "sp")), g_embed
+            )
+            # stage params: pp-local (each rank owns its stage); average (dp, sp).
+            g_stage = jax.tree.map(lambda g: jax.lax.pmean(g, ("dp", "sp")), g_stage)
+            # head: computed identically on every pp rank (pipeline output is
+            # broadcast); average everywhere it is replicated.
+            g_head = jax.tree.map(lambda g: jax.lax.pmean(g, ("dp", "pp", "sp")), g_head)
 
         e_upd, e_opt = opt.update(g_embed, e_opt, embed_p)
         s_upd, my_s_opt = opt.update(g_stage, my_s_opt, my_stage)

@@ -1,5 +1,6 @@
 """Pipeline parallelism: forward equals sequential stage application; grads
-flow through the pipeline schedule correctly."""
+flow through the pipeline schedule correctly; 1F1B matches GPipe's values
+with bounded memory."""
 
 import jax
 import jax.numpy as jnp
@@ -7,7 +8,11 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
-from bagua_tpu.parallel.pipeline import pipeline_apply
+from bagua_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_loss,
+    pipeline_train_1f1b,
+)
 
 STAGES = 4
 MICRO = 6
@@ -110,3 +115,229 @@ def test_pipeline_single_stage_fallback():
     out = pipeline_apply(stage_fn, stages, micro, axis_name="pp")
     expect = jax.vmap(lambda x: stage_fn(stages, x))(micro)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expect), rtol=1e-6)
+
+
+def mse(y, t):
+    return jnp.mean((y - t) ** 2)
+
+
+def _data(seed, n_micro=MICRO):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randn(n_micro, MB, DIM).astype(np.float32)),
+        jnp.asarray(rng.randn(n_micro, MB, DIM).astype(np.float32)),
+    )
+
+
+def _oracle_loss_and_grads(stages, micro, target):
+    def total(stages_list):
+        out = sequential_oracle(stages_list, micro)
+        return jnp.mean(jax.vmap(mse)(out, target))
+
+    return jax.value_and_grad(total)(stages)
+
+
+def test_pipeline_loss_scalar_only(pp_mesh):
+    """pipeline_loss equals the loss on pipeline_apply outputs, and its HLO
+    carries no (n_micro, mb, dim) broadcast — only the scalar psum."""
+    stages = [make_stage_params(20 + s) for s in range(STAGES)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+    micro, target = _data(21)
+
+    def local(p, mb):
+        p_local = jax.tree.map(lambda q: q[0], p)
+        return pipeline_loss(stage_fn, p_local, mb, target, mse, axis_name="pp")
+
+    fn = jax.jit(
+        jax.shard_map(
+            local, mesh=pp_mesh, in_specs=(P("pp"), P()), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    expect, _ = _oracle_loss_and_grads(stages, micro, target)
+    np.testing.assert_allclose(float(fn(stacked, micro)), float(expect), rtol=2e-4)
+    # grads through pipeline_loss match the oracle too
+    grad_fn = jax.jit(
+        jax.shard_map(
+            lambda p, mb: jax.grad(local)(p, mb), mesh=pp_mesh,
+            in_specs=(P("pp"), P()), out_specs=P("pp"), check_vma=False,
+        )
+    )
+    got = grad_fn(stacked, micro)
+    _, expect_grads = _oracle_loss_and_grads(stages, micro, target)
+    for s in range(STAGES):
+        for key in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(got[key][s]), np.asarray(expect_grads[s][key]),
+                rtol=2e-3, atol=1e-4, err_msg=f"stage {s} {key}",
+            )
+
+
+def test_1f1b_matches_sequential_oracle(pp_mesh):
+    """1F1B loss and per-stage grads equal the sequential program's."""
+    stages = [make_stage_params(30 + s) for s in range(STAGES)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+    micro, target = _data(31)
+
+    def local(p, mb):
+        p_local = jax.tree.map(lambda q: q[0], p)
+        loss, grads = pipeline_train_1f1b(
+            stage_fn, p_local, mb, target, mse, axis_name="pp"
+        )
+        return loss, jax.tree.map(lambda g: g[None], grads)
+
+    fn = jax.jit(
+        jax.shard_map(
+            local, mesh=pp_mesh, in_specs=(P("pp"), P()),
+            out_specs=(P(), P("pp")), check_vma=False,
+        )
+    )
+    loss, grads = fn(stacked, micro)
+    expect_loss, expect_grads = _oracle_loss_and_grads(stages, micro, target)
+    np.testing.assert_allclose(float(loss), float(expect_loss), rtol=2e-4)
+    for s in range(STAGES):
+        for key in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(grads[key][s]), np.asarray(expect_grads[s][key]),
+                rtol=2e-3, atol=1e-4, err_msg=f"stage {s} {key}",
+            )
+
+
+def test_1f1b_memory_bounded_vs_gpipe(pp_mesh):
+    """The point of 1F1B+remat: peak temp memory stays flat as n_micro grows,
+    while GPipe-autodiff's residual stack grows with it."""
+    stages = [make_stage_params(40 + s) for s in range(STAGES)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+
+    def temp_bytes(build, n_micro):
+        micro, target = _data(41, n_micro)
+
+        def local(p, mb):
+            p_local = jax.tree.map(lambda q: q[0], p)
+            return build(p_local, mb, target)
+
+        lowered = jax.jit(
+            jax.shard_map(
+                local, mesh=pp_mesh, in_specs=(P("pp"), P()),
+                out_specs=P("pp"), check_vma=False,
+            )
+        ).lower(stacked, micro)
+        return lowered.compile().memory_analysis().temp_size_in_bytes
+
+    def gpipe_grads(p_local, mb, target):
+        return jax.grad(
+            lambda p: pipeline_loss(stage_fn, p, mb, target, mse, axis_name="pp")
+        )(p_local)
+
+    def f1b_grads(p_local, mb, target):
+        return pipeline_train_1f1b(stage_fn, p_local, mb, target, mse, "pp")[1]
+
+    small, large = 8, 64
+    gpipe_growth = temp_bytes(gpipe_grads, large) - temp_bytes(gpipe_grads, small)
+    f1b_small, f1b_large = temp_bytes(f1b_grads, small), temp_bytes(f1b_grads, large)
+    f1b_growth = f1b_large - f1b_small
+    # GPipe residuals grow ~ (n_micro * mb * dim * stages...); 1F1B's stash is
+    # fixed at (2S-1) slots -- its growth must be an order smaller.
+    assert f1b_growth * 4 < gpipe_growth, (f1b_growth, gpipe_growth)
+
+
+def test_1f1b_single_stage_fallback():
+    stages = make_stage_params(50)
+    micro, target = _data(51, 4)
+    loss, grads = pipeline_train_1f1b(stage_fn, stages, micro, target, mse, "pp")
+    expect_loss, expect_grads = jax.value_and_grad(
+        lambda p: jnp.mean(
+            jax.vmap(lambda x, t: mse(stage_fn(p, x), t))(micro, target)
+        )
+    )(stages)
+    np.testing.assert_allclose(float(loss), float(expect_loss), rtol=1e-5)
+    for key in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[key]), np.asarray(expect_grads[key]), rtol=1e-4
+        )
+
+
+def test_1f1b_extended_head_and_input_grads(pp_mesh):
+    """The extended surface for real models: loss_params (an LM-head analog
+    inside loss_fn) and input cotangents (for an embedding outside the
+    pipeline).  Both come back psum-recoverable over pp and match the
+    sequential oracle."""
+    stages = [make_stage_params(70 + s) for s in range(STAGES)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+    micro, _ = _data(71)
+    rng = np.random.RandomState(72)
+    head = {"v": jnp.asarray(rng.randn(DIM, 2).astype(np.float32))}
+    target = jnp.asarray(rng.randn(MICRO, MB, 2).astype(np.float32))
+
+    def head_loss(hp, y, t):
+        return jnp.mean((y @ hp["v"] - t) ** 2)
+
+    def oracle(stages_list, hp, mbs):
+        out = sequential_oracle(stages_list, mbs)
+        return jnp.mean(jax.vmap(lambda y, t: head_loss(hp, y, t))(out, target))
+
+    expect_loss, (eg_stages, eg_head, eg_micro) = jax.value_and_grad(
+        oracle, argnums=(0, 1, 2)
+    )(stages, head, micro)
+
+    def local(p, hp, mb):
+        p_local = jax.tree.map(lambda q: q[0], p)
+        loss, grads = pipeline_train_1f1b(
+            stage_fn, p_local, mb, target, head_loss, axis_name="pp",
+            loss_params=hp, with_input_grads=True,
+        )
+        # loss_params/input grads live on one rank each: psum to recover
+        g_head = jax.tree.map(lambda g: jax.lax.psum(g, "pp"), grads.loss_params)
+        g_micro = jax.lax.psum(grads.inputs, "pp")
+        return loss, jax.tree.map(lambda g: g[None], grads.stage), g_head, g_micro
+
+    fn = jax.jit(
+        jax.shard_map(
+            local, mesh=pp_mesh, in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P("pp"), P(), P()), check_vma=False,
+        )
+    )
+    loss, g_stage, g_head, g_micro = fn(stacked, head, micro)
+    np.testing.assert_allclose(float(loss), float(expect_loss), rtol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(g_head["v"]), np.asarray(eg_head["v"]), rtol=2e-3, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(g_micro), np.asarray(eg_micro), rtol=2e-3, atol=1e-4
+    )
+    for s in range(STAGES):
+        for key in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(g_stage[key][s]), np.asarray(eg_stages[s][key]),
+                rtol=2e-3, atol=1e-4, err_msg=f"stage {s} {key}",
+            )
+
+
+def test_gpipe_remat_same_values(pp_mesh):
+    """remat=True changes memory, not values."""
+    stages = [make_stage_params(60 + s) for s in range(STAGES)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+    micro, target = _data(61)
+
+    def grads(remat):
+        def local(p, mb):
+            p_local = jax.tree.map(lambda q: q[0], p)
+            return jax.grad(
+                lambda q: pipeline_loss(
+                    stage_fn, q, mb, target, mse, axis_name="pp", remat=remat
+                )
+            )(p_local)
+
+        fn = jax.jit(
+            jax.shard_map(
+                local, mesh=pp_mesh, in_specs=(P("pp"), P()),
+                out_specs=P("pp"), check_vma=False,
+            )
+        )
+        return fn(stacked, micro)
+
+    a, b = grads(False), grads(True)
+    for key in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(a[key]), np.asarray(b[key]), rtol=1e-5, atol=1e-7
+        )
