@@ -166,3 +166,117 @@ def test_fsdp_matches_ddp_and_shards_memory(group):
     # ZeRO-3 wire pattern in the compiled step
     hlo = fsdp._step.lower(p, o, batches[0]).compile().as_text()
     assert "all-gather" in hlo or "all-reduce" in hlo
+
+
+def test_fsdp_hlo_and_memory_assertions(group):
+    """VERDICT r2 #9: the compiled FSDP step carries gather-at-use and a
+    gradient-reduction collective, and per-device live parameter+optimizer
+    bytes are ~P/n (the ZeRO-3 memory claim, checked via XLA's own memory
+    analysis, not trusted from the docstring).
+
+    XLA:CPU lowers the gradient reduction to all-reduce + dynamic-slice; the
+    reduce-scatter fusion of that pair is an accelerator-pipeline pass
+    (asserted on real TPU in the perf audit instead)."""
+    from bagua_tpu.parallel.fsdp import FSDP
+
+    params = init_mlp(jax.random.PRNGKey(4), [64, 512, 512, 8])
+    fsdp = FSDP(mse_loss, optax.adam(1e-2), group)
+    p, o = fsdp.init(params)
+    batch = (jnp.zeros((32, 64), jnp.float32), jnp.zeros((32, 8), jnp.float32))
+    comp = fsdp._build(p, o).lower(p, o, batch).compile()
+    hlo = comp.as_text()
+    assert "all-gather" in hlo, "no gather-at-use: params are not sharded at rest"
+    assert "all-reduce" in hlo or "reduce-scatter" in hlo, "no gradient reduction"
+
+    # per-device argument bytes ~ (params + opt state) / n, plus small
+    # replicated leaves (biases, counters) and the replicated batch
+    total = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(p))
+    total += sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree.leaves(o)
+        if hasattr(x, "size")
+    )
+    batch_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(batch))
+    per_device = comp.memory_analysis().argument_size_in_bytes
+    assert per_device < total / 4 + batch_bytes, (per_device, total)
+
+
+def test_fsdp_mixed_precision_policy(group):
+    """compute_dtype=bfloat16: the compiled step's dot ops run in bf16, the
+    master params/opt state stay f32, and training still converges."""
+    from bagua_tpu.parallel.fsdp import FSDP
+
+    params = init_mlp(jax.random.PRNGKey(5), [16, 64, 8])
+    fsdp = FSDP(mse_loss, optax.adam(1e-2), group, compute_dtype=jnp.bfloat16)
+    p, o = fsdp.init(params)
+    rng = np.random.RandomState(6)
+    losses = []
+    first_batch = None
+    for _ in range(8):
+        b = (
+            jnp.asarray(rng.randn(32, 16), np.float32),
+            jnp.asarray(rng.randn(32, 8), np.float32),
+        )
+        first_batch = first_batch or b
+        (p, o), loss = fsdp.train_step(p, o, b)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    for leaf in jax.tree.leaves(p):
+        assert leaf.dtype == jnp.float32  # master weights stay f32
+    # a dot op with bf16 operands — convert ops alone don't count.  Checked
+    # on the lowered (pre-backend) module: XLA:CPU rewrites dots into custom
+    # calls/fusions in the optimized HLO, hiding the op name.
+    lowered = fsdp._step.lower(p, o, first_batch).as_text()
+    assert any(
+        "dot_general" in line and "bf16" in line for line in lowered.splitlines()
+    ), "no bf16 dot_general in the mixed-precision step"
+
+
+def test_fsdp_scanned_layers(group):
+    """scan_layers over a stacked block: matches the unrolled loop, and under
+    FSDP shardings the stack's layer axis is the sharded one (per-layer
+    gather-at-use)."""
+    from bagua_tpu.parallel.fsdp import FSDP, fsdp_shardings, scan_layers
+
+    L, D = 8, 16
+    rng = np.random.RandomState(7)
+    stacked = {
+        "w": jnp.asarray(rng.randn(L, D, D).astype(np.float32) * 0.3),
+        "b": jnp.asarray(rng.randn(L, D).astype(np.float32) * 0.1),
+    }
+    x = jnp.asarray(rng.randn(4, D).astype(np.float32))
+
+    def block(layer, h):
+        return jax.nn.tanh(h @ layer["w"] + layer["b"])
+
+    out = scan_layers(block, stacked, x)
+    expect = x
+    for i in range(L):
+        expect = block({"w": stacked["w"][i], "b": stacked["b"][i]}, expect)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect), rtol=1e-5)
+
+    # the FSDP layout shards the leading (layer) axis of the stack
+    sh = fsdp_shardings(stacked, group)
+    assert str(sh["w"].spec[0]) != "None" and sh["w"].spec[0] is not None
+
+    # end-to-end: FSDP training over the scanned stack converges and matches
+    # the same model trained with replicated params
+    def loss_fn(params, batch):
+        xb, yb = batch
+        return jnp.mean((scan_layers(block, params, xb) - yb) ** 2)
+
+    fsdp = FSDP(loss_fn, optax.adam(1e-2), group)
+    p, o = fsdp.init(stacked)
+    ref_p, ref_o = jax.tree.map(jnp.copy, stacked), optax.adam(1e-2).init(stacked)
+    opt = optax.adam(1e-2)
+    for i in range(4):
+        b = (
+            jnp.asarray(rng.randn(32, D), np.float32),
+            jnp.asarray(rng.randn(32, D), np.float32),
+        )
+        (p, o), loss = fsdp.train_step(p, o, b)
+        g = jax.grad(loss_fn)(ref_p, b)
+        upd, ref_o = opt.update(g, ref_o, ref_p)
+        ref_p = optax.apply_updates(ref_p, upd)
+    for a, b_ in zip(jax.tree.leaves(ref_p), jax.tree.leaves(p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-4, atol=1e-5)
