@@ -32,6 +32,11 @@ class BenchHarness:
         )
         import jax
 
+        if os.environ.get("BENCH_FORCE_CPU"):
+            # CPU smoke of the bench scripts themselves: the axon
+            # sitecustomize force-selects its platform via config.update,
+            # which overrides JAX_PLATFORMS (see tests/conftest.py).
+            jax.config.update("jax_platforms", "cpu")
         jax.config.update(
             "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
         )

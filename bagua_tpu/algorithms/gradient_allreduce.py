@@ -9,6 +9,15 @@ Under XLA the per-bucket ``pmean`` calls are issued as independent async
 collectives, so compute/communication overlap — the reference's Rust
 scheduler + dedicated comm stream — comes from the compiler's latency-hiding
 scheduler for free.
+
+Bucket fusion is *variadic* by default (``fuse="tuple"``): each bucket's
+leaves go into one ``psum`` call, which lowers to a single variadic
+``all-reduce`` — the fusion the reference gets from flat bucket buffers
+(``bucket.rs`` contiguous storage) with the concat/slice elision guaranteed
+by construction.  XLA's optimizer usually rewrites the ``fuse="flat"`` path
+into the same program (PERF_AUDIT.md shows identical compiled censuses on
+VGG16), but the tuple path never depends on that rewrite firing.
+``fuse="flat"`` keeps the materialized-buffer path for parity testing.
 """
 
 from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
@@ -20,28 +29,46 @@ from bagua_tpu.communication import (
 
 
 class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
-    def __init__(self, process_group, hierarchical: bool = False, average: bool = True):
+    def __init__(
+        self,
+        process_group,
+        hierarchical: bool = False,
+        average: bool = True,
+        fuse: str = "tuple",
+    ):
         super().__init__(process_group, hierarchical=hierarchical)
         self.average = average
+        if fuse not in ("tuple", "flat"):
+            raise ValueError(f"fuse must be 'tuple' or 'flat', got {fuse!r}")
+        self.fuse = fuse
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
         op = ReduceOp.AVG if self.average else ReduceOp.SUM
+        reduce = hierarchical_allreduce_inplace if self.hierarchical else allreduce_inplace
+        if self.fuse == "tuple":
+            # Variadic fusion: one psum per bucket over the bucket's leaves —
+            # a single variadic all-reduce on the wire (the same fusion the
+            # flat buffer gives) with zero concat/slice HBM traffic.  psum is
+            # elementwise, so the result is bitwise-identical to the flat
+            # path (alignment padding reduces to zeros either way).
+            groups = ctx.plan.group_leaves(grads)
+            reduced = [reduce(g, op=op) for g in groups]
+            return ctx.plan.ungroup_leaves(reduced, grads), params, state
         flats = ctx.plan.bucketize(grads)
-        out = []
-        for flat in flats:
-            if self.hierarchical:
-                out.append(hierarchical_allreduce_inplace(flat, op=op))
-            else:
-                out.append(allreduce_inplace(flat, op=op))
+        out = [reduce(flat, op=op) for flat in flats]
         return ctx.plan.debucketize(out, grads), params, state
 
 
 class GradientAllReduceAlgorithm(Algorithm):
-    def __init__(self, hierarchical: bool = False, average: bool = True):
+    def __init__(self, hierarchical: bool = False, average: bool = True, fuse: str = "tuple"):
         self.hierarchical = hierarchical
         self.average = average
+        self.fuse = fuse
 
     def reify(self, process_group) -> GradientAllReduceAlgorithmImpl:
         return GradientAllReduceAlgorithmImpl(
-            process_group, hierarchical=self.hierarchical, average=self.average
+            process_group,
+            hierarchical=self.hierarchical,
+            average=self.average,
+            fuse=self.fuse,
         )

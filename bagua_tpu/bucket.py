@@ -149,6 +149,49 @@ class BucketPlan:
 
     # -- traced transforms --------------------------------------------------
 
+    def group_leaves(self, tree) -> List[Dict[str, jnp.ndarray]]:
+        """Group pytree leaves per bucket WITHOUT materializing flat buffers.
+
+        The zero-copy sibling of :meth:`bucketize` for collectives that
+        accept pytrees: ``lax.psum``/``pmean`` on one group emit a single
+        variadic ``all-reduce`` over the bucket's leaves — the same one-
+        collective-per-bucket wire pattern as a flat buffer, with the
+        concat/slice elision guaranteed by construction rather than left to
+        the optimizer (XLA usually rewrites the flat path into this exact
+        form; PERF_AUDIT.md records the compiled census).  Algorithms that
+        operate on the fused *bytes* (compression chunking) still need
+        :meth:`bucketize`."""
+        paths_and_leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        by_name = {jax.tree_util.keystr(p): l for p, l in paths_and_leaves}
+        return [{s.name: by_name[s.name] for s in spec.slots} for spec in self.specs]
+
+    def ungroup_leaves(self, groups: Sequence[Dict[str, jnp.ndarray]], fallback=None):
+        """Rebuild the original pytree from :meth:`group_leaves` groups.
+
+        Leaves not covered by any bucket (excluded by a ``filter_fn``) are
+        taken from ``fallback``, exactly as :meth:`debucketize`."""
+        leaves_by_name: Dict[str, jnp.ndarray] = {}
+        for group in groups:
+            leaves_by_name.update(group)
+        fallback_by_name: Dict[str, jnp.ndarray] = {}
+        if fallback is not None:
+            for p, l in jax.tree_util.tree_flatten_with_path(fallback)[0]:
+                fallback_by_name[jax.tree_util.keystr(p)] = l
+        dummy = self._treedef.unflatten(range(self._treedef.num_leaves))
+        paths = [p for p, _ in jax.tree_util.tree_flatten_with_path(dummy)[0]]
+        ordered = []
+        for p in paths:
+            name = jax.tree_util.keystr(p)
+            if name in leaves_by_name:
+                ordered.append(leaves_by_name[name])
+            elif name in fallback_by_name:
+                ordered.append(fallback_by_name[name])
+            else:
+                raise KeyError(
+                    f"leaf {name} is not in any bucket and no fallback was given"
+                )
+        return self._treedef.unflatten(ordered)
+
     def bucketize(self, tree) -> List[jnp.ndarray]:
         """Fuse pytree leaves into flat per-bucket arrays (traceable)."""
         paths_and_leaves = jax.tree_util.tree_flatten_with_path(tree)[0]

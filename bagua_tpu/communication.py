@@ -307,7 +307,8 @@ def hierarchical_allreduce_inplace(x: jnp.ndarray, op: ReduceOp = ReduceOp.AVG) 
     if op == ReduceOp.AVG:
         x = allreduce_inplace(x, op=ReduceOp.SUM, axis=INTRA_AXIS)
         x = allreduce_inplace(x, op=ReduceOp.SUM, axis=INTER_AXIS)
-        return x / axis_size(ALL_AXES)
+        n = axis_size(ALL_AXES)
+        return jax.tree.map(lambda l: l / n, x)  # x may be a pytree (tuple fusion)
     # SUM/MAX/MIN/PRODUCT/bitwise all compose associatively across phases.
     x = allreduce_inplace(x, op=op, axis=INTRA_AXIS)
     return allreduce_inplace(x, op=op, axis=INTER_AXIS)

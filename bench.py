@@ -1,14 +1,20 @@
 #!/usr/bin/env python3
-"""Benchmark: VGG16 synthetic training throughput per chip.
+"""Benchmark: VGG16 synthetic training throughput per chip, per algorithm.
 
 Mirrors the reference's ``examples/benchmark/synthetic_benchmark.py`` (VGG16,
-batch 32 per worker, synthetic ImageNet-shaped data) whose CI floor is
-185 img/sec/GPU for gradient_allreduce
-(``.buildkite/scripts/benchmark_master.sh:81-83``).
+batch 32 per worker, synthetic ImageNet-shaped data) whose CI gates every
+algorithm with an individual floor
+(``.buildkite/scripts/benchmark_master.sh:81-83``): gradient_allreduce 185,
+bytegrad 180, decentralized 150, low_precision_decentralized 115, qadam 165,
+async 190 img/sec/GPU.
 
 Emission protocol (shared with bench_bert.py, see ``_bench_common``): JSON
-lines on stdout, last line authoritative; provisional line after the first
-timed step; watchdog guarantees a parseable line within the deadline.
+lines on stdout, last line authoritative.  The headline metric
+(gradient_allreduce) is emitted provisionally as soon as its first timed step
+lands, then one line per additional algorithm as the deadline allows, and the
+headline is re-emitted LAST so the driver's last-line parse always sees the
+reference's primary gate.  Watchdog guarantees a parseable line within the
+deadline.
 """
 
 import os
@@ -26,28 +32,72 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-BASELINE_IMG_PER_SEC_PER_CHIP = 185.0  # reference gradient_allreduce floor
+# Reference per-algorithm floors (img/sec/GPU, BASELINE.md:11-16).
+ALGORITHM_FLOORS = {
+    "gradient_allreduce": 185.0,
+    "bytegrad": 180.0,
+    "qadam": 165.0,
+    "decentralized": 150.0,
+    "low_precision_decentralized": 115.0,
+    "async": 190.0,
+}
+HEADLINE = "gradient_allreduce"
 
 # VGG16 at 224x224: ~15.5 GFLOP/img forward; fwd+bwd ~= 3x forward.
 VGG16_TRAIN_GFLOP_PER_IMG = 15.5 * 3
 PEAK_BF16_TFLOPS = {"tpu": 197.0, "axon": 197.0}  # v5e MXU peak; cpu excluded
 
 
-def _emit(img_per_sec_per_chip, provisional):
+def _line(value, algorithm, provisional=False):
     extra = {
-        "vs_baseline": round(img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3)
+        "algorithm": algorithm,
+        "vs_baseline": round(value / ALGORITHM_FLOORS[algorithm], 3),
     }
     peak = PEAK_BF16_TFLOPS.get(jax.devices()[0].platform)
     if peak:
-        extra["mfu"] = round(
-            img_per_sec_per_chip * VGG16_TRAIN_GFLOP_PER_IMG / (peak * 1e3), 3
-        )
-    HARNESS.emit(img_per_sec_per_chip, provisional=provisional, extra=extra)
+        extra["mfu"] = round(value * VGG16_TRAIN_GFLOP_PER_IMG / (peak * 1e3), 3)
+    HARNESS.emit(value, provisional=provisional, extra=extra)
+
+
+def _bench_algorithm(name, make_ddp, params, batch, deadline, max_iters=12,
+                     on_first_step=None):
+    """Compile + warmup + timed loop for one algorithm.  Returns img/s/chip
+    (global batch normalised by group size) or None on failure — one broken
+    algorithm must not sink the other five lines.  ``on_first_step(rate)``
+    fires after the first timed step (the headline's provisional line)."""
+    x, y = batch
+    ddp = None
+    try:
+        ddp = make_ddp(name)
+        state = ddp.init(params)
+        state, losses = ddp.train_step(state, (x, y))  # compile + settle
+        jax.block_until_ready(losses)
+        HARNESS.note(f"{name}: compile + warmup done")
+        t0 = time.perf_counter()
+        state, losses = ddp.train_step(state, (x, y))
+        jax.block_until_ready(losses)
+        first = time.perf_counter() - t0
+        if on_first_step is not None:
+            on_first_step(x.shape[0] / first / ddp.group.size)
+        n_iters = 1  # the timed window includes the first step
+        while n_iters < max_iters and time.perf_counter() < deadline:
+            state, losses = ddp.train_step(state, (x, y))
+            n_iters += 1
+        jax.block_until_ready(losses)
+        elapsed = time.perf_counter() - t0
+        HARNESS.note(f"{name}: {n_iters} steps in {elapsed:.2f}s")
+        return x.shape[0] * n_iters / elapsed / ddp.group.size
+    except Exception as e:  # noqa: BLE001 — per-algorithm isolation
+        HARNESS.note(f"{name}: FAILED {type(e).__name__}: {e}")
+        return None
+    finally:
+        if ddp is not None:
+            ddp.shutdown()  # stop algorithm background threads (async averager)
 
 
 def main():
     import bagua_tpu
-    from bagua_tpu.algorithms import Algorithm
+    from bagua_tpu.algorithms import build_algorithm
     from bagua_tpu.ddp import DistributedDataParallel
     from bagua_tpu.models.vgg import init_vgg16, vgg_loss_fn
 
@@ -56,50 +106,56 @@ def main():
 
     group = bagua_tpu.init_process_group()
     n = group.size
-    per_chip_batch = 32
+    # Smoke-test overrides (CPU CI): the measured configuration is the
+    # default 32 x 224x224, matching the reference benchmark exactly.
+    per_chip_batch = int(os.environ.get("BENCH_BATCH_PER_CHIP", "32"))
+    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
     global_batch = per_chip_batch * n
 
     model, params = init_vgg16(
-        jax.random.PRNGKey(0), image_size=224, num_classes=1000,
+        jax.random.PRNGKey(0), image_size=image_size, num_classes=1000,
         compute_dtype=jnp.bfloat16,
     )
-    ddp = DistributedDataParallel(
-        vgg_loss_fn(model),
-        optax.sgd(0.01, momentum=0.9),
-        Algorithm.init("gradient_allreduce"),
-        process_group=group,
-    )
-    state = ddp.init(params)
-    HARNESS.note("model + DDP state initialized")
+    loss_fn = vgg_loss_fn(model)
+
+    def make_ddp(name):
+        return DistributedDataParallel(
+            loss_fn, optax.sgd(0.01, momentum=0.9), build_algorithm(name, lr=0.01),
+            process_group=group,
+        )
+
+    HARNESS.note("model initialized")
 
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(global_batch, 224, 224, 3).astype(np.float32))
+    x = jnp.asarray(rng.rand(global_batch, image_size, image_size, 3).astype(np.float32))
     y = jnp.asarray(rng.randint(0, 1000, size=(global_batch,)).astype(np.int32))
+    batch = (x, y)
 
-    # Warmup: compile + one settled step.
-    state, losses = ddp.train_step(state, (x, y))
-    jax.block_until_ready(losses)
-    HARNESS.note("compile + warmup step done")
+    # Headline first: guarantees the primary gate lands even if the deadline
+    # cuts the per-algorithm sweep short; a provisional line goes out the
+    # moment its first timed step completes (watchdog insurance).
+    headline = _bench_algorithm(
+        HEADLINE, make_ddp, params, batch, deadline,
+        on_first_step=lambda rate: _line(rate, HEADLINE, provisional=True),
+    )
+    if headline is not None:
+        _line(headline, HEADLINE, provisional=True)
 
-    # First timed step -> provisional number immediately.
-    t0 = time.perf_counter()
-    state, losses = ddp.train_step(state, (x, y))
-    jax.block_until_ready(losses)
-    first = time.perf_counter() - t0
-    _emit(global_batch / first / n, provisional=True)
-    HARNESS.note(f"first timed step: {first * 1e3:.0f} ms")
+    # Per-algorithm sweep (reference gates all six): only start an algorithm
+    # when enough budget remains for its compile (~40s cold) + a few steps.
+    for name in ALGORITHM_FLOORS:
+        if name == HEADLINE:
+            continue
+        if time.perf_counter() > deadline - 75.0:
+            HARNESS.note(f"skipping {name}: <75s of budget left")
+            continue
+        value = _bench_algorithm(name, make_ddp, params, batch, deadline, max_iters=8)
+        if value is not None:
+            _line(value, name)
 
-    # Measured run: as many iters as the deadline allows, up to 12.
-    n_iters = 0
-    t0 = time.perf_counter()
-    while n_iters < 12 and (n_iters == 0 or time.perf_counter() < deadline):
-        state, losses = ddp.train_step(state, (x, y))
-        n_iters += 1
-    jax.block_until_ready(losses)
-    elapsed = time.perf_counter() - t0
-    HARNESS.note(f"measured {n_iters} steps in {elapsed:.2f}s")
-
-    _emit(global_batch * n_iters / elapsed / n, provisional=False)
+    # Authoritative last line = the reference's primary gate.
+    if headline is not None:
+        _line(headline, HEADLINE)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,177 @@
+#!/usr/bin/env python3
+"""Real-chip Pallas kernel validation (VERDICT r2 item 2).
+
+Compiles both Pallas kernels with ``interpret=False`` — i.e. through Mosaic,
+onto the actual TPU — checks numerics against the jnp oracle paths, and
+micro-benchmarks Pallas vs jnp.  Writes ``PALLAS_TPU.json`` at the repo root
+so the validation is a committed artifact.
+
+The kernels under test (reference analog:
+``bagua_kernels.cu:404-572`` — the production CUDA MinMaxUInt8 compressors):
+
+* ``compress/decompress_minmax_uint8_pallas`` (``kernels/minmax_uint8.py``)
+* ``block_attention_pallas`` (``kernels/flash_attention.py``)
+
+If Mosaic rejects a kernel, the failure lands in the JSON (and the kernels'
+env kill-switches — ``BAGUA_TPU_PALLAS_MINMAX`` / ``BAGUA_TPU_PALLAS_FLASH``
+— are the documented mitigation); the jnp fallback keeps the algorithm tier
+correct either way.
+
+Usage: ``python ci/validate_pallas_tpu.py`` on a session where
+``jax.default_backend()`` is a TPU.  ``--interpret`` runs the same suite in
+interpret mode (CPU CI smoke of this script itself).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+INTERPRET_SMOKE = False  # set by main() under --interpret
+
+
+def bench(fn, *args, iters=20):
+    if INTERPRET_SMOKE:
+        iters = 2  # interpret mode emulates the kernel; timing is meaningless
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def validate_minmax(interpret, report):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bagua_tpu.kernels.minmax_uint8 import (
+        compress_minmax_uint8,
+        compress_minmax_uint8_pallas,
+        decompress_minmax_uint8,
+        decompress_minmax_uint8_pallas,
+    )
+
+    entry = {"kernel": "minmax_uint8"}
+    try:
+        # 64 MB of gradient data in aligned chunks — the bucket-sized shape
+        # the bytegrad tier feeds.  (Interpret-mode smoke shrinks: the
+        # emulator is ~1000x slower and only numerics are being checked.)
+        nchunks, chunk = (4, 8192) if INTERPRET_SMOKE else (64, 262144)
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(nchunks, chunk).astype(np.float32)
+        )
+        q_p, mm_p = compress_minmax_uint8_pallas(x, interpret=interpret)
+        q_j, mm_j = compress_minmax_uint8(x)
+        jax.block_until_ready((q_p, q_j))
+        # Bitwise-identical quantization is the contract the wire needs:
+        # every rank must decompress every other rank's bytes identically.
+        entry["compress_bitwise_equal"] = bool(jnp.array_equal(q_p, q_j))
+        entry["minmax_max_abs_diff"] = float(jnp.max(jnp.abs(mm_p - mm_j)))
+        d_p = decompress_minmax_uint8_pallas(q_p, mm_p, interpret=interpret)
+        d_j = decompress_minmax_uint8(q_j, mm_j)
+        entry["decompress_max_abs_diff"] = float(jnp.max(jnp.abs(d_p - d_j)))
+        entry["roundtrip_rel_err"] = float(
+            jnp.max(jnp.abs(d_p - x)) / (jnp.max(jnp.abs(x)) + 1e-12)
+        )
+        entry["pallas_compress_ms"] = round(
+            bench(lambda a: compress_minmax_uint8_pallas(a, interpret=interpret), x), 3
+        )
+        entry["jnp_compress_ms"] = round(bench(compress_minmax_uint8, x), 3)
+        entry["pallas_decompress_ms"] = round(
+            bench(
+                lambda a, b: decompress_minmax_uint8_pallas(a, b, interpret=interpret),
+                q_p, mm_p,
+            ), 3,
+        )
+        entry["jnp_decompress_ms"] = round(bench(decompress_minmax_uint8, q_j, mm_j), 3)
+        entry["ok"] = entry["compress_bitwise_equal"] and entry["decompress_max_abs_diff"] < 1e-5
+    except Exception as e:  # noqa: BLE001 — Mosaic rejection is a finding, not a crash
+        entry["ok"] = False
+        entry["error"] = f"{type(e).__name__}: {e}"[:800]
+    report.append(entry)
+
+
+def validate_flash(interpret, report):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bagua_tpu.kernels.flash_attention import block_attention, block_attention_pallas
+
+    entry = {"kernel": "flash_attention_block"}
+    try:
+        b, h, tq, tk, d = (1, 2, 256, 256, 128) if INTERPRET_SMOKE else (4, 8, 512, 512, 128)
+        rs = np.random.RandomState(1)
+        # layout contract (flash_attention.py:44-59): (b, t, h, d); mask (b, tq, tk)
+        q = jnp.asarray(rs.randn(b, tq, h, d).astype(np.float32)) / np.sqrt(d)
+        k = jnp.asarray(rs.randn(b, tk, h, d).astype(np.float32))
+        v = jnp.asarray(rs.randn(b, tk, h, d).astype(np.float32))
+        mask = jnp.broadcast_to(jnp.tril(jnp.ones((tq, tk), bool)), (b, tq, tk))
+
+        o_p, l_p, m_p = block_attention_pallas(q, k, v, mask, interpret=interpret)
+        o_j, l_j, m_j = block_attention(q, k, v, mask)
+        jax.block_until_ready((o_p, o_j))
+        entry["out_max_abs_diff"] = float(jnp.max(jnp.abs(o_p - o_j)))
+        entry["lse_max_abs_diff"] = float(jnp.max(jnp.abs(l_p - l_j)))
+        entry["pallas_ms"] = round(
+            bench(
+                lambda *a: block_attention_pallas(*a, interpret=interpret),
+                q, k, v, mask,
+            ), 3,
+        )
+        entry["jnp_ms"] = round(bench(block_attention, q, k, v, mask), 3)
+        entry["ok"] = entry["out_max_abs_diff"] < 2e-2
+    except Exception as e:  # noqa: BLE001
+        entry["ok"] = False
+        entry["error"] = f"{type(e).__name__}: {e}"[:800]
+    report.append(entry)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interpret", action="store_true",
+                    help="interpret-mode smoke of this script (CPU CI)")
+    ap.add_argument("--out", default=os.path.join(REPO, "PALLAS_TPU.json"))
+    args = ap.parse_args()
+    import jax
+
+    if args.interpret:
+        global INTERPRET_SMOKE
+        INTERPRET_SMOKE = True
+        # sitecustomize force-selects the axon platform via config.update;
+        # env vars don't override it (see tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+
+    backend = jax.default_backend()
+    if backend == "cpu" and not args.interpret:
+        print("refusing: backend is cpu and --interpret not set", file=sys.stderr)
+        sys.exit(2)
+
+    report = []
+    validate_minmax(args.interpret, report)
+    validate_flash(args.interpret, report)
+
+    result = {
+        "backend": backend,
+        "device": str(jax.devices()[0]),
+        "interpret": args.interpret,
+        "kernels": report,
+        "all_ok": all(e["ok"] for e in report),
+    }
+    print(json.dumps(result, indent=1))
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    sys.exit(0 if result["all_ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,15 +1,24 @@
 #!/usr/bin/env python3
-"""SQuAD-style BERT finetune example (analog of the reference's
-``examples/squad``): BERT + span-prediction head, ByteGrad compression (the
-BASELINE.json config "BERT-Large SQuAD finetune with ByteGrad 8-bit
-compression").  QA data is synthetic (zero-egress) but the model/loss shape
-is the real finetune task: predict answer start/end positions.
+"""SQuAD BERT finetune example (analog of the reference's
+``examples/squad/main.py``): BERT + span-prediction head, ByteGrad
+compression (the BASELINE.json config "BERT-Large SQuAD finetune with
+ByteGrad 8-bit compression").
+
+Two data paths:
+
+* ``--data train-v1.1.json`` — REAL SQuAD: parses the official JSON, trains
+  a WordPiece tokenizer from the corpus itself (zero-egress: no pretrained
+  vocab download), and maps character answer spans to token spans via the
+  tokenizer's offsets.
+* default — synthetic QA batches with the same feature shape (CI path).
 
     python examples/squad/main.py --steps 20           # BERT-mini, CPU-able
     python examples/squad/main.py --large --steps 100  # BERT-Large
+    python examples/squad/main.py --data /data/squad/train-v1.1.json
 """
 
 import argparse
+import json
 
 import flax.linen as nn
 import jax
@@ -49,6 +58,70 @@ def qa_loss_fn(model):
     return loss_fn
 
 
+def load_real_squad(path, seq, vocab_size=8000, max_examples=20000):
+    """Official SQuAD JSON -> (ids, mask, starts, ends) arrays.
+
+    The WordPiece vocabulary is trained from the corpus itself with the
+    ``tokenizers`` library (reference uses a downloaded pretrained vocab,
+    ``examples/squad/run_squad.py``; this environment is zero-egress).
+    Character answer spans map to token spans through the fast tokenizer's
+    byte offsets; examples whose answer falls outside the truncated window
+    are dropped, as in the reference feature builder."""
+    from tokenizers import BertWordPieceTokenizer
+
+    raw = json.load(open(path))["data"]
+    examples = []
+    for article in raw:
+        for para in article["paragraphs"]:
+            ctx = para["context"]
+            for qa in para["qas"]:
+                if len(examples) >= max_examples:
+                    break
+                if qa.get("answers"):
+                    a = qa["answers"][0]
+                    examples.append(
+                        (qa["question"], ctx, a["answer_start"],
+                         a["answer_start"] + len(a["text"]))
+                    )
+            if len(examples) >= max_examples:
+                break
+        if len(examples) >= max_examples:
+            break
+    tok = BertWordPieceTokenizer(lowercase=True)
+    tok.train_from_iterator(
+        [t for q, c, _, _ in examples for t in (q, c)],
+        vocab_size=vocab_size,
+        special_tokens=["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"],
+    )
+    tok.enable_truncation(max_length=seq)
+    tok.enable_padding(length=seq, pad_token="[PAD]")
+
+    ids_l, mask_l, s_l, e_l = [], [], [], []
+    for q, ctx, cs, ce in examples:
+        enc = tok.encode(q, ctx)
+        ts = te = None
+        for i, (sid, (o0, o1)) in enumerate(zip(enc.sequence_ids, enc.offsets)):
+            if sid != 1 or o0 == o1:
+                continue
+            if o0 <= cs < o1:
+                ts = i
+            if o0 < ce <= o1:
+                te = i
+        if ts is None or te is None:
+            continue  # answer truncated away
+        ids_l.append(enc.ids)
+        mask_l.append(enc.attention_mask)
+        s_l.append(ts)
+        e_l.append(te)
+    return (
+        np.array(ids_l, np.int32),
+        np.array(mask_l, bool),
+        np.array(s_l, np.int32),
+        np.array(e_l, np.int32),
+        tok.get_vocab_size(),
+    )
+
+
 def synthetic_squad(rng, n, seq, vocab):
     ids = rng.randint(5, vocab, (n, seq)).astype(np.int32)
     lengths = rng.randint(seq // 2, seq, n)
@@ -69,17 +142,25 @@ def main():
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--data", default=None,
+                   help="path to SQuAD train-v1.1.json; synthetic when omitted")
     args = p.parse_args()
 
     group = bagua_tpu.init_process_group()
+    real = None
+    if args.data:
+        ids, mask, starts, ends, vocab = load_real_squad(args.data, args.seq)
+        real = (ids, mask, starts, ends)
+        print(f"{len(ids)} SQuAD features, vocab {vocab}")
     if args.large:
         cfg = bert_large_config(
-            compute_dtype=jnp.bfloat16, max_position_embeddings=args.seq
+            compute_dtype=jnp.bfloat16, max_position_embeddings=args.seq,
+            **({"vocab_size": vocab} if real else {}),
         )
     else:
         cfg = BertConfig(
-            vocab_size=1000, hidden_size=64, num_layers=2, num_heads=4,
-            intermediate_size=128, max_position_embeddings=args.seq,
+            vocab_size=vocab if real else 1000, hidden_size=64, num_layers=2,
+            num_heads=4, intermediate_size=128, max_position_embeddings=args.seq,
         )
     model = BertForQuestionAnswering(cfg)
     params = model.init(
@@ -95,7 +176,11 @@ def main():
     rng = np.random.RandomState(0)
     bs = args.batch_size * group.size
     for step in range(args.steps):
-        ids, mask, starts, ends = synthetic_squad(rng, bs, args.seq, cfg.vocab_size)
+        if real is not None:
+            idx = rng.randint(0, len(real[0]), bs)
+            ids, mask, starts, ends = (a[idx] for a in real)
+        else:
+            ids, mask, starts, ends = synthetic_squad(rng, bs, args.seq, cfg.vocab_size)
         state, losses = ddp.train_step(
             state,
             (jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(starts), jnp.asarray(ends)),
