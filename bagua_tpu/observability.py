@@ -160,3 +160,63 @@ class Watchdog:
                 faulthandler.dump_traceback(file=sys.stderr)
                 sys.stderr.flush()
                 os._exit(42)
+
+
+class ProfilerSession:
+    """XLA profiler capture (reference: Jaeger tracing + per-op OTel spans,
+    ``bagua-net/src/lib.rs:66-80``; on TPU the ground truth is the XLA
+    profiler's device trace: per-HLO timing, collective overlap, MXU
+    utilization, HBM traffic — viewable in TensorBoard/xprof).
+
+        prof = ProfilerSession("/tmp/bagua_trace")
+        prof.start()
+        ... a few training steps ...
+        prof.stop()           # trace under /tmp/bagua_trace/plugins/profile
+
+    Or scoped::
+
+        with ProfilerSession("/tmp/bagua_trace"):
+            state, _ = ddp.train_step(state, batch)
+
+    ``trace_steps(fn, state, batches)`` captures exactly the supplied steps
+    with a ``block_until_ready`` barrier on each side so device work from
+    outside the window never bleeds into the capture.
+    """
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self._active = False
+
+    def start(self) -> None:
+        import jax
+
+        jax.profiler.start_trace(self.log_dir)
+        self._active = True
+
+    def stop(self) -> None:
+        import jax
+
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def trace_steps(self, step_fn, state, batches):
+        """Run ``state, aux = step_fn(state, batch)`` over ``batches`` inside
+        one clean capture window; returns the final ``(state, aux)``."""
+        import jax
+
+        jax.block_until_ready(state)
+        aux = None
+        with self:
+            for batch in batches:
+                state, aux = step_fn(state, batch)
+            jax.block_until_ready((state, aux))
+        return state, aux

@@ -54,7 +54,13 @@ def _line(value, algorithm, provisional=False):
         "vs_baseline": round(value / ALGORITHM_FLOORS[algorithm], 3),
     }
     peak = PEAK_BF16_TFLOPS.get(jax.devices()[0].platform)
-    if peak:
+    smoke = (
+        os.environ.get("BENCH_IMAGE_SIZE", "224") != "224"
+        or os.environ.get("BENCH_BATCH_PER_CHIP", "32") != "32"
+    )
+    if peak and not smoke:
+        # The GFLOP constant is for the measured 224px config; a smoke-sized
+        # run must not emit a bogus MFU.
         extra["mfu"] = round(value * VGG16_TRAIN_GFLOP_PER_IMG / (peak * 1e3), 3)
     HARNESS.emit(value, provisional=provisional, extra=extra)
 

@@ -31,9 +31,16 @@ PEAK_BF16_TFLOPS = {"tpu": 197.0, "axon": 197.0}
 def _emit(sps, provisional=False, extra=None):
     extra = dict(extra or {})
     extra.setdefault("vs_baseline", None)
-    extra["config"] = "seq128 batch32/chip bytegrad bf16"
+    small = bool(os.environ.get("BENCH_BERT_SMALL"))
+    extra["config"] = (
+        "SMOKE bert-mini seq64 batch4/chip bytegrad bf16"
+        if small
+        else "seq128 batch32/chip bytegrad bf16"
+    )
     peak = PEAK_BF16_TFLOPS.get(jax.devices()[0].platform)
-    if peak:
+    if peak and not small:
+        # TRAIN_GFLOP_PER_SAMPLE is the BERT-Large seq128 constant; an MFU
+        # computed from it in smoke mode would be wildly overstated.
         extra["mfu"] = round(sps * TRAIN_GFLOP_PER_SAMPLE / (peak * 1e3), 3)
     HARNESS.emit(sps, provisional=provisional, extra=extra)
 
@@ -48,7 +55,20 @@ def run(use_pallas, n_iters):
     n = group.size
     seq, per_chip_batch = 128, 32
 
-    cfg = bert_large_config(compute_dtype=jnp.bfloat16, max_position_embeddings=seq)
+    if os.environ.get("BENCH_BERT_SMALL"):
+        # Smoke of the script itself (combine with BENCH_FORCE_CPU=1 to pin
+        # the CPU platform — the axon sitecustomize otherwise forces its
+        # backend); the measured config is BERT-Large.
+        from bagua_tpu.models.bert import BertConfig
+
+        seq, per_chip_batch = 64, 4
+        cfg = BertConfig(
+            vocab_size=1000, hidden_size=128, num_layers=2, num_heads=4,
+            intermediate_size=256, max_position_embeddings=seq,
+            compute_dtype=jnp.bfloat16,
+        )
+    else:
+        cfg = bert_large_config(compute_dtype=jnp.bfloat16, max_position_embeddings=seq)
     model = BertForPreTraining(cfg)
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, seq), jnp.int32))["params"]
     ddp = DistributedDataParallel(

@@ -1,0 +1,45 @@
+#!/bin/bash
+# The moment-the-chip-is-up checklist (VERDICT r2 items 1/2/4/8).
+#
+# Runs every TPU-dependent artifact in priority order, tolerating individual
+# failures, with wall-clock caps so a flaky tunnel still yields partial
+# evidence.  Results land at the repo root:
+#   BENCH_TPU.json        - bench.py JSON lines (per-algorithm VGG16 sweep)
+#   BENCH_BERT_TPU.json   - bench_bert.py JSON lines
+#   PALLAS_TPU.json       - Mosaic kernel validation + microbench
+#   AUTOTUNE_RUN.json     - autotune closed loop on the real chip
+#
+# Usage: bash ci/tpu_session.sh   (assumes the axon tunnel is reachable)
+
+set -u
+cd "$(dirname "$0")/.."
+echo "=== tpu_session $(date) ===" | tee -a tpu_session.log
+
+run() {  # run <name> <timeout_s> <out_or_-> <cmd...>
+  local name=$1 cap=$2 out=$3; shift 3
+  echo "--- $name ($(date +%H:%M:%S), cap ${cap}s)" | tee -a tpu_session.log
+  local tmp
+  tmp=$(mktemp)
+  timeout "$cap" "$@" > "$tmp" 2>> tpu_session.log
+  local rc=$?
+  cat "$tmp" >> tpu_session.log
+  if [ "$out" != "-" ]; then
+    grep '^{' "$tmp" > "$out" || true
+  fi
+  rm -f "$tmp"
+  echo "--- $name rc=$rc" | tee -a tpu_session.log
+}
+
+# 1. Headline + per-algorithm VGG16 sweep (the round's definition of success).
+run bench 780 BENCH_TPU.json python bench.py
+
+# 2. BERT-Large ByteGrad bench.
+run bench_bert 780 BENCH_BERT_TPU.json python bench_bert.py
+
+# 3. Pallas kernels through Mosaic (writes PALLAS_TPU.json itself).
+run pallas 600 - python ci/validate_pallas_tpu.py
+
+# 4. Autotune closed loop on the real chip (overwrites the CPU-sim record).
+run autotune 600 - env BAGUA_AUTOTUNE_RUN_TPU=1 python ci/autotune_real_run.py
+
+echo "=== tpu_session done $(date) ===" | tee -a tpu_session.log

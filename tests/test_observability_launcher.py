@@ -220,3 +220,23 @@ def test_elastic_shrink_resumes_from_checkpoint(tmp_path):
     resumed = [r for r in recs if r["ws"] == 1]
     assert resumed[0]["iter"] == 3  # picked up right after the checkpoint
     assert min(r["loss"] for r in resumed) < recs[0]["loss"]  # kept converging
+
+
+def test_profiler_session_captures_trace(tmp_path):
+    """ProfilerSession writes an XLA profiler trace for the wrapped steps."""
+    import glob
+
+    import jax
+    import jax.numpy as jnp
+
+    from bagua_tpu.observability import ProfilerSession
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((64, 64))
+    prof = ProfilerSession(str(tmp_path))
+    _, aux = prof.trace_steps(lambda s, b: (s, f(b)), x, [x, x])
+    assert float(aux) == 64.0 * 64 * 64
+    assert glob.glob(str(tmp_path) + "/**/*.xplane.pb", recursive=True)
