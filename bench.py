@@ -48,20 +48,21 @@ VGG16_TRAIN_GFLOP_PER_IMG = 15.5 * 3
 PEAK_BF16_TFLOPS = {"tpu": 197.0, "axon": 197.0}  # v5e MXU peak; cpu excluded
 
 
+SMOKE = False  # set by main() when the config differs from the measured one
+
+
 def _line(value, algorithm, provisional=False):
-    extra = {
-        "algorithm": algorithm,
-        "vs_baseline": round(value / ALGORITHM_FLOORS[algorithm], 3),
-    }
-    peak = PEAK_BF16_TFLOPS.get(jax.devices()[0].platform)
-    smoke = (
-        os.environ.get("BENCH_IMAGE_SIZE", "224") != "224"
-        or os.environ.get("BENCH_BATCH_PER_CHIP", "32") != "32"
-    )
-    if peak and not smoke:
-        # The GFLOP constant is for the measured 224px config; a smoke-sized
-        # run must not emit a bogus MFU.
-        extra["mfu"] = round(value * VGG16_TRAIN_GFLOP_PER_IMG / (peak * 1e3), 3)
+    extra = {"algorithm": algorithm}
+    if SMOKE:
+        # A shrunken config must not emit ratios against the 224px floors or
+        # the full-size GFLOP constant — mark the line instead.
+        extra["config"] = "SMOKE (non-reference shapes)"
+        extra["vs_baseline"] = None
+    else:
+        extra["vs_baseline"] = round(value / ALGORITHM_FLOORS[algorithm], 3)
+        peak = PEAK_BF16_TFLOPS.get(jax.devices()[0].platform)
+        if peak:
+            extra["mfu"] = round(value * VGG16_TRAIN_GFLOP_PER_IMG / (peak * 1e3), 3)
     HARNESS.emit(value, provisional=provisional, extra=extra)
 
 
@@ -116,6 +117,8 @@ def main():
     # default 32 x 224x224, matching the reference benchmark exactly.
     per_chip_batch = int(os.environ.get("BENCH_BATCH_PER_CHIP", "32"))
     image_size = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
+    global SMOKE
+    SMOKE = (per_chip_batch, image_size) != (32, 224)
     global_batch = per_chip_batch * n
 
     model, params = init_vgg16(

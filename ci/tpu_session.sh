@@ -23,8 +23,9 @@ run() {  # run <name> <timeout_s> <out_or_-> <cmd...>
   timeout "$cap" "$@" > "$tmp" 2>> tpu_session.log
   local rc=$?
   cat "$tmp" >> tpu_session.log
-  if [ "$out" != "-" ]; then
-    grep '^{' "$tmp" > "$out" || true
+  if [ "$out" != "-" ] && grep -q '^{' "$tmp"; then
+    # only replace a previous session's artifact when this run produced lines
+    grep '^{' "$tmp" > "$out"
   fi
   rm -f "$tmp"
   echo "--- $name rc=$rc" | tee -a tpu_session.log

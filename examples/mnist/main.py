@@ -1,13 +1,20 @@
 #!/usr/bin/env python3
-"""MNIST-style example (analog of the reference's ``examples/mnist/main.py``).
+"""MNIST example (analog of the reference's ``examples/mnist/main.py``).
 
-Uses a synthetic MNIST-shaped classification task (zero-egress environment),
-a small ConvNet, and any registered algorithm:
+A small ConvNet with any registered algorithm.  With ``--data-dir`` pointing
+at the official IDX files (``train-images-idx3-ubyte[.gz]`` +
+``train-labels-idx1-ubyte[.gz]``, the format torchvision downloads), the run
+uses REAL MNIST; otherwise a synthetic MNIST-shaped task (zero-egress CI
+path):
 
     python examples/mnist/main.py --algorithm gradient_allreduce --epochs 2
+    python examples/mnist/main.py --data-dir /data/mnist
 """
 
 import argparse
+import gzip
+import os
+import struct
 
 import flax.linen as nn
 import jax
@@ -32,6 +39,33 @@ class Net(nn.Module):
         return nn.Dense(10)(x)
 
 
+def _read_idx(path):
+    """Official IDX format (http://yann.lecun.com/exdb/mnist/): big-endian
+    magic (2 type bytes + ndim), then per-dim sizes, then raw u8 data."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype, ndim = struct.unpack(">HBB", f.read(4))
+        if zero != 0 or dtype != 0x08:
+            raise ValueError(f"{path}: not a u8 IDX file")
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(shape)
+
+
+def real_mnist(data_dir):
+    """Load the official train split from IDX files (plain or .gz)."""
+    def find(stem):
+        for suffix in ("", ".gz"):
+            p = os.path.join(data_dir, stem + suffix)
+            if os.path.exists(p):
+                return p
+        raise FileNotFoundError(f"{stem}[.gz] not found under {data_dir}")
+
+    xs = _read_idx(find("train-images-idx3-ubyte")).astype(np.float32)
+    xs = (xs / 255.0 - 0.1307) / 0.3081  # torchvision normalization
+    ys = _read_idx(find("train-labels-idx1-ubyte")).astype(np.int32)
+    return xs[..., None], ys
+
+
 def synthetic_mnist(n=4096, seed=0):
     """Separable synthetic digits: class-dependent blob patterns."""
     rng = np.random.RandomState(seed)
@@ -47,6 +81,9 @@ def main():
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--batch-size", type=int, default=256)
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--data-dir", default=None,
+                   help="directory with the official MNIST IDX files; "
+                        "synthetic data when omitted")
     args = p.parse_args()
 
     group = bagua_tpu.init_process_group()
@@ -66,8 +103,14 @@ def main():
     ddp = DistributedDataParallel(loss_fn, opt, algo, process_group=group)
     state = ddp.init(params)
 
-    xs, ys = synthetic_mnist()
+    xs, ys = real_mnist(args.data_dir) if args.data_dir else synthetic_mnist()
+    print(f"{len(xs)} samples ({'real' if args.data_dir else 'synthetic'})")
     n_batches = len(xs) // args.batch_size
+    if n_batches == 0:
+        raise SystemExit(
+            f"dataset ({len(xs)} samples) smaller than --batch-size "
+            f"{args.batch_size}; lower the batch size"
+        )
     for epoch in range(args.epochs):
         perm = np.random.RandomState(epoch).permutation(len(xs))
         for b in range(n_batches):
