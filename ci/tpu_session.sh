@@ -8,11 +8,18 @@
 #   BENCH_BERT_TPU.json   - bench_bert.py JSON lines
 #   PALLAS_TPU.json       - Mosaic kernel validation + microbench
 #   AUTOTUNE_RUN.json     - autotune closed loop on the real chip
+#   tpu_session.log       - everything, incl. the final reference CI gate
+#                           (benchmark_check --tpu-floors: determinism +
+#                           per-algorithm floors; PASS/FAIL lines per algo)
 #
 # Usage: bash ci/tpu_session.sh   (assumes the axon tunnel is reachable)
 
 set -u
 cd "$(dirname "$0")/.."
+# One shared compile cache for every step (bench/_bench_common and
+# benchmark_check default to DIFFERENT dirs otherwise — the floors gate
+# depends on reusing step 1's VGG16 compilations).
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
 echo "=== tpu_session $(date) ===" | tee -a tpu_session.log
 
 run() {  # run <name> <timeout_s> <out_or_-> <cmd...>
@@ -32,15 +39,21 @@ run() {  # run <name> <timeout_s> <out_or_-> <cmd...>
 }
 
 # 1. Headline + per-algorithm VGG16 sweep (the round's definition of success).
-run bench 780 BENCH_TPU.json python bench.py
+#    Internal deadline tracks the outer cap (watchdog = deadline + 60s).
+run bench 780 BENCH_TPU.json env BENCH_DEADLINE_SEC=700 python bench.py
 
 # 2. BERT-Large ByteGrad bench.
-run bench_bert 780 BENCH_BERT_TPU.json python bench_bert.py
+run bench_bert 780 BENCH_BERT_TPU.json env BENCH_DEADLINE_SEC=700 python bench_bert.py
 
 # 3. Pallas kernels through Mosaic (writes PALLAS_TPU.json itself).
 run pallas 600 - python ci/validate_pallas_tpu.py
 
 # 4. Autotune closed loop on the real chip (overwrites the CPU-sim record).
 run autotune 600 - env BAGUA_AUTOTUNE_RUN_TPU=1 python ci/autotune_real_run.py
+
+# 5. The reference's full CI gate (determinism + per-algorithm floors) —
+#    last, so a timeout here never costs the primary artifacts; the compile
+#    cache from step 1 makes it mostly step time.
+run floors_gate 900 - python ci/benchmark_check.py --model vgg16 --tpu-floors
 
 echo "=== tpu_session done $(date) ===" | tee -a tpu_session.log
