@@ -6,7 +6,7 @@ watchdog, speed metrics and checkpointing together)."""
 
 import logging
 import os
-from typing import Callable, Iterable, Optional
+from typing import Callable, Iterable, Optional, Tuple
 
 import jax
 
@@ -28,6 +28,11 @@ class Trainer:
         autotune_model_name: if set (and the autotune service is reachable),
             runs the report/ask/re-bucket cycle.
         watchdog_timeout_s: hang detector (0 disables).
+        profile_dir: if set, captures ONE xprof trace of fit-loop iterations
+            ``[profile_steps[0], profile_steps[1])`` (half-open; default
+            iterations 10-12, past compilation) into this directory.  One
+            capture per Trainer, even across multiple ``fit()`` calls; a
+            window cut short by the end of an epoch is closed and kept.
     """
 
     def __init__(
@@ -41,6 +46,8 @@ class Trainer:
         autotune_model_name: Optional[str] = None,
         watchdog_timeout_s: float = 300.0,
         dp_filter=None,
+        profile_dir: Optional[str] = None,
+        profile_steps: Tuple[int, int] = (10, 13),
     ):
         self.ddp = DistributedDataParallel(
             loss_fn, optimizer, algorithm, process_group=process_group, dp_filter=dp_filter
@@ -53,6 +60,12 @@ class Trainer:
             Watchdog(watchdog_timeout_s).start() if watchdog_timeout_s > 0 else None
         )
         self._session: Optional[AutotuneSession] = None
+        # xprof capture of steps [a, b) once compilation has settled
+        # (docs/performance.md "profile -> fix -> repeat").
+        self.profile_dir = profile_dir
+        self.profile_steps = profile_steps
+        self._profiler = None
+        self._profiled = False  # one capture per Trainer, across fit() calls
 
     def init_state(self, params=None, stacked_params=None):
         state = self.ddp.init(params, stacked_params=stacked_params)
@@ -83,9 +96,26 @@ class Trainer:
                 except Exception as e:
                     logger.warning("bucket-order profiling failed: %s", e)
                     self._session.profiled = True
+            if (
+                self.profile_dir is not None
+                and i == self.profile_steps[0]
+                and not self._profiled
+                and self._profiler is None
+            ):
+                from bagua_tpu.observability import ProfilerSession
+
+                jax.block_until_ready(state)  # clean capture window
+                self._profiler = ProfilerSession(self.profile_dir)
+                self._profiler.start()
+                self._profiled = True
             n_samples = jax.tree.leaves(batch)[0].shape[0]
             with self.timer.step(n_samples):
                 state, losses = self.ddp.train_step(state, batch)
+            if self._profiler is not None and i == self.profile_steps[1] - 1:
+                jax.block_until_ready((state, losses))
+                self._profiler.stop()
+                self._profiler = None
+                logger.info("xprof trace captured to %s", self.profile_dir)
             if self.watchdog:
                 self.watchdog.beat()
             if self._session:
@@ -110,6 +140,9 @@ class Trainer:
     def close(self) -> None:
         """Release background machinery: the hang watchdog and any algorithm
         threads (async averager).  Safe to call more than once."""
+        if self._profiler is not None:  # fit() ended inside the window
+            self._profiler.stop()
+            self._profiler = None
         if self.watchdog:
             self.watchdog.stop()
             self.watchdog = None

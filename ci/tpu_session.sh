@@ -4,10 +4,11 @@
 # Runs every TPU-dependent artifact in priority order, tolerating individual
 # failures, with wall-clock caps so a flaky tunnel still yields partial
 # evidence.  Results land at the repo root:
-#   BENCH_TPU.json        - bench.py JSON lines (per-algorithm VGG16 sweep)
-#   BENCH_BERT_TPU.json   - bench_bert.py JSON lines
-#   PALLAS_TPU.json       - Mosaic kernel validation + microbench
-#   AUTOTUNE_RUN.json     - autotune closed loop on the real chip
+#   BENCH_TPU.json         - bench.py JSON lines (per-algorithm VGG16 sweep)
+#   BENCH_BERT_TPU.json    - bench_bert.py JSON lines
+#   PALLAS_TPU.json        - Mosaic kernel validation + microbench
+#   BENCH_SCALING_TPU.json - DP scaling sweep (trivial on one chip)
+#   AUTOTUNE_RUN.json      - autotune closed loop on the real chip
 #   tpu_session.log       - everything, incl. the final reference CI gate
 #                           (benchmark_check --tpu-floors: determinism +
 #                           per-algorithm floors; PASS/FAIL lines per algo)
@@ -47,6 +48,10 @@ run bench_bert 780 BENCH_BERT_TPU.json env BENCH_DEADLINE_SEC=700 python bench_b
 
 # 3. Pallas kernels through Mosaic (writes PALLAS_TPU.json itself).
 run pallas 600 - python ci/validate_pallas_tpu.py
+
+# 3b. DP scaling sweep — degenerates to width 1 on a single chip; on a pod
+#     slice it produces the BASELINE scaling-efficiency curve.
+run scaling 600 BENCH_SCALING_TPU.json env BENCH_DEADLINE_SEC=520 python bench_scaling.py
 
 # 4. Autotune closed loop on the real chip (overwrites the CPU-sim record).
 run autotune 600 - env BAGUA_AUTOTUNE_RUN_TPU=1 python ci/autotune_real_run.py

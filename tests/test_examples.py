@@ -85,3 +85,19 @@ def test_squad_real_json(tmp_path):
         ["--data", str(path), "--batch-size", "2", "--steps", "2", "--seq", "64"],
     )
     assert "24 SQuAD features" in out
+
+
+@pytest.mark.parametrize("n_devices", [16])
+def test_dryrun_multichip_wider_than_test_mesh(n_devices):
+    """The driver calls dryrun_multichip with arbitrary device counts; guard
+    the path at a width larger than the suite's 8-device mesh (fresh
+    subprocess: the simulated device count is fixed at jax init)."""
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO_ROOT
+    r = subprocess.run(
+        [sys.executable, "-c",
+         f"import __graft_entry__; __graft_entry__.dryrun_multichip({n_devices})"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"

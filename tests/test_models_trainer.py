@@ -186,3 +186,69 @@ def test_pinned_weight_norm_regression(group):
         np.testing.assert_allclose(
             norm, expected, rtol=1e-6, err_msg=f"{name} drifted from pin"
         )
+
+
+def test_trainer_profile_window(group, tmp_path):
+    """Trainer(profile_dir=...) captures an xprof trace of the configured
+    step window and closes it cleanly even when fit() ends mid-window."""
+    import glob
+
+    from bagua_tpu.algorithms import Algorithm
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+    from bagua_tpu.trainer import Trainer
+
+    rng = np.random.RandomState(0)
+
+    def batches(n):
+        for _ in range(n):
+            yield (
+                jnp.asarray(rng.randn(16, 8), np.float32),
+                jnp.asarray(rng.randn(16, 4), np.float32),
+            )
+
+    with Trainer(
+        mse_loss, optax.sgd(0.05), Algorithm.init("gradient_allreduce"),
+        process_group=group, watchdog_timeout_s=0,
+        profile_dir=str(tmp_path / "full"), profile_steps=(2, 4),
+    ) as t:
+        state = t.init_state(init_mlp(jax.random.PRNGKey(0), [8, 16, 4]))
+        t.fit(state, batches(6), log_every=0)
+    assert glob.glob(str(tmp_path / "full") + "/**/*.xplane.pb", recursive=True)
+
+    # window extends past the last step: close() must stop the trace
+    with Trainer(
+        mse_loss, optax.sgd(0.05), Algorithm.init("gradient_allreduce"),
+        process_group=group, watchdog_timeout_s=0,
+        profile_dir=str(tmp_path / "cut"), profile_steps=(1, 99),
+    ) as t:
+        state = t.init_state(init_mlp(jax.random.PRNGKey(1), [8, 16, 4]))
+        t.fit(state, batches(3), log_every=0)
+    assert glob.glob(str(tmp_path / "cut") + "/**/*.xplane.pb", recursive=True)
+
+
+def test_trainer_profile_once_across_epochs(group, tmp_path):
+    """A mid-window epoch end must not re-trigger capture on the next fit()
+    (jax.profiler raises on double-start)."""
+    from bagua_tpu.algorithms import Algorithm
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+    from bagua_tpu.trainer import Trainer
+
+    rng = np.random.RandomState(0)
+
+    def batches(n):
+        for _ in range(n):
+            yield (
+                jnp.asarray(rng.randn(16, 8), np.float32),
+                jnp.asarray(rng.randn(16, 4), np.float32),
+            )
+
+    with Trainer(
+        mse_loss, optax.sgd(0.05), Algorithm.init("gradient_allreduce"),
+        process_group=group, watchdog_timeout_s=0,
+        profile_dir=str(tmp_path), profile_steps=(1, 99),
+    ) as t:
+        state = t.init_state(init_mlp(jax.random.PRNGKey(0), [8, 16, 4]))
+        state = t.fit(state, batches(3), log_every=0)   # epoch 1: window opens
+        # window still open at epoch boundary; epoch 2 hits i==1 again
+        state = t.fit(state, batches(3), log_every=0)
+        assert int(state.step[0]) == 6
