@@ -23,19 +23,32 @@ class GPTConfig:
     tp_size: int = 1
     tp_axis: Union[str, Tuple[str, ...]] = "tp"
     sp_axis: Union[str, Tuple[str, ...], None] = None
+    #: "contiguous" or "zigzag" — the balanced causal ring layout; feed
+    #: token ids permuted with ``ring_attention.zigzag_order`` and the model
+    #: assigns the matching global positions (see docs/parallelism.md)
+    sp_layout: str = "contiguous"
     compute_dtype: Any = jnp.float32
 
 
-def _sp_offset(cfg: GPTConfig, t_local: int):
+def _sp_positions(cfg: GPTConfig, t_local: int):
+    """Global position ids of this rank's local tokens, shape (t_local,)."""
     if cfg.sp_axis is None:
-        return 0
+        return jnp.arange(t_local)
     try:
-        from bagua_tpu.communication import rank_id
+        from bagua_tpu.communication import axis_size, rank_id
 
         axes = (cfg.sp_axis,) if isinstance(cfg.sp_axis, str) else cfg.sp_axis
-        return rank_id(axes) * t_local
+        r = rank_id(axes)
+        if cfg.sp_layout == "zigzag":
+            sp = axis_size(axes)
+            t2 = t_local // 2
+            return jnp.concatenate([
+                r * t2 + jnp.arange(t2),
+                (2 * sp - 1 - r) * t2 + jnp.arange(t2),
+            ])
+        return r * t_local + jnp.arange(t_local)
     except NameError:
-        return 0
+        return jnp.arange(t_local)
 
 
 class GPTBlock(nn.Module):
@@ -54,7 +67,9 @@ class GPTBlock(nn.Module):
         )(h).reshape(b, t, 3, local_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if cfg.sp_axis is not None:
-            ctx = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True)
+            ctx = ring_attention(
+                q, k, v, axis_name=cfg.sp_axis, causal=True, layout=cfg.sp_layout
+            )
         else:
             ctx = _block_attention_local(q, k, v, causal=True)
         attn = RowParallelDense(
@@ -77,7 +92,7 @@ class GPTModel(nn.Module):
         b, t = input_ids.shape
         x = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="wte")(input_ids)
         pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, name="wpe")(
-            jnp.arange(t)[None, :] + _sp_offset(cfg, t)
+            _sp_positions(cfg, t)[None, :]
         )
         x = (x + pos).astype(cfg.compute_dtype)
         for i in range(cfg.num_layers):
@@ -88,12 +103,21 @@ class GPTModel(nn.Module):
 
 
 def lm_loss_fn(model: GPTModel):
-    """Next-token cross entropy (within the local block under SP)."""
+    """Next-token cross entropy (within the local block under SP).  With
+    ``sp_layout="zigzag"`` the two local half-blocks are globally
+    non-adjacent, so the mid-block seam pair (local ``t2-1 -> t2``) is a
+    wrong prediction target — it is masked out of the mean."""
+    cfg = model.cfg
 
     def loss_fn(params, batch):
         ids = batch
         logits = model.apply({"params": params}, ids)
         logp = jax.nn.log_softmax(logits[:, :-1])
-        return -jnp.mean(jnp.take_along_axis(logp, ids[:, 1:, None], axis=-1))
+        nll = -jnp.take_along_axis(logp, ids[:, 1:, None], axis=-1)[..., 0]
+        if cfg.sp_axis is not None and cfg.sp_layout == "zigzag":
+            t = ids.shape[1]
+            keep = jnp.arange(t - 1) != (t // 2 - 1)  # drop the seam pair
+            return jnp.sum(nll * keep[None]) / (nll.shape[0] * (t - 2))
+        return jnp.mean(nll)
 
     return loss_fn

@@ -65,24 +65,37 @@ def ring_attention(
     kv_mask: Optional[jnp.ndarray] = None,
     use_pallas: Optional[bool] = None,
     interpret: bool = False,
+    layout: str = "contiguous",
 ) -> jnp.ndarray:
     """Blockwise ring attention.
 
     Args:
         q, k, v: local blocks, shape ``(batch, t_local, heads, head_dim)``.
-            The global sequence is the concatenation of blocks in rank order.
+            The global sequence is the concatenation of blocks in rank order
+            (``layout="contiguous"``) or in zigzag order (see below).
         axis_name: the sequence-parallel mesh axis.
-        causal: apply a causal mask over *global* positions.
+        causal: apply a causal mask over *global* positions.  Ring steps
+            whose K/V block lies entirely in this rank's future are skipped
+            under ``lax.cond`` — real time saved on TPU, not just masked.
         kv_mask: optional key-padding mask for the LOCAL block, shape
             ``(batch, t_local)``; True = attend.  It rotates around the ring
             together with its K/V block.
         use_pallas: force the Pallas TPU block kernel on/off (None = auto:
             on for TPU backends).  ``interpret`` runs the kernel in
             interpreter mode (CPU testing).
+        layout: ``"contiguous"`` — rank r holds global block r.  With
+            ``causal`` the skip leaves a load imbalance (rank 0 computes 1
+            block, rank sp-1 computes sp; the ring waits for the last rank).
+            ``"zigzag"`` — rank r holds global HALF-blocks ``(r, 2sp-1-r)``
+            concatenated (permute with :func:`zigzag_order` before sharding);
+            every rank then computes exactly ``2sp+1`` unmasked half-block
+            pairs, the balanced causal schedule.
 
     Returns:
         Attention output for the local queries, same shape as ``q``.
     """
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
     axes, sp = _axis_and_size(axis_name)
     b, t, h, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
@@ -92,6 +105,7 @@ def ring_attention(
     block_fn = _pick_block_fn(use_pallas, interpret)
 
     if sp == 1:
+        # zigzag of 1 rank is the identity layout
         t_k = k.shape[1]
         mask = jnp.broadcast_to(kv_mask[:, None, :], (b, t, t_k))
         if causal:
@@ -100,6 +114,11 @@ def ring_attention(
         l = jnp.where(l == 0.0, 1.0, l)
         out = (o / l[..., None]).astype(q.dtype)
         return jnp.transpose(out, (0, 2, 1, 3))
+
+    if layout == "zigzag":
+        return _ring_attention_zigzag(
+            qf, k, v, kv_mask, axes, sp, causal, block_fn, q.dtype
+        )
 
     from bagua_tpu.communication import ppermute_shift, rank_id
 
@@ -114,7 +133,15 @@ def ring_attention(
             q_pos = my * t + jnp.arange(t)
             k_pos = src * t + jnp.arange(t)
             mask = mask & (q_pos[:, None] >= k_pos[None, :])[None]
-        o, l, m = merge_blocks((o, l, m), block_fn(qf, k_blk, v_blk, mask))
+
+            def compute(olm):
+                return merge_blocks(olm, block_fn(qf, k_blk, v_blk, mask))
+
+            # a block from a strictly-future rank contributes nothing: skip
+            # the whole block computation, not just mask it
+            o, l, m = jax.lax.cond(src <= my, compute, lambda olm: olm, (o, l, m))
+        else:
+            o, l, m = merge_blocks((o, l, m), block_fn(qf, k_blk, v_blk, mask))
         k_next = ppermute_shift(k_blk, 1, axes)
         v_next = ppermute_shift(v_blk, 1, axes)
         mask_next = ppermute_shift(mask_blk, 1, axes)
@@ -127,6 +154,104 @@ def ring_attention(
     l = jnp.where(l == 0.0, 1.0, l)
     out = (o / l[..., None]).astype(q.dtype)
     return jnp.transpose(out, (0, 2, 1, 3))  # (b, t, h, d)
+
+
+def _ring_attention_zigzag(qf, k, v, kv_mask, axes, sp, causal, block_fn, out_dtype):
+    """Zigzag-layout ring: rank r's local sequence is global half-blocks
+    ``(r, 2sp-1-r)``.  Work is skipped per (q-half, k-half) pair — the pair
+    ``(qg, kg)`` contributes iff ``qg >= kg`` — which makes the causal load
+    uniform: every rank computes ``(r+1) + (2sp-r) = 2sp+1`` pairs."""
+    from bagua_tpu.communication import ppermute_shift, rank_id
+
+    b, t, h, d = qf.shape
+    if t % 2 != 0:
+        raise ValueError(f"zigzag needs an even local length, got {t}")
+    t2 = t // 2
+    my = rank_id(axes)
+    q_halves = (qf[:, :t2], qf[:, t2:])
+    qg = (my, 2 * sp - 1 - my)  # global half-block id of each local q half
+
+    def pair(o, l, m, q_h, q_gid, k_h, v_h, mask_h, k_gid):
+        """Merge one (q-half x k-half) attention block, skipped when the
+        k half lies strictly in the q half's future."""
+        k_pos = k_gid * t2 + jnp.arange(t2)
+        q_pos = q_gid * t2 + jnp.arange(t2)
+        mask = jnp.broadcast_to(mask_h[:, None, :], (b, t2, t2))
+        if causal:
+            mask = mask & (q_pos[:, None] >= k_pos[None, :])[None]
+
+            def compute(olm):
+                return merge_blocks(olm, block_fn(q_h, k_h, v_h, mask))
+
+            return jax.lax.cond(q_gid >= k_gid, compute, lambda olm: olm, (o, l, m))
+        return merge_blocks((o, l, m), block_fn(q_h, k_h, v_h, mask))
+
+    def body(i, carry):
+        acc, k_blk, v_blk, mask_blk = carry
+        src = (my - i) % sp
+        kg = (src, 2 * sp - 1 - src)
+        new_acc = []
+        for qh in range(2):
+            o, l, m = acc[qh]
+            for kh in range(2):
+                o, l, m = pair(
+                    o, l, m,
+                    q_halves[qh], qg[qh],
+                    k_blk[:, kh * t2 : (kh + 1) * t2],
+                    v_blk[:, kh * t2 : (kh + 1) * t2],
+                    mask_blk[:, kh * t2 : (kh + 1) * t2],
+                    kg[kh],
+                )
+            new_acc.append((o, l, m))
+        k_next = ppermute_shift(k_blk, 1, axes)
+        v_next = ppermute_shift(v_blk, 1, axes)
+        mask_next = ppermute_shift(mask_blk, 1, axes)
+        return tuple(new_acc), k_next, v_next, mask_next
+
+    def zeros():
+        return (
+            jnp.zeros((b, h, t2, d), jnp.float32),
+            jnp.zeros((b, h, t2), jnp.float32),
+            jnp.full((b, h, t2), NEG, jnp.float32),
+        )
+
+    acc, _, _, _ = jax.lax.fori_loop(
+        0, sp, body, ((zeros(), zeros()), k, v, kv_mask)
+    )
+    outs = []
+    for o, l, m in acc:
+        l = jnp.where(l == 0.0, 1.0, l)
+        outs.append(o / l[..., None])
+    out = jnp.concatenate(outs, axis=2).astype(out_dtype)  # (b, h, t, d)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def zigzag_order(seq_len: int, sp: int):
+    """Global index permutation laying a length-``seq_len`` sequence out so
+    that contiguous per-rank shards hold global half-blocks ``(r, 2sp-1-r)``
+    (the balanced causal layout).  Apply with ``x[:, zigzag_order(T, sp)]``
+    before sharding; invert with :func:`zigzag_inverse`."""
+    if seq_len % (2 * sp) != 0:
+        raise ValueError(f"seq_len {seq_len} not divisible by 2*sp={2 * sp}")
+    t2 = seq_len // (2 * sp)
+    import numpy as _np
+
+    order = []
+    for r in range(sp):
+        order.extend(range(r * t2, (r + 1) * t2))
+        order.extend(range((2 * sp - 1 - r) * t2, (2 * sp - r) * t2))
+    return _np.asarray(order)
+
+
+def zigzag_inverse(seq_len: int, sp: int):
+    """Inverse permutation of :func:`zigzag_order` (maps zigzag-laid-out
+    positions back to natural order)."""
+    import numpy as _np
+
+    order = zigzag_order(seq_len, sp)
+    inv = _np.empty_like(order)
+    inv[order] = _np.arange(seq_len)
+    return inv
 
 
 def _block_attention_local(q, k, v, causal=False, kv_mask=None):

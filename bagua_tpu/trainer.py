@@ -135,6 +135,13 @@ class Trainer:
                 )
         if losses is not None:
             jax.block_until_ready(losses)
+        if self._profiler is not None:
+            # epoch ended inside the capture window: close it here (one
+            # short trace kept) rather than recording every later epoch
+            jax.block_until_ready(state)
+            self._profiler.stop()
+            self._profiler = None
+            logger.info("xprof trace (cut at epoch end) captured to %s", self.profile_dir)
         return state
 
     def close(self) -> None:
