@@ -151,7 +151,11 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
             if not self._negotiate(ready):
                 return
             if self._jit_average is None:
-                self._jit_average = self._build_average()
+                # AOT-compile OUTSIDE the dispatch lock: the first cycle would
+                # otherwise hold the lock for the full XLA compile of the
+                # average program, stalling every training-step dispatch for
+                # seconds.  The lock below then covers only the enqueue.
+                self._jit_average = self._build_average().lower(self._latest).compile()
             with self.host_dispatch_lock:
                 avg, snap = self._jit_average(self._latest)
             jax.block_until_ready(avg)

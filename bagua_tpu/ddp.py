@@ -141,47 +141,36 @@ class DistributedDataParallel:
         self._tree_template = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template
         )
-        if self.group.spans_processes:
-            # Multi-host: build the rank-stacked state *inside* jit with
-            # explicit out_shardings over the group mesh, so every process
-            # computes exactly its addressable shards (the analog of the
-            # reference's per-node state setup after the rank-0 broadcast).
-            # With plain ``params``, every process must pass the same values
-            # (e.g. same PRNG seed) — they are treated as replicated inputs.
-            sharding = jax.sharding.NamedSharding(self.group.mesh, P(ALL_AXES))
-            if stacked_params is not None:
-                build = lambda sp: TrainState(
-                    params=sp,
-                    opt_state=jax.vmap(self.optimizer.init)(sp),
-                    algo_state=jax.vmap(self.impl.init_state)(sp),
-                    step=jnp.zeros((n,), jnp.int32),
-                )
-                return jax.jit(build, out_shardings=sharding)(stacked_params)
-            build = lambda p: TrainState(
-                params=_stack(p, n),
-                opt_state=_stack(self.optimizer.init(p), n),
-                algo_state=_stack(self.impl.init_state(p), n),
+        # The state is built *inside* jit with explicit out_shardings over the
+        # group mesh — on multi-host groups every process computes exactly its
+        # addressable shards (the analog of the reference's per-node state
+        # setup after the rank-0 broadcast; with plain ``params`` every
+        # process must pass the same values, e.g. the same PRNG seed), and on
+        # every group the result is *committed* to the same sharding the step
+        # function emits.  An eagerly-built (uncommitted, single-device)
+        # state would make the first step's jit signature differ from every
+        # later step's, compiling the full step graph twice back-to-back
+        # (~2x VGG16's compile latency at startup, measured on v5e).
+        sharding = jax.sharding.NamedSharding(self.group.mesh, P(ALL_AXES))
+        if stacked_params is not None:
+            build_stacked = lambda sp: TrainState(
+                params=sp,
+                opt_state=jax.vmap(self.optimizer.init)(sp),
+                algo_state=jax.vmap(self.impl.init_state)(sp),
                 step=jnp.zeros((n,), jnp.int32),
             )
-            import numpy as np
-
-            return jax.jit(build, out_shardings=sharding)(
-                jax.tree.map(np.asarray, params)
-            )
-        if stacked_params is not None:
-            stacked = stacked_params
-            opt_state = jax.vmap(self.optimizer.init)(stacked)
-            algo_state = jax.vmap(self.impl.init_state)(stacked)
-        else:
-            stacked = _stack(params, n)
-            opt_state = _stack(self.optimizer.init(params), n)
-            algo_state = _stack(self.impl.init_state(params), n)
-        return TrainState(
-            params=stacked,
-            opt_state=opt_state,
-            algo_state=algo_state,
+            return jax.jit(build_stacked, out_shardings=sharding)(stacked_params)
+        build = lambda p: TrainState(
+            params=_stack(p, n),
+            opt_state=_stack(self.optimizer.init(p), n),
+            algo_state=_stack(self.impl.init_state(p), n),
             step=jnp.zeros((n,), jnp.int32),
         )
+        if self.group.spans_processes:
+            import numpy as np
+
+            params = jax.tree.map(np.asarray, params)
+        return jax.jit(build, out_shardings=sharding)(params)
 
     # -- re-bucketing (autotune) -------------------------------------------
 

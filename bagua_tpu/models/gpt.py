@@ -30,6 +30,22 @@ class GPTConfig:
     compute_dtype: Any = jnp.float32
 
 
+def _zigzag_active(cfg: GPTConfig) -> bool:
+    """Is the zigzag layout actually in effect (axis bound, >1 rank)?  With
+    the ``sp`` axis unbound (single-device eval/debug outside shard_map) or of
+    size 1, zigzag degenerates to the identity layout — positions, attention,
+    AND the loss seam mask must all take the contiguous path together."""
+    if cfg.sp_axis is None or cfg.sp_layout != "zigzag":
+        return False
+    try:
+        from bagua_tpu.communication import axis_size
+
+        axes = (cfg.sp_axis,) if isinstance(cfg.sp_axis, str) else cfg.sp_axis
+        return axis_size(axes) > 1
+    except NameError:
+        return False
+
+
 def _sp_positions(cfg: GPTConfig, t_local: int):
     """Global position ids of this rank's local tokens, shape (t_local,)."""
     if cfg.sp_axis is None:
@@ -39,7 +55,14 @@ def _sp_positions(cfg: GPTConfig, t_local: int):
 
         axes = (cfg.sp_axis,) if isinstance(cfg.sp_axis, str) else cfg.sp_axis
         r = rank_id(axes)
-        if cfg.sp_layout == "zigzag":
+        if _zigzag_active(cfg):
+            if t_local % 2:
+                # fail here, with the real constraint, rather than as an
+                # opaque broadcast error at the position-embedding add
+                raise ValueError(
+                    f"zigzag sp layout needs an even local sequence length, "
+                    f"got {t_local}"
+                )
             sp = axis_size(axes)
             t2 = t_local // 2
             return jnp.concatenate([
@@ -114,8 +137,15 @@ def lm_loss_fn(model: GPTModel):
         logits = model.apply({"params": params}, ids)
         logp = jax.nn.log_softmax(logits[:, :-1])
         nll = -jnp.take_along_axis(logp, ids[:, 1:, None], axis=-1)[..., 0]
-        if cfg.sp_axis is not None and cfg.sp_layout == "zigzag":
+        if _zigzag_active(cfg):
             t = ids.shape[1]
+            if t < 4:
+                # t == 2 would leave zero targets after the seam mask and
+                # divide by zero (NaN loss) — fail with the real constraint.
+                raise ValueError(
+                    f"zigzag LM loss needs a local sequence length >= 4 "
+                    f"(seam masking leaves no targets at {t})"
+                )
             keep = jnp.arange(t - 1) != (t // 2 - 1)  # drop the seam pair
             return jnp.sum(nll * keep[None]) / (nll.shape[0] * (t - 2))
         return jnp.mean(nll)

@@ -115,7 +115,12 @@ def ring_attention(
         out = (o / l[..., None]).astype(q.dtype)
         return jnp.transpose(out, (0, 2, 1, 3))
 
-    if layout == "zigzag":
+    if layout == "zigzag" and causal:
+        # Zigzag exists to balance the *causal* triangle across ranks.
+        # Non-causal attention is invariant to the kv block order (each
+        # block's kv_mask travels with it), so the contiguous path below
+        # computes the identical result with one full-size kernel launch per
+        # ring step instead of zigzag's four quarter-size ones.
         return _ring_attention_zigzag(
             qf, k, v, kv_mask, axes, sp, causal, block_fn, q.dtype
         )

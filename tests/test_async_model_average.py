@@ -142,8 +142,11 @@ def test_step_cadence_independent_of_averaging(group):
             t_hot = time_steps(hot)
             if t_hot < t_cold * 3 + 0.5:
                 break
-        assert hot.impl.folds_applied >= 1, "averager never ran during the hot run"
-        # generous bound: averaging must not serialize the step cadence
+        # generous bound: averaging must not serialize the step cadence.
+        # (Fold delivery itself is owned by
+        # test_background_thread_folds_while_training — the averager now
+        # compiles off the dispatch path, so a short timing window may
+        # legitimately end before the first cycle lands.)
         assert t_hot < t_cold * 3 + 0.5, (t_hot, t_cold)
     finally:
         hot.shutdown()
