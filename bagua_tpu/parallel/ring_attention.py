@@ -66,6 +66,7 @@ def ring_attention(
     use_pallas: Optional[bool] = None,
     interpret: bool = False,
     layout: str = "contiguous",
+    kv_groups: int = 1,
 ) -> jnp.ndarray:
     """Blockwise ring attention.
 
@@ -73,6 +74,11 @@ def ring_attention(
         q, k, v: local blocks, shape ``(batch, t_local, heads, head_dim)``.
             The global sequence is the concatenation of blocks in rank order
             (``layout="contiguous"``) or in zigzag order (see below).
+            With ``kv_groups > 1`` (grouped-query attention) K/V carry
+            ``heads // kv_groups`` heads instead: the ring hops ship the
+            *unrepeated* K/V blocks and each head group is expanded only
+            inside the per-block computation — the GQA bandwidth saving
+            applies to the ring traffic itself.
         axis_name: the sequence-parallel mesh axis.
         causal: apply a causal mask over *global* positions.  Ring steps
             whose K/V block lies entirely in this rank's future are skipped
@@ -103,6 +109,18 @@ def ring_attention(
     if kv_mask is None:
         kv_mask = jnp.ones((b, k.shape[1]), bool)
     block_fn = _pick_block_fn(use_pallas, interpret)
+    if kv_groups > 1:
+        if k.shape[2] * kv_groups != h:
+            raise ValueError(
+                f"kv_groups={kv_groups} needs K/V with {h}//{kv_groups} heads, "
+                f"got {k.shape[2]} (q has {h})"
+            )
+        inner = block_fn
+        # Expand the shared K/V heads at compute time only; everything that
+        # travels (the ring hops below) stays at the grouped head count.
+        block_fn = lambda qf_, k_, v_, m_: inner(  # noqa: E731
+            qf_, jnp.repeat(k_, kv_groups, axis=2), jnp.repeat(v_, kv_groups, axis=2), m_
+        )
 
     if sp == 1:
         # zigzag of 1 rank is the identity layout

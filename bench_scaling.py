@@ -49,6 +49,10 @@ def measure(width, params, model_cfg, deadline, max_iters=8):
     y = jnp.asarray(rng.randint(0, 1000, size=(gb,)).astype(np.int32))
     state, losses = ddp.train_step(state, (x, y))  # compile + settle
     jax.block_until_ready(losses)
+    # second warmup step compiles the steady-state executable (committed
+    # sharding + XLA layouts signature) — see the bench.py warmup note
+    state, losses = ddp.train_step(state, (x, y))
+    jax.block_until_ready(losses)
     n_iters = 0
     t0 = time.perf_counter()
     while n_iters < max_iters and (n_iters == 0 or time.perf_counter() < deadline):
