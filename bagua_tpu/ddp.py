@@ -444,3 +444,13 @@ class AutotuneSession:
         if changed_hier:
             self.ddp.impl.hierarchical = hp.is_hierarchical_reduce
             self.ddp._step_fns = {}
+        # Opt-in wire-dtype knob: only algorithms exposing ``wire_dtype``
+        # (gradient_allreduce) participate; for the rest the dimension is a
+        # no-op and the optimizer sees a flat response along it.
+        # ``hp.wire_bf16 is None`` = the service is not tuning this dimension
+        # — a user-configured wire_dtype must then be left untouched.
+        if hp.wire_bf16 is not None and hasattr(self.ddp.impl, "wire_dtype"):
+            want = jnp.dtype(jnp.bfloat16) if hp.wire_bf16 else None
+            if want != self.ddp.impl.wire_dtype:
+                self.ddp.impl.wire_dtype = want
+                self.ddp._step_fns = {}

@@ -7,7 +7,7 @@ autotune service, plus the ``ReduceOp`` enum used by the collective API
 """
 
 import enum
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from pydantic import BaseModel
 
@@ -64,6 +64,13 @@ class BaguaHyperparameter(BaseModel):
     buckets: List[List[TensorDeclaration]] = []
     bucket_size: int = 10 * 1024 ** 2
     is_hierarchical_reduce: bool = False
+    #: beyond-reference knob: exchange gradients in bfloat16 — half the ICI
+    #: bytes, applied only to algorithms exposing ``wire_dtype``.  Tri-state:
+    #: ``None`` means the service is NOT tuning this dimension (the client
+    #: must leave any user-configured wire dtype untouched); True/False are
+    #: live proposals from a ``tune_wire_dtype=True`` service, which then
+    #: owns the knob.
+    wire_bf16: Optional[bool] = None
 
     def update(self, param_dict: Dict) -> "BaguaHyperparameter":
         tmp = self.model_dump()

@@ -116,6 +116,11 @@ def parse_args(argv=None):
     p.add_argument("--autotune_level", type=int, default=0)
     # reference CLI parity (bagua/distributed/run.py autotune args)
     p.add_argument("--autotune_max_samples", type=int, default=60)
+    p.add_argument(
+        "--autotune_tune_wire_dtype", action="store_true",
+        help="let autotune also explore bf16 wire exchange (numerics-affecting"
+        ", so opt-in; applies to algorithms exposing wire_dtype)",
+    )
     p.add_argument("--autotune_warmup_time_s", type=float, default=30.0)
     p.add_argument("--autotune_sampling_confidence_time_s", type=float, default=5.0)
     p.add_argument("--bagua_service_port", type=int, default=29501)
@@ -500,6 +505,7 @@ def main(argv=None) -> int:
             max_samples=args.autotune_max_samples,
             warmup_time_s=args.autotune_warmup_time_s,
             sampling_confidence_time_s=args.autotune_sampling_confidence_time_s,
+            tune_wire_dtype=args.autotune_tune_wire_dtype,
         )
         autotune_server = start_autotune_server(service, port=args.bagua_service_port)
         logger.info("autotune service on port %d", args.bagua_service_port)

@@ -38,6 +38,7 @@ class AutotuneService:
         warmup_time_s: float = 30.0,
         is_output_autotune_log: bool = False,
         default_bucket_size: int = 10 * 1024 ** 2,
+        tune_wire_dtype: bool = False,
     ):
         self.world_size = world_size
         self.autotune_level = autotune_level
@@ -46,6 +47,7 @@ class AutotuneService:
         self.warmup_time_s = warmup_time_s
         self.is_output_autotune_log = is_output_autotune_log
         self.default_bucket_size = default_bucket_size
+        self.tune_wire_dtype = tune_wire_dtype
 
         self._lock = threading.Lock()
         self._managers: Dict[str, AutotuneTaskManager] = {}
@@ -68,7 +70,8 @@ class AutotuneService:
     def _manager(self, model_name: str) -> AutotuneTaskManager:
         if model_name not in self._managers:
             self._managers[model_name] = AutotuneTaskManager(
-                model_name, self.is_output_autotune_log
+                model_name, self.is_output_autotune_log,
+                tune_wire_dtype=self.tune_wire_dtype,
             )
             self._start_time[model_name] = time.time()
             self._last_sample_time[model_name] = 0.0

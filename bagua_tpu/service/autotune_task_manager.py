@@ -19,13 +19,23 @@ logger = logging.getLogger(__name__)
 
 
 class AutotuneTaskManager:
-    def __init__(self, model_name: str, is_output_autotune_log: bool = False):
+    def __init__(
+        self,
+        model_name: str,
+        is_output_autotune_log: bool = False,
+        tune_wire_dtype: bool = False,
+    ):
         self.model_name = model_name
         self.tensor_list: List[TensorDeclaration] = []
         self.hyperparameter = BaguaHyperparameter()
-        self.optimizer = BayesianOptimizer(
-            [IntParam("bucket_size_2p", 10, 31), BoolParam("is_hierarchical_reduce")]
-        )
+        self.tune_wire_dtype = tune_wire_dtype
+        params = [IntParam("bucket_size_2p", 10, 31), BoolParam("is_hierarchical_reduce")]
+        if tune_wire_dtype:
+            # opt-in third dimension: bf16 wire exchange trades ~3 decimal
+            # digits of gradient mantissa for half the allreduce bytes —
+            # a numerics-affecting knob, so never explored silently
+            params.append(BoolParam("wire_bf16"))
+        self.optimizer = BayesianOptimizer(params)
         self.sampling_counter = 0
         self.best_score = float("-inf")
         self.best_hyperparameter = self.hyperparameter
@@ -54,6 +64,9 @@ class AutotuneTaskManager:
             buckets=buckets,
             bucket_size=bucket_size,
             is_hierarchical_reduce=bool(param_dict["is_hierarchical_reduce"]),
+            # None = dimension not tuned; the client must not touch a
+            # user-configured wire dtype in that case
+            wire_bf16=bool(param_dict.get("wire_bf16", 0)) if self.tune_wire_dtype else None,
         )
 
     # -- optimizer loop ----------------------------------------------------
@@ -64,6 +77,8 @@ class AutotuneTaskManager:
             "bucket_size_2p": max(10, self.hyperparameter.bucket_size.bit_length() - 1),
             "is_hierarchical_reduce": int(self.hyperparameter.is_hierarchical_reduce),
         }
+        if self.tune_wire_dtype:
+            current["wire_bf16"] = int(bool(self.hyperparameter.wire_bf16))
         self.optimizer.tell(current, score)
         self.sampling_counter += 1
         if score > self.best_score:

@@ -274,3 +274,130 @@ def test_plan_changes_are_step_agreed_under_drift():
         assert any(done for (_, _, done) in seen[0].values())
     finally:
         srv.shutdown()
+
+
+def test_wire_dtype_knob_opt_in():
+    """With tune_wire_dtype the optimizer explores wire_bf16 and the service
+    reports it in proposals; without it the field stays at its False default."""
+    service = AutotuneService(
+        world_size=1, autotune_level=1, max_samples=25,
+        sampling_confidence_time_s=0.0, warmup_time_s=0.0, tune_wire_dtype=True,
+    )
+    srv = start_autotune_server(service, port=0)
+    try:
+        client = AutotuneClient(port=srv.server_address[1])
+        assert client.wait_until_ready(5.0)
+        hp = client.register_tensors("wm", fake_decls())
+        seen_bf16 = set()
+        for it in range(30):
+            # synthetic score: bf16 wire is strictly better
+            score = synthetic_score(hp.bucket_size, hp.is_hierarchical_reduce)
+            score += 25.0 if hp.wire_bf16 else 0.0
+            client.report_metrics("wm", 0, it, score)
+            hp, completed = client.ask_hyperparameters("wm", 0, it)
+            seen_bf16.add(hp.wire_bf16)
+            if completed:
+                break
+        assert completed
+        assert seen_bf16 == {False, True}, "knob was never explored"
+        assert hp.wire_bf16 is True, "locked hyperparameters missed the bf16 win"
+    finally:
+        srv.shutdown()
+
+
+def test_wire_dtype_disabled_by_default(server):
+    service, client = server
+    hp = client.register_tensors("wd", fake_decls())
+    for it in range(12):
+        client.report_metrics("wd", 0, it, 1.0)
+        hp, _ = client.ask_hyperparameters("wd", 0, it)
+        assert hp.wire_bf16 is None  # dimension not tuned
+    assert "wire_bf16" not in service._managers["wd"].optimizer.ask()
+
+
+def test_untuned_service_preserves_user_wire_dtype(group):
+    """Autotune without tune_wire_dtype must not clobber an explicitly
+    configured wire_dtype on the algorithm."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.ddp import AutotuneSession, DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+    service = AutotuneService(
+        world_size=1, autotune_level=1, max_samples=3,
+        sampling_confidence_time_s=0.0, warmup_time_s=0.0,
+    )
+    srv = start_autotune_server(service, port=0)
+    try:
+        client = AutotuneClient(port=srv.server_address[1])
+        params = init_mlp(jax.random.PRNGKey(0), [16, 32, 4])
+        ddp = DistributedDataParallel(
+            mse_loss, optax.sgd(0.05),
+            GradientAllReduceAlgorithm(wire_dtype=jnp.bfloat16), process_group=group,
+        )
+        state = ddp.init(params)
+        session = AutotuneSession(ddp, "keep_model", client=client, interval=1)
+        rng = np.random.RandomState(0)
+        for i in range(6):
+            batch = (
+                jnp.asarray(rng.randn(16, 16), np.float32),
+                jnp.asarray(rng.randn(16, 4), np.float32),
+            )
+            state, _ = ddp.train_step(state, batch)
+            session.tick(16)
+            assert ddp.impl.wire_dtype == jnp.dtype(jnp.bfloat16), (
+                "user wire_dtype clobbered by an untuned dimension"
+            )
+    finally:
+        srv.shutdown()
+
+
+def test_autotune_session_applies_wire_dtype(group):
+    """A wire_bf16 proposal flips the gradient_allreduce impl's wire_dtype
+    (re-jitting the step) and training continues finite."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.ddp import AutotuneSession, DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+    service = AutotuneService(
+        world_size=1, autotune_level=1, max_samples=40,
+        sampling_confidence_time_s=0.0, warmup_time_s=0.0, tune_wire_dtype=True,
+    )
+    srv = start_autotune_server(service, port=0)
+    try:
+        client = AutotuneClient(port=srv.server_address[1])
+        params = init_mlp(jax.random.PRNGKey(0), [16, 32, 4])
+        ddp = DistributedDataParallel(
+            mse_loss, optax.sgd(0.05), GradientAllReduceAlgorithm(), process_group=group,
+        )
+        state = ddp.init(params)
+        session = AutotuneSession(ddp, "wire_model", client=client, interval=1)
+        rng = np.random.RandomState(0)
+        saw_bf16 = False
+        for i in range(25):
+            batch = (
+                jnp.asarray(rng.randn(16, 16), np.float32),
+                jnp.asarray(rng.randn(16, 4), np.float32),
+            )
+            state, losses = ddp.train_step(state, batch)
+            assert np.isfinite(np.asarray(losses)).all()
+            session.tick(16)
+            saw_bf16 = saw_bf16 or ddp.impl.wire_dtype is not None
+            if saw_bf16:
+                break
+        assert saw_bf16, "the optimizer never proposed (or _apply never set) bf16 wire"
+        # step still runs with the bf16 wire in force
+        state, losses = ddp.train_step(
+            state,
+            (jnp.asarray(rng.randn(16, 16), np.float32), jnp.asarray(rng.randn(16, 4), np.float32)),
+        )
+        assert np.isfinite(np.asarray(losses)).all()
+    finally:
+        srv.shutdown()
