@@ -386,9 +386,15 @@ class AutotuneSession:
         self.interval = interval
         self._step = 0
         self.completed = False
-        # register the current plan's tensors
+        # register the current plan's tensors, declaring the wire dtype the
+        # initial speed reports will be measured under
         decls = [td for bucket in ddp.plan.declarations() for td in bucket]
-        self.client.register_tensors(model_name, decls)
+        self.client.register_tensors(
+            model_name, decls,
+            current_wire_bf16=(
+                getattr(ddp.impl, "wire_dtype", None) == jnp.dtype(jnp.bfloat16)
+            ),
+        )
         from bagua_tpu.observability import SpanRecorder
 
         self.spans = SpanRecorder()

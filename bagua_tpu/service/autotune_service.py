@@ -91,6 +91,10 @@ class AutotuneService:
                     {
                         "bucket_size_2p": max(10, self.default_bucket_size.bit_length() - 1),
                         "is_hierarchical_reduce": 0,
+                        # label the pre-tuning samples with the wire dtype
+                        # they are actually measured under (the client may
+                        # have preconfigured bf16)
+                        "wire_bf16": int(bool(payload.get("current_wire_bf16", False))),
                     }
                 )
                 mgr.hyperparameter.bucket_size = self.default_bucket_size

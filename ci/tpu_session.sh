@@ -37,28 +37,49 @@ run() {  # run <name> <timeout_s> <out_or_-> <cmd...>
   fi
   rm -f "$tmp"
   echo "--- $name rc=$rc" | tee -a tpu_session.log
+  LAST_RC=$rc
+}
+
+probe() {  # fast tunnel check: a dead tunnel must cost ~75s, not each
+           # remaining step's full cap (the 2026-07-29 session lost ~45 min
+           # to four hung steps after the tunnel dropped mid-run)
+  timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+LAST_RC=1  # probe before the first step too (the session may start blind)
+guard() {  # guard <step args...>: probe (only after a non-zero previous
+           # step, with one retry — a single hiccup must not drop an
+           # artifact), then run; skip fast when the tunnel is really down
+  if [ "$LAST_RC" -ne 0 ] && ! probe && ! probe; then
+    echo "--- $1 SKIPPED: tunnel down ($(date +%H:%M:%S))" | tee -a tpu_session.log
+    return
+  fi
+  run "$@"
 }
 
 # 1. Headline + per-algorithm VGG16 sweep (the round's definition of success).
 #    Internal deadline tracks the outer cap (watchdog = deadline + 60s).
-run bench 780 BENCH_TPU.json env BENCH_DEADLINE_SEC=700 python bench.py
+guard bench 780 BENCH_TPU.json env BENCH_DEADLINE_SEC=700 python bench.py
 
 # 2. BERT-Large ByteGrad bench.
-run bench_bert 780 BENCH_BERT_TPU.json env BENCH_DEADLINE_SEC=700 python bench_bert.py
+guard bench_bert 780 BENCH_BERT_TPU.json env BENCH_DEADLINE_SEC=700 python bench_bert.py
 
 # 3. Pallas kernels through Mosaic (writes PALLAS_TPU.json itself).
-run pallas 600 - python ci/validate_pallas_tpu.py
+guard pallas 600 - python ci/validate_pallas_tpu.py
 
 # 3b. DP scaling sweep — degenerates to width 1 on a single chip; on a pod
 #     slice it produces the BASELINE scaling-efficiency curve.
-run scaling 600 BENCH_SCALING_TPU.json env BENCH_DEADLINE_SEC=520 python bench_scaling.py
+guard scaling 600 BENCH_SCALING_TPU.json env BENCH_DEADLINE_SEC=520 python bench_scaling.py
 
 # 4. Autotune closed loop on the real chip (overwrites the CPU-sim record).
-run autotune 600 - env BAGUA_AUTOTUNE_RUN_TPU=1 python ci/autotune_real_run.py
+guard autotune 600 - env BAGUA_AUTOTUNE_RUN_TPU=1 python ci/autotune_real_run.py
+
+# 4b. Single-compile invariant on the real chip (writes COMPILE_STABILITY.json).
+guard compile_stability 600 - python ci/compile_stability.py --model vgg16
 
 # 5. The reference's full CI gate (determinism + per-algorithm floors) —
 #    last, so a timeout here never costs the primary artifacts; the compile
 #    cache from step 1 makes it mostly step time.
-run floors_gate 900 - python ci/benchmark_check.py --model vgg16 --tpu-floors
+guard floors_gate 900 - python ci/benchmark_check.py --model vgg16 --tpu-floors
 
 echo "=== tpu_session done $(date) ===" | tee -a tpu_session.log

@@ -401,3 +401,26 @@ def test_autotune_session_applies_wire_dtype(group):
         assert np.isfinite(np.asarray(losses)).all()
     finally:
         srv.shutdown()
+
+
+def test_first_sample_labeled_with_preconfigured_wire_dtype():
+    """A client that starts with bf16 on the wire must have its first score
+    credited to wire_bf16=1, not the f32 default."""
+    service = AutotuneService(
+        world_size=1, autotune_level=1, max_samples=10,
+        sampling_confidence_time_s=0.0, warmup_time_s=0.0, tune_wire_dtype=True,
+    )
+    srv = start_autotune_server(service, port=0)
+    try:
+        client = AutotuneClient(port=srv.server_address[1])
+        assert client.wait_until_ready(5.0)
+        hp = client.register_tensors("pre", fake_decls(), current_wire_bf16=True)
+        assert hp.wire_bf16 is True
+        client.report_metrics("pre", 0, 0, 50.0)
+        client.ask_hyperparameters("pre", 0, 0)
+        opt = service._managers["pre"].optimizer
+        wire_idx = [p.name for p in opt.params].index("wire_bf16")
+        assert opt.xs[0][wire_idx] == 1.0
+        assert opt.ys[0] == 50.0
+    finally:
+        srv.shutdown()
