@@ -18,9 +18,13 @@ import time
 
 
 class BenchHarness:
-    def __init__(self, metric: str, unit: str):
+    def __init__(self, metric: str, unit: str, recorded_artifact: str = None):
         self.metric = metric
         self.unit = unit
+        #: optional repo-relative path of a committed artifact holding this
+        #: metric's last real-hardware measurement — attached to watchdog /
+        #: error lines so a dead tunnel doesn't read as "no evidence exists"
+        self.recorded_artifact = recorded_artifact
         self.t0 = time.perf_counter()
         self._lock = threading.Lock()
         self._emitted = False
@@ -42,6 +46,18 @@ class BenchHarness:
         )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+    def _error_line(self, error: str) -> str:
+        line = {
+            "metric": self.metric,
+            "value": 0.0,
+            "unit": self.unit,
+            "vs_baseline": None,
+            "error": error,
+        }
+        if self.recorded_artifact:
+            line["recorded_artifact"] = self.recorded_artifact
+        return json.dumps(line)
+
     def _watchdog(self):
         # one minute after the measurement loop's soft deadline
         deadline = float(os.environ.get("BENCH_DEADLINE_SEC", "420")) + 60.0
@@ -50,15 +66,9 @@ class BenchHarness:
             if self._emitted:
                 os._exit(0)  # provisional line already out; let it stand
             print(
-                json.dumps(
-                    {
-                        "metric": self.metric,
-                        "value": 0.0,
-                        "unit": self.unit,
-                        "vs_baseline": None,
-                        "error": f"no measurement within {deadline:.0f}s "
-                        "(device backend init or compile hang)",
-                    }
+                self._error_line(
+                    f"no measurement within {deadline:.0f}s "
+                    "(device backend init or compile hang)"
                 ),
                 flush=True,
             )
@@ -81,15 +91,7 @@ class BenchHarness:
             with self._lock:
                 if not self._emitted:
                     print(
-                        json.dumps(
-                            {
-                                "metric": self.metric,
-                                "value": 0.0,
-                                "unit": self.unit,
-                                "vs_baseline": None,
-                                "error": f"{type(e).__name__}: {e}"[:500],
-                            }
-                        ),
+                        self._error_line(f"{type(e).__name__}: {e}"[:500]),
                         flush=True,
                     )
                     self._emitted = True

@@ -25,7 +25,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from _bench_common import BenchHarness
 
-HARNESS = BenchHarness("vgg16_img_per_sec_per_chip", "img/s/chip")
+HARNESS = BenchHarness(
+    "vgg16_img_per_sec_per_chip", "img/s/chip",
+    recorded_artifact="BENCH_TPU.json",  # last committed real-chip sweep
+)
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from _bench_common import BenchHarness
 
-HARNESS = BenchHarness("bert_large_mlm_samples_per_sec_per_chip", "samples/s/chip")
+HARNESS = BenchHarness(
+    "bert_large_mlm_samples_per_sec_per_chip", "samples/s/chip",
+    recorded_artifact="BENCH_BERT_TPU.json",  # last committed real-chip run
+)
 
 import jax
 import jax.numpy as jnp

@@ -31,9 +31,11 @@ run() {  # run <name> <timeout_s> <out_or_-> <cmd...>
   timeout "$cap" "$@" > "$tmp" 2>> tpu_session.log
   local rc=$?
   cat "$tmp" >> tpu_session.log
-  if [ "$out" != "-" ] && grep -q '^{' "$tmp"; then
-    # only replace a previous session's artifact when this run produced lines
-    grep '^{' "$tmp" > "$out"
+  if [ "$out" != "-" ] && grep '^{' "$tmp" | grep -qv '"error"'; then
+    # Replace a previous session's artifact only when this run produced at
+    # least one HEALTHY line — a watchdog/error line must never clobber the
+    # committed last real measurement its recorded_artifact field points at.
+    grep '^{' "$tmp" | grep -v '"error"' > "$out"
   fi
   rm -f "$tmp"
   echo "--- $name rc=$rc" | tee -a tpu_session.log
@@ -47,10 +49,18 @@ probe() {  # fast tunnel check: a dead tunnel must cost ~75s, not each
 }
 
 LAST_RC=1  # probe before the first step too (the session may start blind)
+TUNNEL_DOWN=0
 guard() {  # guard <step args...>: probe (only after a non-zero previous
            # step, with one retry — a single hiccup must not drop an
-           # artifact), then run; skip fast when the tunnel is really down
+           # artifact), then run; once both probes fail the verdict is
+           # cached so a dead tunnel costs one ~150s check, not 150s per
+           # remaining step
+  if [ "$TUNNEL_DOWN" -eq 1 ]; then
+    echo "--- $1 SKIPPED: tunnel down ($(date +%H:%M:%S))" | tee -a tpu_session.log
+    return
+  fi
   if [ "$LAST_RC" -ne 0 ] && ! probe && ! probe; then
+    TUNNEL_DOWN=1
     echo "--- $1 SKIPPED: tunnel down ($(date +%H:%M:%S))" | tee -a tpu_session.log
     return
   fi

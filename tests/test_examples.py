@@ -101,3 +101,26 @@ def test_dryrun_multichip_wider_than_test_mesh(n_devices):
         capture_output=True, text=True, timeout=420, env=env, cwd=REPO_ROOT,
     )
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+
+def test_llama_pretrain_real_text(tmp_path):
+    """Char-LM on a real UTF-8 corpus fixture through the dp x tp x sp
+    example (8-device sim inside the subprocess)."""
+    text = ("To be, or not to be, that is the question:\n" * 80)
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(text, encoding="utf-8")
+    env_extra = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT, **env_extra)
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "llama_pretrain", "main.py"),
+         "--data", str(corpus), "--dp", "2", "--tp", "2", "--sp", "2",
+         "--steps", "8", "--seq", "32", "--batch", "8"],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    lines = [l for l in r.stdout.splitlines() if l.startswith("final:")]
+    assert lines, r.stdout
+    # loss must improve on real text over a few steps
+    parts = lines[0].split("loss")[1].split("->")
+    assert float(parts[1]) < float(parts[0]), lines[0]
