@@ -341,3 +341,112 @@ def test_gpipe_remat_same_values(pp_mesh):
         np.testing.assert_allclose(
             np.asarray(a[key]), np.asarray(b[key]), rtol=1e-5, atol=1e-7
         )
+
+
+def test_interleaved_matches_sequential_oracle(pp_mesh):
+    """Interleaved V=2 over 4 ranks == sequential application of the 8
+    global stages, for loss AND per-chunk gradients."""
+    from bagua_tpu.parallel.pipeline import pipeline_loss_interleaved
+
+    V = 2
+    n_global = V * STAGES
+    chunks = [make_stage_params(100 + j) for j in range(n_global)]
+    rng = np.random.RandomState(3)
+    micro = jnp.asarray(rng.randn(8, MB, DIM).astype(np.float32))  # 8 % 4 == 0
+    target = jnp.asarray(rng.randn(8, MB, DIM).astype(np.float32))
+
+    def mb_loss(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    # oracle: global stage j = v * STAGES + r, applied in order j = 0..7
+    def oracle(flat_chunks):
+        out = []
+        for m in range(micro.shape[0]):
+            x = micro[m]
+            for p in flat_chunks:
+                x = stage_fn(p, x)
+            out.append(mb_loss(x, target[m]))
+        return jnp.mean(jnp.stack(out))
+
+    expect_loss, expect_grads = jax.value_and_grad(oracle)(chunks)
+
+    # rank r's stacked chunks: [chunk r, chunk STAGES + r, ...]
+    per_rank = [
+        jax.tree.map(lambda *xs: jnp.stack(xs), *[chunks[v * STAGES + r] for v in range(V)])
+        for r in range(STAGES)
+    ]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_rank)  # (S, V, ...)
+
+    def local(p, mb, tg):
+        mine = jax.tree.map(lambda q: q[0], p)  # (V, ...) per rank
+        return pipeline_loss_interleaved(stage_fn, mine, mb, tg, mb_loss, axis_name="pp")
+
+    fn = jax.jit(
+        jax.shard_map(
+            jax.value_and_grad(local),
+            mesh=pp_mesh,
+            in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P("pp")),
+            check_vma=False,
+        )
+    )
+    loss, grads = fn(stacked, micro, target)
+    np.testing.assert_allclose(float(loss), float(expect_loss), rtol=2e-4)
+    got = np.asarray(grads["w"])  # (S, V, DIM, DIM)
+    for r in range(STAGES):
+        for v in range(V):
+            np.testing.assert_allclose(
+                got[r, v], np.asarray(expect_grads[v * STAGES + r]["w"]),
+                rtol=2e-3, atol=2e-5,
+            )
+
+
+def test_interleaved_v1_equals_gpipe(pp_mesh):
+    """V=1 interleaved degenerates to the GPipe schedule exactly."""
+    from bagua_tpu.parallel.pipeline import pipeline_loss_interleaved
+
+    stages = [make_stage_params(40 + s) for s in range(STAGES)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+    rng = np.random.RandomState(5)
+    micro = jnp.asarray(rng.randn(8, MB, DIM).astype(np.float32))
+    target = jnp.asarray(rng.randn(8, MB, DIM).astype(np.float32))
+
+    def mb_loss(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    def run(use_interleaved):
+        def local(p, mb, tg):
+            mine = jax.tree.map(lambda q: q[0], p)
+            if use_interleaved:
+                one = jax.tree.map(lambda q: q[None], mine)  # V=1 leading axis
+                return pipeline_loss_interleaved(stage_fn, one, mb, tg, mb_loss, axis_name="pp")
+            return pipeline_loss(stage_fn, mine, mb, tg, mb_loss, axis_name="pp")
+
+        fn = jax.jit(
+            jax.shard_map(local, mesh=pp_mesh, in_specs=(P("pp"), P(), P()),
+                          out_specs=P(), check_vma=False)
+        )
+        return float(fn(stacked, micro, target))
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
+
+
+def test_interleaved_micro_divisibility(pp_mesh):
+    from bagua_tpu.parallel.pipeline import pipeline_loss_interleaved
+
+    stages = [make_stage_params(60 + s) for s in range(STAGES)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+    micro = jnp.zeros((6, MB, DIM), jnp.float32)  # 6 % 4 != 0
+    target = jnp.zeros((6, MB, DIM), jnp.float32)
+
+    def local(p, mb, tg):
+        one = jax.tree.map(lambda q: q[0][None], p)  # this rank's chunk, V=1
+        return pipeline_loss_interleaved(
+            stage_fn, one, mb, tg, lambda y, t: jnp.mean((y - t) ** 2), axis_name="pp"
+        )
+
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(
+            jax.shard_map(local, mesh=pp_mesh, in_specs=(P("pp"), P(), P()),
+                          out_specs=P(), check_vma=False)
+        )(stacked, micro, target)
