@@ -14,17 +14,21 @@ live weights in place" does not map directly — but the property does:
 
 * A daemon **averager thread** wakes every ``sync_interval_ms``, snapshots the
   current rank-stacked parameters (a Python ref — jax.Arrays are immutable, so
-  the snapshot is free), and dispatches a separately-jitted **average
-  program** that returns ``(group_mean, snapshot_copy)`` in fresh buffers.
-  The device executes it interleaved with training steps (the role of the
-  reference's comm stream); the host training loop never waits on it.
-* When a result lands, it is **folded** into the training state right before
-  the next step dispatch: ``params <- params + (avg - snapshot)`` — i.e. the
-  averaging *delta* measured at snapshot time is applied to the current
-  weights.  This is the well-defined functional analog of the reference's
-  tolerated race between the averaging write-back and concurrent optimizer
-  updates: progress made since the snapshot survives, staleness in the
-  average is accepted.
+  the snapshot is free), and dispatches a separately-jitted **delta
+  program** ``delta = group_mean - snapshot`` into fresh buffers.  The device
+  executes it interleaved with training steps (the role of the reference's
+  comm stream); the averager NEVER waits on the result — it publishes the
+  in-flight delta and goes back to sleep.  (Returning the delta rather than
+  ``(mean, snapshot_copy)`` halves the program's HBM writes and the fold's
+  reads.)
+* Right before a step dispatch the engine **folds** a published delta into the
+  training state — ``params <- params + delta`` — but ONLY if its buffers
+  have actually landed (``Array.is_ready()``, a non-blocking query).  An
+  in-flight average is simply left pending for a later step, so the training
+  loop never blocks on the averager, host- or device-side.  This is the
+  well-defined functional analog of the reference's tolerated race between
+  the averaging write-back and concurrent optimizer updates: progress made
+  since the snapshot survives, staleness in the average is accepted.
 * The steady-state train step itself contains **zero collectives** (warmup
   steps route through a ``lax.cond`` gradient allreduce, after which the
   branch is dead) — so step cadence is independent of averaging cadence.
@@ -41,6 +45,7 @@ step donates its input buffers; sampling under the lock guarantees the
 averager only ever reads the freshest, not-yet-donated parameters.
 """
 
+import logging
 import threading
 
 import jax
@@ -73,7 +78,16 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
         self._status = "running"
         self._latest = None  # rank-stacked params of the newest dispatched step
         self._published_step = 0
-        self._pending = None  # (snapshot, avg) awaiting fold
+        self._pending = None  # (generation, delta tree) awaiting fold
+        # Double-fold guard.  A delta is ``mean(snap) - snap``; applying it is
+        # only correct if no OTHER fold landed between its snapshot and its
+        # consumption — an intervening fold's correction would be re-applied
+        # (observed on the 8-dev CPU sim as the rank spread re-inverting to
+        # its full initial magnitude at lr=0).  Optimizer progress in that
+        # window is fine (the tolerated staleness); a second fold is not.
+        # The counter increments on every fold; stale-generation deltas are
+        # dropped.  Guarded by ``_pending_lock``.
+        self._fold_generation = 0
         self._pending_lock = threading.Lock()
         self._cycle_lock = threading.Lock()  # held across one averaging cycle
         self.host_dispatch_lock = threading.Lock()  # shared with the engine
@@ -82,32 +96,35 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
         self._wake = threading.Event()
         self._shutdown = False
         self._jit_average = None
+        # The delta is consumed exactly once — donate its buffers to the fold.
         self._jit_fold = jax.jit(
-            lambda params, snap, avg: jax.tree.map(
-                lambda p, s, a: p + (a - s), params, snap, avg
-            )
+            lambda params, delta: jax.tree.map(
+                lambda p, d: p + d, params, delta
+            ),
+            donate_argnums=(1,),
         )
         self.folds_applied = 0  # observability: how many averages landed
+        self.folds_failed = 0  # observability: how many folds were dropped
 
     # -- the average program -------------------------------------------------
 
     def _build_average(self):
         def local(p):
-            def mean_of(x):
+            def delta_of(x):
                 # Uniform stacking: every device holds size/n_dev rows, so the
-                # pmean of local means is the group mean.
+                # pmean of local means is the group mean.  Emitting the delta
+                # (mean - snapshot) keeps the output a fresh buffer — no
+                # aliasing with the live training params, which the next step
+                # will donate — while halving the traffic of returning
+                # (mean, snapshot_copy) pairs.
                 m = jax.lax.pmean(jnp.mean(x, axis=0, keepdims=True), ALL_AXES)
-                return jnp.broadcast_to(m, x.shape)
+                return jnp.broadcast_to(m, x.shape) - x
 
-            avg = jax.tree.map(mean_of, p)
-            # ``x + 0`` forces fresh output buffers (no aliasing with the live
-            # training params, which the next step will donate).
-            snap = jax.tree.map(lambda x: x + 0, p)
-            return avg, snap
+            return jax.tree.map(delta_of, p)
 
         return jax.jit(
             self.process_group.shard_map(
-                local, in_specs=P(ALL_AXES), out_specs=(P(ALL_AXES), P(ALL_AXES))
+                local, in_specs=P(ALL_AXES), out_specs=P(ALL_AXES)
             )
         )
 
@@ -131,7 +148,13 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
         flags = multihost_utils.process_allgather(np.int32(1 if ready else 0))
         return bool(flags.min())
 
-    def _cycle(self, stop_event=None):
+    def _cycle(self, stop_event=None, wait: bool = True):
+        """One averaging cycle.  ``wait=False`` (the background thread's mode)
+        dispatches the delta program and publishes the in-flight result
+        without ever blocking — a host-side wait here was measured stalling
+        step dispatch on the remote-relay TPU backend (BENCH_TPU.json r3:
+        async 183 img/s vs gradient_allreduce 764).  ``wait=True`` (manual /
+        test calls) blocks until the delta lands, for determinism."""
         stop_event = stop_event or self._stop_event
         # Multi-process: negotiation is itself a collective, and warmup steps
         # contain gradient allreduces — negotiating mid-warmup would interleave
@@ -157,11 +180,35 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
                 # seconds.  The lock below then covers only the enqueue.
                 self._jit_average = self._build_average().lower(self._latest).compile()
             with self.host_dispatch_lock:
-                avg, snap = self._jit_average(self._latest)
-            jax.block_until_ready(avg)
+                with self._pending_lock:
+                    gen = self._fold_generation
+                    latest = self._latest
+                delta = self._jit_average(latest)
+            if wait:
+                jax.block_until_ready(delta)
+            displaced = None
             with self._pending_lock:
-                if self._status == "running":
-                    self._pending = (snap, avg)
+                if self._status == "running" and gen == self._fold_generation:
+                    if self._pending is not None:
+                        # An unconsumed previous delta is displaced — drain it
+                        # below so no untracked program outlives the cycle.
+                        displaced = self._pending[1]
+                    self._pending = (gen, delta)
+                    delta = None
+            if displaced is not None:
+                try:
+                    jax.block_until_ready(displaced)
+                except Exception:
+                    pass
+            if delta is not None:
+                # Publish suppressed (abort or a racing fold): drain the
+                # orphaned program here, in the averager thread, so abort()'s
+                # exclusive-device-time contract holds — releasing
+                # ``_cycle_lock`` must imply the device is quiet.
+                try:
+                    jax.block_until_ready(delta)
+                except Exception:
+                    pass
 
     def _run(self, stop_event, wake):
         while True:
@@ -169,7 +216,7 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
             wake.clear()
             if stop_event.is_set():
                 return
-            self._cycle(stop_event)
+            self._cycle(stop_event, wait=False)
 
     def _ensure_thread(self):
         if self._shutdown:
@@ -189,14 +236,59 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
 
     # -- host-side engine hooks ---------------------------------------------
 
+    def _log_fold_failure(self, what: str, exc: Exception) -> None:
+        self.folds_failed += 1
+        logging.getLogger(__name__).warning(
+            "async model average: %s (%s: %s); the average was skipped "
+            "(folds_failed=%d)", what, type(exc).__name__, exc, self.folds_failed
+        )
+
     def host_pre_dispatch(self, state):
         with self._pending_lock:
-            pending, self._pending = self._pending, None
-        if pending is None:
+            if self._pending is None:
+                return state
+            gen, delta = self._pending
+            if gen != self._fold_generation:
+                # Snapshot predates an intervening fold — applying it would
+                # double-count that fold's correction.  Drop; the averager
+                # will produce a fresh delta next cycle.
+                self._pending = None
+                return state
+            try:
+                if not all(
+                    leaf.is_ready() for leaf in jax.tree.leaves(delta)
+                    if hasattr(leaf, "is_ready")
+                ):
+                    # In flight: leave it pending for a later step — the
+                    # training loop must never wait on the averager (the
+                    # reference's defining property,
+                    # async_model_average.py:208-230).
+                    return state
+            except Exception as e:
+                # Host-visible delta failure (e.g. deleted/donated buffers):
+                # degrade to a skipped average, never kill training.  A
+                # DEVICE-side async failure is NOT catchable here — it
+                # surfaces at the training loop's next await, like any other
+                # algorithm's collective failure would.
+                self._log_fold_failure("pending delta unusable", e)
+                self._pending = None
+                return state
+            self._pending = None
+        try:
+            folded = self._jit_fold(state.params, delta)
+        except Exception as e:
+            # Dispatch-time (structural) failure: param tree / sharding
+            # mismatch, e.g. after an in-place model swap.  Loud, counted —
+            # a permanent mismatch would otherwise silently stop averaging.
+            self._log_fold_failure("fold dispatch failed", e)
             return state
-        snap, avg = pending
+        with self._pending_lock:
+            self._fold_generation += 1
+            # Retarget the snapshot source at the folded params so a cycle
+            # racing this fold can never capture the pre-fold tree.
+            self._latest = folded
         self.folds_applied += 1
-        return state._replace(params=self._jit_fold(state.params, snap, avg))
+        return state._replace(params=folded)
 
     def host_post_dispatch(self, state, step: int) -> None:
         self._latest = state.params
@@ -206,14 +298,21 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
     # -- control (reference ``:232-305``) ------------------------------------
 
     def abort(self):
-        """Stop averaging; waits for any in-flight average to drain and
-        discards its undelivered result."""
+        """Stop averaging; waits for any in-flight average to drain (both the
+        cycle's dispatch and its device-side execution) and discards the
+        undelivered result — callers rely on exclusive device time after
+        abort() returns (e.g. a timed benchmark window)."""
         if self._status != "running":
             return
         self._status = "aborted"
-        with self._cycle_lock:  # drain: in-flight cycle finishes first
+        with self._cycle_lock:  # drain: in-flight cycle's dispatch first
             with self._pending_lock:
-                self._pending = None
+                pending, self._pending = self._pending, None
+            if pending is not None:
+                try:
+                    jax.block_until_ready(pending[1])  # device-side drain
+                except Exception:
+                    pass  # a failed average aborts just the same
 
     def resume(self):
         self._status = "running"

@@ -44,6 +44,16 @@ class LlamaConfig:
     compute_dtype: Any = jnp.float32
 
     def __post_init__(self):
+        if self.hidden_size % self.num_heads:
+            raise ValueError(
+                f"hidden_size ({self.hidden_size}) must divide by num_heads "
+                f"({self.num_heads})"
+            )
+        if (self.hidden_size // self.num_heads) % 2:
+            raise ValueError(
+                f"head_dim ({self.hidden_size // self.num_heads}) must be even "
+                "(RoPE rotates half-dimension pairs)"
+            )
         if self.num_heads % self.num_kv_heads:
             raise ValueError(
                 f"num_heads ({self.num_heads}) must divide by num_kv_heads "

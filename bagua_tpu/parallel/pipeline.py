@@ -214,9 +214,11 @@ def _interleaved_collect(stage_fn, stacked_params, microbatches, axes, remat, n_
     per rank, and every rank emits exactly one value per tick, so the single
     neighbor ``ppermute`` register carries both intra-circuit hops
     (rank r -> r+1, same chunk) and the wrap (rank S-1 chunk v -> rank 0
-    chunk v+1).  ``V = 1`` reduces to the GPipe loop; the trailing bubble is
-    ~``2(S-1)`` chunk-ticks of work ``M*V`` — a ~``V``x smaller bubble
-    fraction ((S-1)/(M*V)) than GPipe's ``(S-1)/M`` at equal total work."""
+    chunk v+1).  ``V = 1`` reduces to the GPipe loop.  Total ticks are
+    ``M*V + S - 1``: each rank does ``M*V`` work ticks and idles ``S-1``
+    ticks total (rank ``r``: ``r`` warmup + ``S-1-r`` drain), a bubble
+    fraction of ~``(S-1)/(M*V)`` — ``V``x smaller than GPipe's ``(S-1)/M``
+    at equal total work."""
     from bagua_tpu.communication import ppermute_shift, rank_id
 
     if remat:

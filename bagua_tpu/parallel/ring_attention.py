@@ -112,7 +112,7 @@ def ring_attention(
     if kv_groups > 1:
         if k.shape[2] * kv_groups != h:
             raise ValueError(
-                f"kv_groups={kv_groups} needs K/V with {h}//{kv_groups} heads, "
+                f"kv_groups={kv_groups} needs K/V with {h // kv_groups} heads, "
                 f"got {k.shape[2]} (q has {h})"
             )
         inner = block_fn

@@ -98,6 +98,13 @@ class AutotuneService:
                     }
                 )
                 mgr.hyperparameter.bucket_size = self.default_bucket_size
+            elif self.tune_wire_dtype and mgr.sampling_counter == 0:
+                # Re-registration before any GP proposal: the restarted gang
+                # may have changed its preconfigured wire dtype — refresh the
+                # label so its pre-tuning samples credit the right wire_bf16.
+                mgr.hyperparameter.wire_bf16 = bool(
+                    payload.get("current_wire_bf16", False)
+                )
             # (Re-)registration = a (re)started gang whose train_iter restarts
             # from 0: reset the per-rank ask ratchet and re-base the
             # effective-from history on the current hyperparameters, or new

@@ -37,7 +37,10 @@ class _CompileCounter(logging.Handler):
 
     def emit(self, record):
         msg = record.getMessage()
-        if "Compiling jit(local_step)" in msg:
+        # Loose match: tolerate the wrapper name changing ("jit(local_step)"
+        # vs "local_step for pjit") but not the companion "Finished ..."
+        # lines, which would double-count each compile.
+        if msg.startswith("Compiling") and "local_step" in msg:
             self.compiles.append(msg[:120])
 
 
@@ -64,7 +67,8 @@ def main():
     jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
     jax.config.update("jax_log_compiles", True)
     counter = _CompileCounter()
-    logging.getLogger("jax._src.interpreters.pxla").addHandler(counter)
+    # Root "jax" logger: survives internal module renames across JAX versions.
+    logging.getLogger("jax").addHandler(counter)
 
     import jax.numpy as jnp
     import numpy as np
@@ -127,6 +131,15 @@ def main():
         "local_step_compiles": len(counter.compiles),
         "stalled_steps": stalled,
         "ok": len(counter.compiles) == 1 and not stalled,
+        # Distinguish WHY the gate failed: 0 detected compiles with clean
+        # timings means the log hook missed (JAX changed its message), not
+        # that the invariant broke.
+        "failure_reason": (
+            "stall" if stalled
+            else "recompile" if len(counter.compiles) > 1
+            else "compile_log_not_detected" if not counter.compiles
+            else None
+        ),
     }
     print(json.dumps(result, indent=1))
     with open(args.out, "w") as f:

@@ -153,6 +153,33 @@ def test_step_cadence_independent_of_averaging(group):
         cold.shutdown()
 
 
+def test_stale_generation_delta_is_dropped(group):
+    """Double-fold guard: a delta whose snapshot predates an intervening fold
+    must be dropped, not re-applied.  (Re-applying it re-adds the previous
+    fold's correction: at lr=0 the rank spread re-inverts to its full initial
+    magnitude instead of staying collapsed — the race the background thread
+    can hit when a cycle snapshot overlaps a fold.)"""
+    base = init_mlp(jax.random.PRNGKey(4), [DIM_IN, 8, DIM_OUT])
+    xs, ys = make_data(3, seed=5)
+    ddp = make_ddp(base, lr=0.0, group=group)
+    state = ddp.init(stacked_params=spread_params(base))
+    try:
+        state, _ = ddp.train_step(state, (jnp.asarray(xs[0]), jnp.asarray(ys[0])))
+        ddp.impl._cycle()
+        gen, delta = ddp.impl._pending
+        stale = (gen, jax.tree.map(lambda x: x + 0, delta))  # pre-donation copy
+        state, _ = ddp.train_step(state, (jnp.asarray(xs[1]), jnp.asarray(ys[1])))
+        assert ddp.impl.folds_applied == 1 and ranks_close(state)
+        # inject the stale-generation delta as if a racing cycle published it
+        ddp.impl._pending = stale
+        state, _ = ddp.train_step(state, (jnp.asarray(xs[2]), jnp.asarray(ys[2])))
+        assert ddp.impl.folds_applied == 1, "stale delta was folded"
+        assert ddp.impl._pending is None, "stale delta was not dropped"
+        assert ranks_close(state), "stale fold re-inverted the rank spread"
+    finally:
+        ddp.shutdown()
+
+
 def test_abort_drains_and_resume_rearms(group):
     base = init_mlp(jax.random.PRNGKey(3), [DIM_IN, 8, DIM_OUT])
     xs, ys = make_data(3, seed=4)

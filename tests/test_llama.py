@@ -23,9 +23,13 @@ from bagua_tpu.models.llama import (
 
 def test_config_validation():
     with pytest.raises(ValueError, match="num_kv_heads"):
-        LlamaConfig(num_heads=6, num_kv_heads=4)
+        LlamaConfig(hidden_size=768, num_heads=6, num_kv_heads=4)
     with pytest.raises(ValueError, match="tp_size"):
         llama_test_config(num_heads=4, num_kv_heads=2, tp_size=4)  # kv % tp != 0
+    with pytest.raises(ValueError, match="hidden_size"):
+        LlamaConfig(hidden_size=100, num_heads=6, num_kv_heads=6)
+    with pytest.raises(ValueError, match="head_dim"):  # 18/6 = 3, odd -> RoPE
+        LlamaConfig(hidden_size=18, num_heads=6, num_kv_heads=6)
 
 
 def test_rope_properties():
