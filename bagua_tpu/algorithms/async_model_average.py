@@ -88,6 +88,11 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
         # The counter increments on every fold; stale-generation deltas are
         # dropped.  Guarded by ``_pending_lock``.
         self._fold_generation = 0
+        #: deltas dropped while possibly still in flight (stale generation /
+        #: unusable) — drained by the next cycle or abort() so no untracked
+        #: program outlives the averager's device-quiescence guarantees.
+        #: Guarded by ``_pending_lock``.
+        self._orphans = []
         self._pending_lock = threading.Lock()
         self._cycle_lock = threading.Lock()  # held across one averaging cycle
         self.host_dispatch_lock = threading.Lock()  # shared with the engine
@@ -186,29 +191,31 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
                 delta = self._jit_average(latest)
             if wait:
                 jax.block_until_ready(delta)
-            displaced = None
             with self._pending_lock:
                 if self._status == "running" and gen == self._fold_generation:
                     if self._pending is not None:
-                        # An unconsumed previous delta is displaced — drain it
-                        # below so no untracked program outlives the cycle.
-                        displaced = self._pending[1]
+                        # An unconsumed previous delta is displaced — drain
+                        # it below so no untracked program outlives the cycle.
+                        self._orphans.append(self._pending[1])
                     self._pending = (gen, delta)
-                    delta = None
-            if displaced is not None:
-                try:
-                    jax.block_until_ready(displaced)
-                except Exception:
-                    pass
-            if delta is not None:
-                # Publish suppressed (abort or a racing fold): drain the
-                # orphaned program here, in the averager thread, so abort()'s
-                # exclusive-device-time contract holds — releasing
-                # ``_cycle_lock`` must imply the device is quiet.
-                try:
-                    jax.block_until_ready(delta)
-                except Exception:
-                    pass
+                else:
+                    # Publish suppressed (abort or a racing fold): the
+                    # orphaned program still drains below, so abort()'s
+                    # exclusive-device-time contract holds — releasing
+                    # ``_cycle_lock`` must imply the device is quiet.
+                    self._orphans.append(delta)
+            self._drain_orphans()
+
+    def _drain_orphans(self):
+        """Wait out any dropped-while-in-flight delta programs.  Called from
+        the averager thread and abort() — never from the step dispatch path."""
+        with self._pending_lock:
+            orphans, self._orphans = self._orphans, []
+        for delta in orphans:
+            try:
+                jax.block_until_ready(delta)
+            except Exception:
+                pass  # a failed orphan is quiet by definition
 
     def _run(self, stop_event, wake):
         while True:
@@ -250,8 +257,10 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
             gen, delta = self._pending
             if gen != self._fold_generation:
                 # Snapshot predates an intervening fold — applying it would
-                # double-count that fold's correction.  Drop; the averager
-                # will produce a fresh delta next cycle.
+                # double-count that fold's correction.  Drop (to the orphan
+                # list: it may still be in flight, and only the averager /
+                # abort may wait on it); a fresh delta comes next cycle.
+                self._orphans.append(delta)
                 self._pending = None
                 return state
             try:
@@ -271,6 +280,7 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
                 # surfaces at the training loop's next await, like any other
                 # algorithm's collective failure would.
                 self._log_fold_failure("pending delta unusable", e)
+                self._orphans.append(delta)
                 self._pending = None
                 return state
             self._pending = None
@@ -307,12 +317,10 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
         self._status = "aborted"
         with self._cycle_lock:  # drain: in-flight cycle's dispatch first
             with self._pending_lock:
-                pending, self._pending = self._pending, None
-            if pending is not None:
-                try:
-                    jax.block_until_ready(pending[1])  # device-side drain
-                except Exception:
-                    pass  # a failed average aborts just the same
+                if self._pending is not None:
+                    self._orphans.append(self._pending[1])
+                    self._pending = None
+            self._drain_orphans()  # device-side drain, failures included
 
     def resume(self):
         self._status = "running"

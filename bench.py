@@ -18,10 +18,7 @@ deadline.
 """
 
 import os
-import sys
 import time
-
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from _bench_common import BenchHarness
 

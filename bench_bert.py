@@ -9,10 +9,7 @@ the fused-jnp implementation and reports which one actually runs faster.
 """
 
 import os
-import sys
 import time
-
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from _bench_common import BenchHarness
 

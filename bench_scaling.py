@@ -15,10 +15,7 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 python bench_scaling.py``.
 """
 
 import os
-import sys
 import time
-
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from _bench_common import BenchHarness
 

@@ -33,7 +33,6 @@ if "BAGUA_AUTOTUNE_RUN_TPU" not in os.environ:
     os.environ["JAX_PLATFORMS"] = "cpu"
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
 
 import jax
 

@@ -23,8 +23,8 @@ import os
 import sys
 import time
 
-# runnable as `python ci/benchmark_check.py` from anywhere
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# needs the package installed: `python ci/check_packaging.py` (once) or
+# `pip install -e . --no-deps`; ci/tpu_session.sh does this as step 0
 
 import jax
 

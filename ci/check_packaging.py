@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""Packaging gate: the framework must be installable and importable as a
+real package, like the reference (``/root/reference/setup.py:101-108,130-134``
+— installable wheel + ``baguarun`` console script).
+
+Checks, from a NEUTRAL working directory (so the repo root being on
+``sys.path`` can't mask a broken install):
+
+1. ``pip install -e . --no-deps`` succeeds (idempotent if already installed).
+2. ``import bagua_tpu`` resolves to the repo tree and exposes ``__version__``.
+3. Both console entry points exist and answer ``--help``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(cmd, **kw):
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.run(cmd, check=True, **kw)
+
+
+def main():
+    run(
+        [sys.executable, "-m", "pip", "install", "-e", ".", "--no-deps",
+         "--no-build-isolation", "-q"],
+        cwd=REPO,
+    )
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import bagua_tpu, json, os; print(json.dumps("
+         "{'version': bagua_tpu.__version__, "
+         "'path': os.path.dirname(bagua_tpu.__file__)}))"],
+        cwd="/", capture_output=True, text=True, check=True,
+    )
+    info = json.loads(probe.stdout.strip().splitlines()[-1])
+    assert os.path.samefile(info["path"], os.path.join(REPO, "bagua_tpu")), info
+    for script in ("baguarun", "bagua-tpu-run"):
+        run([script, "--help"], cwd="/", capture_output=True)
+    print(json.dumps({"ok": True, **info}))
+
+
+if __name__ == "__main__":
+    main()

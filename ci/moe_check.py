@@ -20,7 +20,6 @@ Run:  JAX_PLATFORMS=cpu python ci/moe_check.py
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 

@@ -1,0 +1,266 @@
+#!/usr/bin/env python3
+"""Falsifiable 8->256-chip scaling projection (VERDICT r3 missing #4).
+
+Real multi-chip hardware is not reachable from this environment, so the
+driver's north-star metric (BASELINE.json: "scaling efficiency 8->256
+chips") cannot be *measured* here.  This tool produces the next-best
+artifact: a committed, assumption-explicit projection that a future pod run
+can confirm or refute, derived from
+
+* the per-algorithm collective census (PERF_AUDIT.json — what actually
+  travels per step, audited from compiled HLO), and
+* the measured single-chip step times (BENCH_TPU.json / BENCH_BERT_TPU.json,
+  v5e via the tunnel), and
+* an explicit ICI cost model (bytes, hops, link bandwidth per topology).
+
+Reference context: the reference proves scaling with figures only
+(`/root/reference/README.md:39-53`, 128 GPUs); its machine-checked CI floors
+are fixed-size 2x4 (`.buildkite/scripts/benchmark_master.sh:81-106`).
+
+Cost model (stated so it can be refuted measurement-by-measurement):
+
+* v5e 2D torus, 4 ICI links/chip at 45 GB/s usable per direction; a
+  conservative 50% efficiency discount gives BW_CHIP = 90 GB/s of usable
+  injection bandwidth per chip (same assumption as PERF_AUDIT.md's
+  roofline).  Per-hop latency LAT = 1 us; a collective pays the torus
+  diameter in hops once (latency term, irrelevant at VGG16/BERT sizes but
+  stated for falsifiability).
+* ring/torus all-reduce moves 2*(n-1)/n * bytes per chip; all-gather and
+  all-to-all move (n-1)/n * bytes; a neighbor collective-permute moves
+  bytes once over one hop.  XLA's per-dimension torus decomposition changes
+  the hop count, not these per-chip byte totals.
+* Weak scaling (fixed per-chip batch, the reference benchmark's regime):
+  per-chip compute time is constant in n; only collective time grows.
+* Overlap: XLA's latency-hiding scheduler overlaps collectives with the
+  backward pass.  OVERLAP_WINDOW = 2/3 of the measured single-chip step
+  (the backward fraction); comm beyond that window is exposed:
+      t(n) = t_compute + max(0, t_comm(n) - OVERLAP_WINDOW * t_compute)
+* Efficiency(n) = t(8) / t(n)  (8 chips = the smallest pod-slice baseline,
+  matching BASELINE.json's 8->256 framing).  n stays within one 256-chip
+  v5e pod — no DCN term enters; a multi-pod projection would add a DCN
+  bottleneck term  bytes / (HOSTS_PER_POD * DCN_GBPS)  which we also emit
+  for 512 chips as a sanity extension.
+
+Wire bytes per algorithm (per step, per chip, from the census patterns —
+PERF_AUDIT.md maps each to its compiled HLO):
+
+* gradient_allreduce: one variadic all-reduce over the gradient bytes
+  (bf16 wire option: 2 B/param).
+* bytegrad: u8 compressed hierarchical all-reduce = all-to-all (1 B/param)
+  + all-gather (1 B/param) + minmax scalars (negligible).
+* decentralized: one peer weight exchange via collective-permute
+  (2 B/param bf16), single hop — n-independent by construction.
+* low_precision_decentralized: two u8 ring diff exchanges (1 B/param each),
+  single hop each.
+* qadam: compressed exchange identical to bytegrad (warmup all-reduce is
+  amortized away post-warmup).
+* async: ZERO in-step collectives; the background averager's f32 all-reduce
+  (4 B/param every sync_interval) is divided across the steps in one
+  interval.
+
+Writes SCALING_PROJECTION.json and SCALING_PROJECTION.md at the repo root.
+"""
+
+import json
+import math
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BW_CHIP = 90e9        # usable ICI injection bandwidth per chip, B/s
+LAT_HOP = 1e-6        # per-hop ICI latency, s
+OVERLAP_FRAC = 2 / 3  # fraction of the step a collective can hide behind
+POD_SIZE = 256        # one v5e pod; beyond this DCN enters
+DCN_GBPS_PER_HOST = 25e9  # conservative per-host DCN bandwidth, B/s
+STEPS_PER_INTERVAL = 20   # async averager: steps per sync interval (amortization)
+CHIPS_PER_HOST = 8
+
+# Measured single-chip step times (committed artifacts; see BENCH_TPU.json /
+# BENCH_BERT_TPU.json for provenance).  batch is per chip.
+MEASURED = {
+    "vgg16": {
+        "params": 138.36e6,
+        "batch": 32,
+        # img/s/chip measured on v5e (BENCH_TPU.json, 2026-07-29 session)
+        "rate_per_chip": {
+            "gradient_allreduce": 764.0,
+            "bytegrad": 675.0,
+            "decentralized": 662.0,
+            "qadam": 529.0,
+            "low_precision_decentralized": 420.0,
+            "async": 183.1,
+        },
+    },
+    "bert_large_mlm": {
+        "params": 334.09e6,
+        "batch": 32,
+        "rate_per_chip": {"bytegrad": 471.9},  # BENCH_BERT_TPU.json
+    },
+    # No chip measurement exists for the Llama family yet — projected from
+    # the BERT-measured MFU (0.614) applied to the 7B fwd+bwd FLOPs at
+    # seq 2048, batch 1/chip; marked "projected_compute" in the output.
+    "llama_7b": {
+        "params": 6.74e9,
+        "batch": 1,
+        "projected_compute_s": (6 * 6.74e9 * 2048 * 1) / (0.614 * 197e12),
+        "rate_per_chip": {"gradient_allreduce": None},
+    },
+}
+
+
+def torus_dims(n):
+    """Closest-to-square 2D factorization (v5e topology shapes)."""
+    a = int(math.sqrt(n))
+    while n % a:
+        a -= 1
+    return a, n // a
+
+
+def t_collective(kind, bytes_per_chip, n):
+    """Per-chip time of one collective over n chips on the ICI torus."""
+    dx, dy = torus_dims(n)
+    diameter = dx / 2 + dy / 2  # torus wrap-around halves each dim
+    lat = diameter * LAT_HOP
+    if n == 1:
+        return 0.0
+    if kind == "allreduce":
+        return 2 * (n - 1) / n * bytes_per_chip / BW_CHIP + 2 * lat
+    if kind in ("allgather", "alltoall", "reducescatter"):
+        return (n - 1) / n * bytes_per_chip / BW_CHIP + lat
+    if kind == "permute":  # neighbor exchange: one hop, n-independent
+        return bytes_per_chip / BW_CHIP + LAT_HOP
+    raise ValueError(kind)
+
+
+def comm_time(algorithm, params, n, steps_per_interval=STEPS_PER_INTERVAL):
+    """Per-step collective time for one DP algorithm at world size n."""
+    if algorithm == "gradient_allreduce":
+        return t_collective("allreduce", params * 2, n)  # bf16 wire
+    if algorithm in ("bytegrad", "qadam"):
+        return t_collective("alltoall", params * 1, n) + t_collective(
+            "allgather", params * 1, n
+        )
+    if algorithm == "decentralized":
+        return t_collective("permute", params * 2, n)
+    if algorithm == "low_precision_decentralized":
+        return 2 * t_collective("permute", params * 1, n)
+    if algorithm == "async":
+        # background f32 average amortized over the steps in one interval
+        return t_collective("allreduce", params * 4, n) / steps_per_interval
+    raise ValueError(algorithm)
+
+
+def project(model, spec):
+    rows = []
+    for algorithm, rate in spec["rate_per_chip"].items():
+        if rate is not None:
+            t_compute = spec["batch"] / rate
+            basis = "measured_single_chip"
+        else:
+            t_compute = spec["projected_compute_s"]
+            basis = "projected_compute"
+        window = OVERLAP_FRAC * t_compute
+        t8 = None
+        for n in (8, 32, 256, 512):
+            t_comm = comm_time(algorithm, spec["params"], n)
+            if n > POD_SIZE:
+                # multi-pod: DP exchange bytes cross DCN once per step,
+                # shared by the host's chips; async's background f32 average
+                # is amortized over its interval exactly as on ICI
+                wire = spec["params"] * (1 if algorithm in (
+                    "bytegrad", "qadam", "low_precision_decentralized") else 2)
+                t_dcn = wire / (DCN_GBPS_PER_HOST / CHIPS_PER_HOST)
+                if algorithm == "async":
+                    t_dcn = spec["params"] * 4 / (
+                        DCN_GBPS_PER_HOST / CHIPS_PER_HOST) / STEPS_PER_INTERVAL
+                t_comm += t_dcn
+            t_n = t_compute + max(0.0, t_comm - window)
+            if n == 8:
+                t8 = t_n
+            rows.append(
+                {
+                    "model": model,
+                    "algorithm": algorithm,
+                    "n_chips": n,
+                    "basis": basis,
+                    "t_compute_ms": round(t_compute * 1e3, 3),
+                    "t_comm_ms": round(t_comm * 1e3, 3),
+                    "t_step_ms": round(t_n * 1e3, 3),
+                    "exposed_comm_ms": round(max(0.0, t_comm - window) * 1e3, 3),
+                    "efficiency_vs_8": round(t8 / t_n, 4),
+                    "rate_per_chip": round(spec["batch"] / t_n, 1),
+                }
+            )
+    return rows
+
+
+def main():
+    all_rows = []
+    for model, spec in MEASURED.items():
+        all_rows.extend(project(model, spec))
+    out = {
+        "assumptions": {
+            "bw_chip_GBps": BW_CHIP / 1e9,
+            "lat_per_hop_us": LAT_HOP * 1e6,
+            "overlap_window_frac_of_step": OVERLAP_FRAC,
+            "pod_size": POD_SIZE,
+            "dcn_GBps_per_host": DCN_GBPS_PER_HOST / 1e9,
+            "regime": "weak scaling, fixed per-chip batch",
+            "collective_model": "ring/torus: allreduce 2(n-1)/n, "
+            "gather/a2a (n-1)/n, permute 1 hop",
+        },
+        "provenance": {
+            "census": "PERF_AUDIT.json (compiled-HLO wire patterns)",
+            "measured": ["BENCH_TPU.json", "BENCH_BERT_TPU.json"],
+        },
+        "rows": all_rows,
+    }
+    with open(os.path.join(REPO, "SCALING_PROJECTION.json"), "w") as f:
+        json.dump(out, f, indent=1)
+
+    lines = [
+        "# SCALING_PROJECTION — 8→256 chips (projected, falsifiable)",
+        "",
+        "Generated by `ci/scaling_projection.py`; every constant is stated there. "
+        "The projection combines the compiled-HLO collective census "
+        "(PERF_AUDIT.json) with measured single-chip v5e step times "
+        "(BENCH_TPU.json, BENCH_BERT_TPU.json) and an explicit ICI cost model "
+        "(90 GB/s usable per chip, 1 µs/hop, 2D torus, weak scaling, "
+        "collectives overlap with the backward ⅔ of the step). "
+        "A future pod run confirms or refutes it row by row.",
+        "",
+        "Headline: **every DP algorithm projects ≥0.99 efficiency at 256 chips "
+        "within one pod** — the wire bytes per chip are n-independent (ring "
+        "collectives) or single-hop (peer exchanges), and at VGG16/BERT sizes "
+        "they fit inside the overlap window. The first real cliff is multi-pod "
+        "DCN (the 512-chip rows).",
+        "",
+        "| model | algorithm | n | t_step ms | exposed comm ms | eff. vs 8 | rate/chip |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in all_rows:
+        lines.append(
+            f"| {r['model']} | {r['algorithm']} | {r['n_chips']} | "
+            f"{r['t_step_ms']} | {r['exposed_comm_ms']} | "
+            f"{r['efficiency_vs_8']} | {r['rate_per_chip']} |"
+        )
+    lines += [
+        "",
+        "Notes:",
+        "- `basis=projected_compute` rows (Llama-7B) have no chip measurement; "
+        "their compute time is the BERT-measured 0.614 MFU applied to 7B "
+        "fwd+bwd FLOPs (see the script).",
+        "- `async` shows the averager's amortized f32 all-reduce "
+        "(sync_interval of ~20 steps); its in-step collective count is zero "
+        "(PERF_AUDIT.md census).",
+        "- The 512-chip rows add a conservative DCN term (25 GB/s/host ÷ 8 "
+        "chips) with no overlap credit — a worst-case bound, not a prediction "
+        "of the tuned multi-pod schedule.",
+    ]
+    with open(os.path.join(REPO, "SCALING_PROJECTION.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(json.dumps({"rows": len(all_rows), "ok": True}))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,11 @@ cd "$(dirname "$0")/.."
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
 echo "=== tpu_session $(date) ===" | tee -a tpu_session.log
 
+# Step 0: the ci/ scripts import the installed package (no sys.path
+# bootstrap since r4) — make sure it is installed before anything runs.
+python ci/check_packaging.py >> tpu_session.log 2>&1 \
+  || echo "--- check_packaging FAILED (ci steps may not import)" | tee -a tpu_session.log
+
 run() {  # run <name> <timeout_s> <out_or_-> <cmd...>
   local name=$1 cap=$2 out=$3; shift 3
   echo "--- $name ($(date +%H:%M:%S), cap ${cap}s)" | tee -a tpu_session.log
@@ -67,29 +72,37 @@ guard() {  # guard <step args...>: probe (only after a non-zero previous
   run "$@"
 }
 
-# 1. Headline + per-algorithm VGG16 sweep (the round's definition of success).
-#    Internal deadline tracks the outer cap (watchdog = deadline + 60s).
-guard bench 780 BENCH_TPU.json env BENCH_DEADLINE_SEC=700 python bench.py
+# Step order (VERDICT r3 next #3): the artifacts that have NEVER landed run
+# FIRST — the 2026-07-29 session lost exactly its last four steps to a
+# mid-run tunnel drop, and those were the four the round lacked.  The
+# benches (already committed from the 14:01 session) refresh LAST.
 
-# 2. BERT-Large ByteGrad bench.
-guard bench_bert 780 BENCH_BERT_TPU.json env BENCH_DEADLINE_SEC=700 python bench_bert.py
-
-# 3. Pallas kernels through Mosaic (writes PALLAS_TPU.json itself).
+# 1. Pallas kernels through Mosaic (writes PALLAS_TPU.json itself) — the
+#    cheapest never-landed artifact, and the one gating ring-attention's
+#    kernel auto-select.
 guard pallas 600 - python ci/validate_pallas_tpu.py
 
-# 3b. DP scaling sweep — degenerates to width 1 on a single chip; on a pod
-#     slice it produces the BASELINE scaling-efficiency curve.
-guard scaling 600 BENCH_SCALING_TPU.json env BENCH_DEADLINE_SEC=520 python bench_scaling.py
-
-# 4. Autotune closed loop on the real chip (overwrites the CPU-sim record).
+# 2. Autotune closed loop on the real chip (overwrites the CPU-sim record).
 guard autotune 600 - env BAGUA_AUTOTUNE_RUN_TPU=1 python ci/autotune_real_run.py
 
-# 4b. Single-compile invariant on the real chip (writes COMPILE_STABILITY.json).
-guard compile_stability 600 - python ci/compile_stability.py --model vgg16
-
-# 5. The reference's full CI gate (determinism + per-algorithm floors) —
-#    last, so a timeout here never costs the primary artifacts; the compile
-#    cache from step 1 makes it mostly step time.
+# 3. The reference's full CI gate (determinism + per-algorithm floors).
+#    Compile-cache cold here (~2 VGG16 compiles); cap sized for that.
 guard floors_gate 900 - python ci/benchmark_check.py --model vgg16 --tpu-floors
+
+# 4. DP scaling sweep — degenerates to width 1 on a single chip; on a pod
+#    slice it produces the BASELINE scaling-efficiency curve.
+guard scaling 600 BENCH_SCALING_TPU.json env BENCH_DEADLINE_SEC=520 python bench_scaling.py
+
+# 5. Single-compile invariant on the real chip (writes COMPILE_STABILITY.json).
+guard compile_stability 420 - python ci/compile_stability.py --model vgg16
+
+# 6. MoE throughput line (VERDICT r3 next #7 — first MoE chip measurement).
+guard bench_moe 600 BENCH_MOE_TPU.json env BENCH_DEADLINE_SEC=520 python bench_moe.py
+
+# 7. Headline + per-algorithm VGG16 sweep; warm compile cache from step 3.
+guard bench 780 BENCH_TPU.json env BENCH_DEADLINE_SEC=700 python bench.py
+
+# 8. BERT-Large ByteGrad bench.
+guard bench_bert 780 BENCH_BERT_TPU.json env BENCH_DEADLINE_SEC=700 python bench_bert.py
 
 echo "=== tpu_session done $(date) ===" | tee -a tpu_session.log

@@ -29,7 +29,6 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
 
 INTERPRET_SMOKE = False  # set by main() under --interpret
 
