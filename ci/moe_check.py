@@ -14,7 +14,9 @@ reference's pattern:
 3. expert parity — expert parameters stay different across ranks (they are
    per-rank state), while every other parameter stays bitwise equal.
 
-Run:  JAX_PLATFORMS=cpu python ci/moe_check.py
+Run:  python ci/moe_check.py   (the package must be installed — run
+``python ci/check_packaging.py`` once, or ``pip install -e . --no-deps``;
+the platform is forced to the CPU sim in-process)
 """
 
 import os

@@ -37,9 +37,10 @@ Cost model (stated so it can be refuted measurement-by-measurement):
       t(n) = t_compute + max(0, t_comm(n) - OVERLAP_WINDOW * t_compute)
 * Efficiency(n) = t(8) / t(n)  (8 chips = the smallest pod-slice baseline,
   matching BASELINE.json's 8->256 framing).  n stays within one 256-chip
-  v5e pod — no DCN term enters; a multi-pod projection would add a DCN
-  bottleneck term  bytes / (HOSTS_PER_POD * DCN_GBPS)  which we also emit
-  for 512 chips as a sanity extension.
+  v5e pod — no DCN term enters; the 512-chip sanity extension adds a
+  per-chip DCN bottleneck term  wire_bytes / (DCN_GBPS_PER_HOST /
+  CHIPS_PER_HOST)  — each host's DCN bandwidth is shared by its 8 chips'
+  exchange bytes, with no overlap credit (a worst-case bound).
 
 Wire bytes per algorithm (per step, per chip, from the census patterns —
 PERF_AUDIT.md maps each to its compiled HLO):

@@ -96,6 +96,10 @@ guard scaling 600 BENCH_SCALING_TPU.json env BENCH_DEADLINE_SEC=520 python bench
 # 5. Single-compile invariant on the real chip (writes COMPILE_STABILITY.json).
 guard compile_stability 420 - python ci/compile_stability.py --model vgg16
 
+# 5b. VGG16 MFU attribution: xprof trace + differential timings (writes
+#     TRACE_VGG16.json) — the round's highest-leverage evidence.
+guard trace_vgg16 600 - python ci/trace_vgg16.py
+
 # 6. MoE throughput line (VERDICT r3 next #7 — first MoE chip measurement).
 guard bench_moe 600 BENCH_MOE_TPU.json env BENCH_DEADLINE_SEC=520 python bench_moe.py
 
