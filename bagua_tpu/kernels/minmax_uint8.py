@@ -185,6 +185,7 @@ def get_compressors(use_pallas=None):
     """
     from bagua_tpu.kernels._config import resolve_use_pallas
 
-    if resolve_use_pallas(use_pallas, "BAGUA_PALLAS_COMPRESSION"):
+    if resolve_use_pallas(use_pallas, "BAGUA_PALLAS_COMPRESSION",
+                          kernel="minmax_uint8"):
         return compress_minmax_uint8_pallas, decompress_minmax_uint8_pallas
     return compress_minmax_uint8, decompress_minmax_uint8

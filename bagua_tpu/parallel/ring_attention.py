@@ -49,7 +49,8 @@ def _axis_and_size(axis_name):
 def _pick_block_fn(use_pallas, interpret):
     from bagua_tpu.kernels._config import resolve_use_pallas
 
-    if resolve_use_pallas(use_pallas, "BAGUA_PALLAS_ATTENTION"):
+    if resolve_use_pallas(use_pallas, "BAGUA_PALLAS_ATTENTION",
+                          kernel="flash_attention_block"):
         return lambda qf, k, v, mask: block_attention_pallas(
             qf, k, v, mask, interpret=interpret
         )
