@@ -119,7 +119,8 @@ class BenchHarness:
         # one real init attempt regardless of remaining budget).
         deadline = self.t0 + float(os.environ.get("BENCH_DEADLINE_SEC", "420"))
         result = tpu_probe.wait_healthy(
-            attempts=4, cap_s=50.0, note=self.note, deadline=deadline - 90.0
+            attempts=4, cap_s=50.0, note=self.note, deadline=deadline - 90.0,
+            relay=relay,
         )
         if result["ok"]:
             self.note("preflight: probe healthy — proceeding to backend init")

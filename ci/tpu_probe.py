@@ -105,10 +105,14 @@ def probe_once(cap_s: float = 60.0, note=lambda m: None) -> dict:
     t0 = time.perf_counter()
     env = dict(os.environ)
     env.pop("BENCH_FORCE_CPU", None)  # the probe must test the real backend
+    # start_new_session: the child gets its own process group so a helper
+    # grandchild (PJRT plugin forks have been seen) can be killed too —
+    # otherwise it inherits the stdout pipe and the final communicate()
+    # blocks forever waiting for EOF.
     proc = subprocess.Popen(
         [sys.executable, "-c", _CHILD],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-        text=True, env=env,
+        text=True, env=env, start_new_session=True,
     )
     last_phase = "spawn"
     try:
@@ -124,8 +128,14 @@ def probe_once(cap_s: float = 60.0, note=lambda m: None) -> dict:
         try:
             out, _ = proc.communicate(timeout=10.0)
         except subprocess.TimeoutExpired:
-            proc.kill()
-            out, _ = proc.communicate()
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            try:
+                out, _ = proc.communicate(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                out = ""  # pipe still held open somewhere; give up on it
         for line in (out or "").splitlines():
             if line.startswith("phase:"):
                 last_phase = line[len("phase:"):].strip()
@@ -136,7 +146,8 @@ def probe_once(cap_s: float = 60.0, note=lambda m: None) -> dict:
 
 
 def wait_healthy(attempts: int = 3, cap_s: float = 60.0,
-                 note=lambda m: None, deadline: float | None = None) -> dict:
+                 note=lambda m: None, deadline: float | None = None,
+                 relay: str | None = None) -> dict:
     """Retry ``probe_once`` up to ``attempts`` times (fresh process each —
     a fresh process re-dials the stuck handshake).  Returns a summary dict;
     ``ok`` True on the first healthy attempt.
@@ -144,10 +155,12 @@ def wait_healthy(attempts: int = 3, cap_s: float = 60.0,
     ``deadline`` (``time.perf_counter()`` value) additionally stops the
     retry loop once the budget is spent — but the FIRST probe always runs:
     the relay classification is a heuristic and must never veto an actual
-    init attempt on its own.
+    init attempt on its own.  Callers that already classified the relay
+    pass it via ``relay`` to skip the duplicate ~6s socket hold.
     """
     tried = []
-    relay = relay_diagnosis()
+    if relay is None:
+        relay = relay_diagnosis()
     note(f"relay {RELAY_HOST}:{RELAY_PORT} -> {relay}")
     for i in range(attempts):
         if tried and deadline is not None and time.perf_counter() >= deadline:
@@ -183,9 +196,22 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--attempts", type=int, default=3)
     ap.add_argument("--cap", type=float, default=60.0)
+    ap.add_argument("--relay-gate", action="store_true",
+                    help="fail fast (~5s, no chip claim) when the relay "
+                         "shows a dead signature — heuristic: callers "
+                         "should fall back to a gate-less probe before "
+                         "concluding the tunnel is down")
     args = ap.parse_args()
     note = lambda m: print(f"[tpu_probe] {m}", file=sys.stderr, flush=True)  # noqa: E731
-    result = wait_healthy(args.attempts, args.cap, note=note)
+    relay = relay_diagnosis()
+    if args.relay_gate and relay != "accepted-held":
+        result = {"ok": False, "attempts": [], "relay": relay,
+                  "last_phase": "relay-gate",
+                  "summary": f"relay-gate: {relay} (no init attempted)"}
+        note(result["summary"])
+        print(json.dumps(result), flush=True)
+        return 1
+    result = wait_healthy(args.attempts, args.cap, note=note, relay=relay)
     result["summary"] = ("healthy" if result["ok"] else failure_summary(result))
     print(json.dumps(result), flush=True)
     return 0 if result["ok"] else 1

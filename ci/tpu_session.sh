@@ -1,19 +1,34 @@
 #!/bin/bash
-# The moment-the-chip-is-up checklist (VERDICT r2 items 1/2/4/8).
+# The moment-the-chip-is-up checklist (VERDICT r2 1/2/4/8, r4 next #4).
 #
-# Runs every TPU-dependent artifact in priority order, tolerating individual
-# failures, with wall-clock caps so a flaky tunnel still yields partial
-# evidence.  Results land at the repo root:
+# Runs every TPU-dependent artifact in priority order — never-landed
+# artifacts FIRST — tolerating individual failures, with per-step caps and
+# a global session budget so a flaky tunnel still yields partial evidence.
+# Results land at the repo root:
+#   PALLAS_TPU.json        - Mosaic kernel validation + microbench
+#   AUTOTUNE_RUN.json      - autotune closed loop on the real chip
+#   (floors gate)          - PASS/FAIL lines per algorithm in tpu_session.log
+#   BENCH_SCALING_TPU.json - DP scaling sweep (trivial on one chip)
+#   TRACE_VGG16.json       - on-chip MFU attribution trace
+#   BENCH_MOE_TPU.json     - MoE expert-parallel throughput
 #   BENCH_TPU.json         - bench.py JSON lines (per-algorithm VGG16 sweep)
 #   BENCH_BERT_TPU.json    - bench_bert.py JSON lines
-#   PALLAS_TPU.json        - Mosaic kernel validation + microbench
-#   BENCH_SCALING_TPU.json - DP scaling sweep (trivial on one chip)
-#   AUTOTUNE_RUN.json      - autotune closed loop on the real chip
-#   tpu_session.log       - everything, incl. the final reference CI gate
-#                           (benchmark_check --tpu-floors: determinism +
-#                           per-algorithm floors; PASS/FAIL lines per algo)
+#   tpu_session.log        - everything
+#
+# Hard-learned rules encoded here:
+#   * kill with SIGINT first (timeout --signal=INT --kill-after): a
+#     SIGKILLed client can leak its chip claim and wedge the pool for
+#     every later step (suspected cause of the 14:08 UTC r4 session loss);
+#   * probe the tunnel with ci/tpu_probe.py relay diagnosis (~5s) before
+#     paying a 60s bounded init probe;
+#   * skip steps whose artifact is already fresh (< FRESH_S old) and
+#     healthy, so a re-entrant session (the background watcher may fire
+#     this script more than once) spends its budget on what's missing.
 #
 # Usage: bash ci/tpu_session.sh   (assumes the axon tunnel is reachable)
+#   SESSION_BUDGET_S  total wall budget (default 5400); steps are skipped
+#                     when the remaining budget can't cover their cap
+#   FRESH_S           artifact freshness window (default 21600 = 6h)
 
 set -u
 cd "$(dirname "$0")/.."
@@ -21,19 +36,26 @@ cd "$(dirname "$0")/.."
 # benchmark_check default to DIFFERENT dirs otherwise — the floors gate
 # depends on reusing step 1's VGG16 compilations).
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
-echo "=== tpu_session $(date) ===" | tee -a tpu_session.log
+SESSION_BUDGET_S=${SESSION_BUDGET_S:-5400}
+FRESH_S=${FRESH_S:-21600}
+T0=$(date +%s)
+echo "=== tpu_session $(date) (budget ${SESSION_BUDGET_S}s) ===" | tee -a tpu_session.log
 
 # Step 0: the ci/ scripts import the installed package (no sys.path
 # bootstrap since r4) — make sure it is installed before anything runs.
 python ci/check_packaging.py >> tpu_session.log 2>&1 \
   || echo "--- check_packaging FAILED (ci steps may not import)" | tee -a tpu_session.log
 
+remaining() { echo $(( SESSION_BUDGET_S - ($(date +%s) - T0) )); }
+
 run() {  # run <name> <timeout_s> <out_or_-> <cmd...>
   local name=$1 cap=$2 out=$3; shift 3
   echo "--- $name ($(date +%H:%M:%S), cap ${cap}s)" | tee -a tpu_session.log
   local tmp
   tmp=$(mktemp)
-  timeout "$cap" "$@" > "$tmp" 2>> tpu_session.log
+  # SIGINT first so the axon client's advisory claim release runs; SIGKILL
+  # only 20s later if the process ignores it.
+  timeout --signal=INT --kill-after=20 "$cap" "$@" > "$tmp" 2>> tpu_session.log
   local rc=$?
   cat "$tmp" >> tpu_session.log
   if [ "$out" != "-" ] && grep '^{' "$tmp" | grep -qv '"error"'; then
@@ -47,66 +69,109 @@ run() {  # run <name> <timeout_s> <out_or_-> <cmd...>
   LAST_RC=$rc
 }
 
-probe() {  # fast tunnel check: a dead tunnel must cost ~75s, not each
-           # remaining step's full cap (the 2026-07-29 session lost ~45 min
-           # to four hung steps after the tunnel dropped mid-run)
-  timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1
+probe_fast() {  # ~5s relay-signature gate, no chip claim (heuristic:
+                # never the sole verdict — probe_full is the ground truth)
+  timeout 30 python ci/tpu_probe.py --relay-gate --attempts 1 --cap 60 >/dev/null 2>&1
+}
+
+probe_full() {  # bounded real init attempt; outer timeout is belt-and-
+                # braces in case the probe's own caps are defeated
+  timeout 150 python ci/tpu_probe.py --attempts 1 --cap 60 >/dev/null 2>&1
+}
+
+fresh() {  # fresh <artifact>: 0 when the file exists, is < FRESH_S old,
+           # and holds at least one healthy (non-error) JSON line
+           # (*.ok marker files only need the age check)
+  local f=$1
+  [ -f "$f" ] || return 1
+  local age=$(( $(date +%s) - $(stat -c %Y "$f") ))
+  [ "$age" -lt "$FRESH_S" ] || return 1
+  case "$f" in *.ok) return 0 ;; esac
+  grep '^{' "$f" 2>/dev/null | grep -qv '"error"'
 }
 
 LAST_RC=1  # probe before the first step too (the session may start blind)
 TUNNEL_DOWN=0
-guard() {  # guard <step args...>: probe (only after a non-zero previous
-           # step, with one retry — a single hiccup must not drop an
-           # artifact), then run; once both probes fail the verdict is
-           # cached so a dead tunnel costs one ~150s check, not 150s per
-           # remaining step
+guard() {  # guard <name> <cap> <out> <cmd...>: freshness skip, budget
+           # check, then probe (only after a non-zero previous step —
+           # relay-gate fast reject first, full init probe as the ground
+           # truth); once both probes fail the verdict is cached so a dead
+           # tunnel costs one check, not one per remaining step.
+           #
+           # <out> forms:  -        no artifact, no redirect
+           #               FILE     healthy JSON lines redirected to FILE
+           #               @FILE    the step writes FILE itself (freshness
+           #                        check only; @FILE.ok markers are
+           #                        touched by guard on rc=0 for steps
+           #                        with no natural artifact)
+  local name=$1 cap=$2 out=$3; shift 3
+  local fresh_target="${out#@}"
+  if [ "$out" != "-" ] && fresh "$fresh_target"; then
+    echo "--- $name SKIPPED: $fresh_target fresh ($(date +%H:%M:%S))" | tee -a tpu_session.log
+    return
+  fi
+  if [ "$(remaining)" -lt "$cap" ]; then
+    echo "--- $name SKIPPED: budget exhausted ($(remaining)s < ${cap}s)" | tee -a tpu_session.log
+    return
+  fi
   if [ "$TUNNEL_DOWN" -eq 1 ]; then
-    echo "--- $1 SKIPPED: tunnel down ($(date +%H:%M:%S))" | tee -a tpu_session.log
+    echo "--- $name SKIPPED: tunnel down ($(date +%H:%M:%S))" | tee -a tpu_session.log
     return
   fi
-  if [ "$LAST_RC" -ne 0 ] && ! probe && ! probe; then
+  if [ "$LAST_RC" -ne 0 ] && ! probe_fast && ! probe_full; then
     TUNNEL_DOWN=1
-    echo "--- $1 SKIPPED: tunnel down ($(date +%H:%M:%S))" | tee -a tpu_session.log
+    echo "--- $name SKIPPED: tunnel down ($(date +%H:%M:%S))" | tee -a tpu_session.log
     return
   fi
-  run "$@"
+  case "$out" in
+    -|@*) run "$name" "$cap" - "$@" ;;
+    *)    run "$name" "$cap" "$out" "$@" ;;
+  esac
+  case "$out" in
+    @*.ok) [ "$LAST_RC" -eq 0 ] && date > "$fresh_target" ;;
+  esac
 }
 
-# Step order (VERDICT r3 next #3): the artifacts that have NEVER landed run
-# FIRST — the 2026-07-29 session lost exactly its last four steps to a
-# mid-run tunnel drop, and those were the four the round lacked.  The
-# benches (already committed from the 14:01 session) refresh LAST.
+# Step order (VERDICT r3 #3, r4 #4): artifacts that have NEVER landed run
+# FIRST; the benches (already committed from the r4 14:01 UTC session)
+# refresh LAST.  Caps sum to 5280s of a 5400s default budget; the global
+# budget check keeps the tail from overrunning regardless.
 
 # 1. Pallas kernels through Mosaic (writes PALLAS_TPU.json itself) — the
-#    cheapest never-landed artifact, and the one gating ring-attention's
-#    kernel auto-select.
-guard pallas 600 - python ci/validate_pallas_tpu.py
+#    cheapest never-landed artifact, and the one gating the compressor /
+#    flash-attention kernel auto-select (VERDICT r4 #5).
+guard pallas 600 @PALLAS_TPU.json python ci/validate_pallas_tpu.py
 
-# 2. Autotune closed loop on the real chip (overwrites the CPU-sim record).
-guard autotune 600 - env BAGUA_AUTOTUNE_RUN_TPU=1 python ci/autotune_real_run.py
+# 2. Autotune closed loop on the real chip (overwrites the CPU-sim record;
+#    freshness keys on the TPU marker so the committed CPU record doesn't
+#    mask the missing chip run).
+guard autotune 600 @AUTOTUNE_TPU.ok env BAGUA_AUTOTUNE_RUN_TPU=1 python ci/autotune_real_run.py
 
 # 3. The reference's full CI gate (determinism + per-algorithm floors).
 #    Compile-cache cold here (~2 VGG16 compiles); cap sized for that.
-guard floors_gate 900 - python ci/benchmark_check.py --model vgg16 --tpu-floors
+guard floors_gate 900 @FLOORS_TPU.ok python ci/benchmark_check.py --model vgg16 --tpu-floors
 
-# 4. DP scaling sweep — degenerates to width 1 on a single chip; on a pod
+# 4. VGG16 MFU attribution: xprof trace + differential timings at real
+#    shapes (writes TRACE_VGG16.json) — the round's highest-leverage
+#    evidence (VERDICT r4 #2).  Freshness keys on a marker: the committed
+#    TRACE_VGG16.json is the r4 CPU toy trace, which must not mask this.
+guard trace_vgg16 600 @TRACE_VGG16_TPU.ok python ci/trace_vgg16.py
+
+# 5. DP scaling sweep — degenerates to width 1 on a single chip; on a pod
 #    slice it produces the BASELINE scaling-efficiency curve.
-guard scaling 600 BENCH_SCALING_TPU.json env BENCH_DEADLINE_SEC=520 python bench_scaling.py
+guard scaling 480 BENCH_SCALING_TPU.json env BENCH_DEADLINE_SEC=400 python bench_scaling.py
 
-# 5. Single-compile invariant on the real chip (writes COMPILE_STABILITY.json).
-guard compile_stability 420 - python ci/compile_stability.py --model vgg16
+# 6. MoE throughput line (VERDICT r3 #7 — first MoE chip measurement).
+guard bench_moe 540 BENCH_MOE_TPU.json env BENCH_DEADLINE_SEC=460 python bench_moe.py
 
-# 5b. VGG16 MFU attribution: xprof trace + differential timings (writes
-#     TRACE_VGG16.json) — the round's highest-leverage evidence.
-guard trace_vgg16 600 - python ci/trace_vgg16.py
+# 7. Single-compile invariant on the real chip (writes COMPILE_STABILITY.json;
+#    marker-keyed — the committed record is from the CPU sim).
+guard compile_stability 300 @COMPILE_STABILITY_TPU.ok python ci/compile_stability.py --model vgg16
 
-# 6. MoE throughput line (VERDICT r3 next #7 — first MoE chip measurement).
-guard bench_moe 600 BENCH_MOE_TPU.json env BENCH_DEADLINE_SEC=520 python bench_moe.py
+# 8. Headline + per-algorithm VGG16 sweep; warm compile cache from step 3.
+guard bench 660 BENCH_TPU.json env BENCH_DEADLINE_SEC=580 python bench.py
 
-# 7. Headline + per-algorithm VGG16 sweep; warm compile cache from step 3.
-guard bench 780 BENCH_TPU.json env BENCH_DEADLINE_SEC=700 python bench.py
+# 9. BERT-Large ByteGrad bench.
+guard bench_bert 600 BENCH_BERT_TPU.json env BENCH_DEADLINE_SEC=520 python bench_bert.py
 
-# 8. BERT-Large ByteGrad bench.
-guard bench_bert 780 BENCH_BERT_TPU.json env BENCH_DEADLINE_SEC=700 python bench_bert.py
-
-echo "=== tpu_session done $(date) ===" | tee -a tpu_session.log
+echo "=== tpu_session done $(date) ($(($(date +%s) - T0))s elapsed) ===" | tee -a tpu_session.log

@@ -1,0 +1,59 @@
+#!/bin/bash
+# Tunnel watcher: poll the axon relay until it recovers, then immediately
+# run the TPU session checklist (ci/tpu_session.sh).
+#
+# The tunnel dies and recovers on its own schedule (r4: alive at 14:01 UTC,
+# dead from ~14:08 onward — including the driver's 20:06 bench run).  The
+# build loop can't sit blocked on it, so this script is started in the
+# background at round start.  It exits once every session artifact is
+# fresh (the session's own freshness skips cover partial landings), and a
+# flock guarantees a single instance — two concurrent sessions would
+# contend for the one-chip pool and interleave artifact writes.
+#
+# Usage: bash ci/tpu_watch.sh [poll_interval_s] >> tpu_watch.log 2>&1 &
+
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL=${1:-480}
+LOCK=/tmp/bagua_tpu_watch.lock
+
+exec 9> "$LOCK"
+if ! flock -n 9; then
+  echo "tpu_watch already running (lock $LOCK) — exiting"
+  exit 0
+fi
+
+# The artifacts the session produces, in its own freshness terms.  When all
+# are fresh there is nothing left to claim the chip for.
+ARTIFACTS=(PALLAS_TPU.json AUTOTUNE_TPU.ok FLOORS_TPU.ok TRACE_VGG16_TPU.ok
+           BENCH_SCALING_TPU.json BENCH_MOE_TPU.json COMPILE_STABILITY_TPU.ok
+           BENCH_TPU.json BENCH_BERT_TPU.json)
+FRESH_S=${FRESH_S:-21600}
+
+all_fresh() {
+  local f age
+  for f in "${ARTIFACTS[@]}"; do
+    [ -f "$f" ] || return 1
+    age=$(( $(date +%s) - $(stat -c %Y "$f") ))
+    [ "$age" -lt "$FRESH_S" ] || return 1
+  done
+  return 0
+}
+
+echo "=== tpu_watch start $(date) (interval ${INTERVAL}s) ==="
+while true; do
+  if all_fresh; then
+    echo "=== all artifacts fresh $(date) — watcher converged, exiting ==="
+    exit 0
+  fi
+  # Relay-gate first: ~5s and no chip claim while the tunnel is down.
+  if timeout 30 python ci/tpu_probe.py --relay-gate --attempts 1 --cap 60 2>/dev/null | grep -q '"ok": true' \
+     || timeout 150 python ci/tpu_probe.py --attempts 1 --cap 60 2>/dev/null | grep -q '"ok": true'; then
+    echo "=== tunnel HEALTHY $(date) — running session ==="
+    bash ci/tpu_session.sh
+    echo "=== session pass done $(date); continuing watch ==="
+  else
+    echo "tunnel still down $(date)"
+  fi
+  sleep "$INTERVAL"
+done
