@@ -19,9 +19,15 @@ class CacheLoader:
         store: Optional[Store] = None,
         **kwargs,
     ):
-        """``backend`` ∈ {"memory", "file", "shm"} or pass an explicit
-        ``store``.  ``writer_buffer_size`` batches that many pending writes
-        before flushing (reference ``cache_loader.py:75-140``)."""
+        """``backend`` ∈ {"memory", "file", "shm", "rendezvous"} or pass an
+        explicit ``store``.  ``writer_buffer_size`` batches that many pending
+        writes before flushing (reference ``cache_loader.py:75-140``).
+
+        ``backend="rendezvous"`` is the cross-host path (the analog of the
+        reference's redis-backed cluster cache): pass
+        ``endpoints=["host1:29400", "host2:29400", ...]`` (one rendezvous
+        blob server per node, same order on every worker) and optionally
+        ``bootstrap=True`` to start this host's server if absent."""
         self.dataset_name = dataset_name
         if store is not None:
             self.store = store
@@ -35,6 +41,12 @@ class CacheLoader:
             from bagua_tpu.contrib.shm_store import ShmStore
 
             self.store = ShmStore(**kwargs)
+        elif backend == "rendezvous":
+            from bagua_tpu.contrib.rendezvous_store import (
+                make_rendezvous_cluster_store,
+            )
+
+            self.store = make_rendezvous_cluster_store(**kwargs)
         else:
             raise ValueError(f"unknown cache backend {backend!r}")
         self.writer_buffer_size = writer_buffer_size

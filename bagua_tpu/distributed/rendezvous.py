@@ -36,8 +36,10 @@ keeps the zero-dependency rule).
 
 import json
 import logging
+import os
 import threading
 import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
@@ -78,6 +80,8 @@ class RendezvousState:
         max_nodes: int = 1 << 30,
         settle_s: float = 1.0,
         ttl_s: float = 30.0,
+        max_blob_bytes: int = 1 << 30,
+        blob_token: Optional[str] = None,
     ):
         self.min_nodes = min_nodes
         self.max_nodes = max_nodes
@@ -86,6 +90,23 @@ class RendezvousState:
         self._lock = threading.Lock()
         self._members: Dict[int, _Member] = {}
         self._kv: Dict[str, str] = {}
+        # Binary blob tier backing contrib.rendezvous_store.RendezvousStore
+        # (the cross-host CacheLoader path).  LRU-bounded, mirroring the
+        # reference's redis bootstrap with ``maxmemory`` +
+        # ``allkeys-lru`` (redis_store.py:46-137): when the cap is hit, the
+        # least-recently-touched cache entries are evicted rather than the
+        # writer failing.
+        self._blobs: "OrderedDict[str, bytes]" = OrderedDict()
+        self._blob_bytes = 0
+        self.max_blob_bytes = max_blob_bytes
+        # Shared-secret gate for the blob routes (values are pickles; see
+        # _Handler._blob_authorized).  Default comes from the environment so
+        # launcher-started stores pick it up without plumbing.
+        self.blob_token = (
+            blob_token
+            if blob_token is not None
+            else os.environ.get("BAGUA_STORE_TOKEN")
+        )
         self.generation = 0
         self.epoch = 0
         self._settled: Optional[dict] = None  # published assignment
@@ -204,6 +225,35 @@ class RendezvousState:
         with self._lock:
             return self._kv.get(key)
 
+    # -- blob tier (binary values; LRU-bounded) ------------------------------
+
+    def blob_set(self, key: str, data: bytes) -> None:
+        with self._lock:
+            old = self._blobs.pop(key, None)
+            if old is not None:
+                self._blob_bytes -= len(old)
+            self._blobs[key] = data
+            self._blob_bytes += len(data)
+            while self._blob_bytes > self.max_blob_bytes and len(self._blobs) > 1:
+                _, evicted = self._blobs.popitem(last=False)
+                self._blob_bytes -= len(evicted)
+
+    def blob_get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            data = self._blobs.get(key)
+            if data is not None:
+                self._blobs.move_to_end(key)  # LRU touch
+            return data
+
+    def blob_count(self) -> int:
+        with self._lock:
+            return len(self._blobs)
+
+    def blob_clear(self) -> None:
+        with self._lock:
+            self._blobs.clear()
+            self._blob_bytes = 0
+
     # -- internals (lock held) ----------------------------------------------
 
     def _mark_dirty_locked(self):
@@ -263,9 +313,27 @@ class RendezvousState:
 
 class _Handler(BaseHTTPRequestHandler):
     state: RendezvousState  # set on the subclass by start_rendezvous_server
+    # HTTP/1.1 so keep-alive works (every reply carries Content-Length);
+    # RendezvousStore relies on persistent connections — under the 1.0
+    # default, http.client tears the connection down after each request
+    # and the per-sample TCP handshake dominates small cached items.
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, *a):  # silence default stderr access log
         pass
+
+    def _blob_authorized(self) -> bool:
+        """Blob routes carry arbitrary pickles — when the state has a
+        ``blob_token``, require the matching header.  pickle.loads on the
+        reader side means an attacker who can PUT blobs can execute code on
+        every worker; membership routes carry no payloads and stay open."""
+        token = getattr(self.state, "blob_token", None)
+        if not token:
+            return True
+        if self.headers.get("X-Bagua-Store-Token") == token:
+            return True
+        self._reply({"error": "missing or bad X-Bagua-Store-Token"}, 403)
+        return False
 
     def _reply(self, payload: dict, code: int = 200):
         body = json.dumps(payload).encode()
@@ -279,6 +347,11 @@ class _Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", "0"))
         return json.loads(self.rfile.read(n) or b"{}")
 
+    def _blob_key(self) -> str:
+        from urllib.parse import unquote
+
+        return unquote(self.path[len("/rdzv/blob/"):])
+
     def do_GET(self):
         if self.path.startswith("/rdzv/assignment"):
             self._reply(self.state.assignment())
@@ -288,6 +361,44 @@ class _Handler(BaseHTTPRequestHandler):
             key = unquote(self.path[len("/rdzv/kv/"):])
             value = self.state.kv_get(key)
             self._reply({"key": key, "value": value, "found": value is not None})
+        elif self.path == "/rdzv/blobs":
+            if not self._blob_authorized():
+                return
+            self._reply({"count": self.state.blob_count()})
+        elif self.path.startswith("/rdzv/blob/"):
+            if not self._blob_authorized():
+                return
+            data = self.state.blob_get(self._blob_key())
+            if data is None:
+                self._reply({"error": "not found"}, 404)
+            else:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+        else:
+            self._reply({"error": "not found"}, 404)
+
+    def do_PUT(self):
+        # Drain the body before any reply: under HTTP/1.1 keep-alive an
+        # unread request body desyncs the connection for the next request.
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if self.path.startswith("/rdzv/blob/"):
+            if not self._blob_authorized():
+                return
+            self.state.blob_set(self._blob_key(), body)
+            self._reply({"ok": True})
+        else:
+            self._reply({"error": "not found"}, 404)
+
+    def do_DELETE(self):
+        if self.path == "/rdzv/blobs":
+            if not self._blob_authorized():
+                return
+            self.state.blob_clear()
+            self._reply({"ok": True})
         else:
             self._reply({"error": "not found"}, 404)
 
