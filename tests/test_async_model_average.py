@@ -7,6 +7,7 @@ one averaging cycle by hand (``_cycle``) with the timer parked; a separate
 timed test lets the thread run for real.
 """
 
+import pytest
 import time
 
 import jax
@@ -68,6 +69,7 @@ def max_spread(state):
     return max(np.abs(l.max(axis=0) - l.min(axis=0)).max() for l in leaves)
 
 
+@pytest.mark.slow
 def test_one_cycle_converges_ranks_to_mean(group):
     """One averaging cycle + fold collapses divergent ranks to their mean
     (lr=0 isolates the averaging path from training updates)."""
@@ -171,11 +173,39 @@ def test_stale_generation_delta_is_dropped(group):
         state, _ = ddp.train_step(state, (jnp.asarray(xs[1]), jnp.asarray(ys[1])))
         assert ddp.impl.folds_applied == 1 and ranks_close(state)
         # inject the stale-generation delta as if a racing cycle published it
+        # (ready flag too — the step path only looks at landed deltas)
         ddp.impl._pending = stale
+        ddp.impl._pending_ready = True
         state, _ = ddp.train_step(state, (jnp.asarray(xs[2]), jnp.asarray(ys[2])))
         assert ddp.impl.folds_applied == 1, "stale delta was folded"
         assert ddp.impl._pending is None, "stale delta was not dropped"
         assert ranks_close(state), "stale fold re-inverted the rank spread"
+    finally:
+        ddp.shutdown()
+
+
+def test_step_path_makes_no_backend_queries(group):
+    """The fold path must read only the plain ``_pending_ready`` flag — a
+    per-leaf ``is_ready()`` probe on the step path cost ~130 ms/step over
+    the tunneled PJRT client (r4 chip session: async 183 img/s vs 764 for
+    gradient_allreduce on the same model)."""
+
+    class ExplodingLeaf:
+        def is_ready(self):
+            raise AssertionError("step path queried the backend")
+
+    base = init_mlp(jax.random.PRNGKey(5), [DIM_IN, 8, DIM_OUT])
+    xs, ys = make_data(1, seed=6)
+    ddp = make_ddp(base, lr=0.0, group=group)
+    state = ddp.init(base)
+    try:
+        state, _ = ddp.train_step(state, (jnp.asarray(xs[0]), jnp.asarray(ys[0])))
+        # An in-flight (not-ready) delta must be left pending without a probe.
+        ddp.impl._pending = (ddp.impl._fold_generation, ExplodingLeaf())
+        ddp.impl._pending_ready = False
+        state, _ = ddp.train_step(state, (jnp.asarray(xs[0]), jnp.asarray(ys[0])))
+        assert ddp.impl._pending is not None  # still pending, never probed
+        ddp.impl._pending = None
     finally:
         ddp.shutdown()
 

@@ -113,6 +113,7 @@ def test_execution_order_reorders_buckets(server):
     assert ordered == ["t2", "t0", "t1"]
 
 
+@pytest.mark.slow
 def test_autotune_session_rebuckets(group):
     """End-to-end: DDP + AutotuneSession against a live service re-buckets."""
     import jax
@@ -158,6 +159,7 @@ def test_autotune_session_rebuckets(group):
         srv.shutdown()
 
 
+@pytest.mark.slow
 def test_profile_bucket_order_measures_backward_depth(group):
     """Measured bucket costs reflect real backward depth: the first layer's
     gradients (deepest in backprop) cost more than the last layer's — the
@@ -194,6 +196,7 @@ def test_profile_bucket_order_measures_backward_depth(group):
     assert times[bucket_of("layer0")] > times[bucket_of("layer4")], times
 
 
+@pytest.mark.slow
 def test_session_profile_reports_measured_order(group):
     """profile_and_report ships measured spans; the service's learned partial
     order puts early-ready (late-layer) tensors first even though they were

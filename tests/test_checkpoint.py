@@ -20,6 +20,7 @@ def tree_equal(a, b):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+@pytest.mark.slow
 def test_tracker_and_roundtrip(tmp_path):
     tree = {
         "layer": {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones((3,))},
@@ -42,6 +43,7 @@ def test_tracker_and_roundtrip(tmp_path):
     tree_equal(tree, restored100)
 
 
+@pytest.mark.slow
 def test_resume_training_identical(group, tmp_path):
     """Save mid-training, reload into a fresh engine, and check the next step
     is bitwise-identical to the uninterrupted run."""

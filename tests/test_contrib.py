@@ -267,6 +267,7 @@ def _shm_child(name, q):
         q.put(("err", repr(e)))
 
 
+@pytest.mark.slow
 def test_shm_store_cross_process():
     pytest.importorskip("ctypes")
     from bagua_tpu.contrib.shm_store import ShmStore

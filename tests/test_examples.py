@@ -13,6 +13,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # real-data example runs + driver dryruns (subprocess, minutes)
+
 from helpers import REPO_ROOT
 
 EXAMPLES = os.path.join(REPO_ROOT, "examples")

@@ -52,6 +52,7 @@ def test_rope_properties():
     assert score(3, 1) != pytest.approx(score(3, 2), rel=1e-3)
 
 
+@pytest.mark.slow
 def test_gqa_matches_mha_with_repeated_kv():
     """num_kv_heads=1 with K/V weights replicated per head must equal the MHA
     model whose per-head K/V weights are identical."""
@@ -77,6 +78,7 @@ def test_gqa_matches_mha_with_repeated_kv():
     )
 
 
+@pytest.mark.slow
 def test_forward_and_loss_finite():
     cfg = llama_test_config()
     model = LlamaModel(cfg)
@@ -148,6 +150,7 @@ def _shard_llama_for_tp(params0, heads, kv_heads, tp):
     return [slice_leaf_for_rank(r) for r in range(tp)]
 
 
+@pytest.mark.slow
 def test_tp_sp_consistency():
     """tp=2 x sp=2 (zigzag) on a 2x2 submesh matches the single-device model
     with assembled weights — TP pairing, ring attention, RoPE global
@@ -190,6 +193,7 @@ def test_tp_sp_consistency():
     np.testing.assert_allclose(got_z[:, inv], ref, rtol=5e-3, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_ddp_training_integration(group):
     """3 gradient_allreduce steps on the 8-device group: finite decreasing
     loss and bitwise replica equality."""

@@ -12,6 +12,7 @@ import bagua_tpu
 from bagua_tpu import communication as C
 
 
+@pytest.mark.slow
 def test_resnet50_forward_and_train_step(group):
     from bagua_tpu.algorithms import GradientAllReduceAlgorithm
     from bagua_tpu.ddp import DistributedDataParallel
@@ -34,6 +35,7 @@ def test_resnet50_forward_and_train_step(group):
     assert np.isfinite(np.asarray(losses)).all()
 
 
+@pytest.mark.slow
 def test_gpt_causal_sp_matches_local():
     """GPT with sp=4 ring attention == the same model run locally on the full
     sequence (identical params), including tied-LM-head logits."""
@@ -146,6 +148,7 @@ def test_alltoall_v(group):
         np.testing.assert_array_equal(rc[r], np.full(n, send_counts[r]))
 
 
+@pytest.mark.slow
 def test_pinned_weight_norm_regression(group):
     """Exact weight-norm pins per algorithm (seed 13, 8 steps) — the analog
     of the reference's Lightning-strategy regression values
@@ -254,6 +257,7 @@ def test_trainer_profile_once_across_epochs(group, tmp_path):
         assert int(state.step[0]) == 6
 
 
+@pytest.mark.slow
 def test_gpt_causal_sp_zigzag_matches_local():
     """GPT with the zigzag SP layout == the local model on the full sequence:
     feed zigzag-permuted ids, invert the output permutation."""

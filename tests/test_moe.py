@@ -161,6 +161,7 @@ def test_router_jitter_and_eval_capacity(group):
         bad.init(jax.random.PRNGKey(0), tokens)
 
 
+@pytest.mark.slow
 def test_moe_used_token_end_to_end(group):
     """used_token flows MoE -> ExpertParallelFFN -> Router: masked tokens
     produce zero MoE output."""
@@ -206,6 +207,7 @@ def moe_loss_fn(model):
     return loss_fn
 
 
+@pytest.mark.slow
 def test_ep_matches_local_when_experts_tiled(group):
     """With identical (tiled) expert params, the distributed EP dispatch must
     produce the same per-rank output as running all experts locally."""
@@ -248,6 +250,7 @@ def test_ep_matches_local_when_experts_tiled(group):
 
 
 @pytest.mark.parametrize("k", [1, 2])
+@pytest.mark.slow
 def test_moe_ddp_training(group, k):
     """End-to-end: DDP + MoE with experts excluded from DP; expert params
     diverge across ranks, non-expert params stay bitwise equal
@@ -302,6 +305,7 @@ def test_moe_ddp_training(group, k):
                 np.testing.assert_array_equal(arr[0], arr[r], err_msg=name)
 
 
+@pytest.mark.slow
 def test_split_moe_params():
     model = MoEModel(num_experts=4, ep_size=1)
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((4, MODEL_DIM)))["params"]

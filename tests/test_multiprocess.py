@@ -14,6 +14,8 @@ import textwrap
 
 import pytest
 
+pytestmark = pytest.mark.slow  # spawns OS-process gangs per test
+
 from helpers import free_port, spawn_and_collect, worker_env
 
 WORKER = textwrap.dedent(

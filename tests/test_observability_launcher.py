@@ -8,6 +8,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow  # elastic launcher gangs (subprocess)
+
 from bagua_tpu.observability import SpanRecorder, StepTimer, Watchdog
 from bagua_tpu.utils import SpeedMeter
 

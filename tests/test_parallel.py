@@ -142,6 +142,7 @@ def test_tp_axis_mismatch_raises():
         )(x)
 
 
+@pytest.mark.slow
 def test_bert_forward_shapes_and_parallel_consistency():
     """BERT with tp=2 x sp=2 on a 2x2 submesh matches the single-device
     model with assembled weights — end-to-end integration of TP + SP."""
@@ -277,6 +278,7 @@ def test_ring_attention_pallas_matches_oracle():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gpt_4d_parallel_example():
     """The dp x pp x tp x sp composition example trains: one jitted step over
     a 4-axis mesh (pipeline stages, tensor-parallel blocks, ring attention,

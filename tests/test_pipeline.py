@@ -203,6 +203,7 @@ def test_1f1b_matches_sequential_oracle(pp_mesh):
             )
 
 
+@pytest.mark.slow
 def test_1f1b_memory_bounded_vs_gpipe(pp_mesh):
     """The point of 1F1B+remat: peak temp memory stays flat as n_micro grows,
     while GPipe-autodiff's residual stack grows with it."""

@@ -283,6 +283,7 @@ def _launch_node(tmp_path, script, node_rank, ports):
     )
 
 
+@pytest.mark.slow
 def test_cross_host_elastic_scale_down_then_up(tmp_path):
     """VERDICT r2 #3: two launcher processes (fake hosts) scale 2 -> 1 -> 2
     with checkpointed state carried across every membership change."""

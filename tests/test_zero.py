@@ -87,6 +87,7 @@ def test_zero_wrong_shard_count(group):
         )
 
 
+@pytest.mark.slow
 def test_zero2_matches_unsharded_adam(group):
     """ZeRO-2 (reduce-scattered raw gradients + sharded state + "none"
     algorithm) produces the same trajectory as allreduce + unsharded Adam."""
@@ -125,6 +126,7 @@ def test_zero2_matches_unsharded_adam(group):
             np.testing.assert_array_equal(arr[0], arr[r])
 
 
+@pytest.mark.slow
 def test_fsdp_matches_ddp_and_shards_memory(group):
     """The pjit FSDP path (params sharded at rest) matches the explicit DDP
     engine's trajectory, and the HLO carries the ZeRO-3 wire pattern
@@ -168,6 +170,7 @@ def test_fsdp_matches_ddp_and_shards_memory(group):
     assert "all-gather" in hlo or "all-reduce" in hlo
 
 
+@pytest.mark.slow
 def test_fsdp_hlo_and_memory_assertions(group):
     """VERDICT r2 #9: the compiled FSDP step carries gather-at-use and a
     gradient-reduction collective, and per-device live parameter+optimizer
@@ -201,6 +204,7 @@ def test_fsdp_hlo_and_memory_assertions(group):
     assert per_device < total / 4 + batch_bytes, (per_device, total)
 
 
+@pytest.mark.slow
 def test_fsdp_mixed_precision_policy(group):
     """compute_dtype=bfloat16: the compiled step's dot ops run in bf16, the
     master params/opt state stay f32, and training still converges."""
@@ -232,6 +236,7 @@ def test_fsdp_mixed_precision_policy(group):
     ), "no bf16 dot_general in the mixed-precision step"
 
 
+@pytest.mark.slow
 def test_fsdp_scanned_layers(group):
     """scan_layers over a stacked block: matches the unrolled loop, and under
     FSDP shardings the stack's layer axis is the sharded one (per-layer
