@@ -26,20 +26,35 @@ def _truthy(v: str) -> bool:
 
 
 def _artifact():
-    """The committed hardware validation record, or None."""
-    path = os.path.join(
+    """The hardware validation record, or None.
+
+    Two locations, repo-root first: ``PALLAS_TPU.json`` at the repo root is
+    the committed artifact a checkout carries (and what
+    ``ci/validate_pallas_tpu.py`` just wrote during a chip session — it must
+    win over a stale packaged copy).  The packaged copy
+    (``bagua_tpu/kernels/_pallas_validation.json``, shipped as package data)
+    is the fallback for non-editable wheel installs, where no repo root
+    exists; the validator refreshes both.
+    """
+    repo_root = os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
         "PALLAS_TPU.json",
     )
-    if path not in _ARTIFACT_CACHE:
+    packaged = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "_pallas_validation.json"
+    )
+    key = (repo_root, packaged)
+    if key not in _ARTIFACT_CACHE:
         rec = None
-        try:
-            with open(path) as f:
-                rec = json.load(f)
-        except Exception:
-            pass
-        _ARTIFACT_CACHE[path] = rec
-    return _ARTIFACT_CACHE[path]
+        for path in (repo_root, packaged):
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+                break
+            except Exception:
+                continue
+        _ARTIFACT_CACHE[key] = rec
+    return _ARTIFACT_CACHE[key]
 
 
 def validated_on_hardware(kernel: str) -> bool:
@@ -61,7 +76,10 @@ def validated_on_hardware(kernel: str) -> bool:
     return False
 
 
-def resolve_use_pallas(explicit, env_var: str, kernel: str = None) -> bool:
+def resolve_use_pallas(explicit, env_var: str, kernel: str) -> bool:
+    """``kernel`` is required: every kernel earns default-on status through
+    its own ``PALLAS_TPU.json`` record (ADVICE r4: a ``None`` escape hatch
+    would let new call sites silently revert to hope-based auto-ON)."""
     if explicit is not None:
         return bool(explicit)
     env = os.environ.get(env_var)
@@ -71,6 +89,4 @@ def resolve_use_pallas(explicit, env_var: str, kernel: str = None) -> bool:
 
     if jax.default_backend() in ("cpu",):
         return False
-    if kernel is None:
-        return True  # legacy callers: preserve backend auto-detection
     return validated_on_hardware(kernel)

@@ -178,10 +178,12 @@ def get_compressors(use_pallas=None):
 
     Selection precedence (``kernels._config.resolve_use_pallas``): an
     explicit ``use_pallas`` argument wins; else the env var
-    ``BAGUA_PALLAS_COMPRESSION`` (operator kill switch); else backend auto
-    (Pallas on TPU).  The Pallas entry points themselves still fall back to
-    jnp per-call when a chunk doesn't satisfy TPU tiling — so every
-    configuration is semantically identical.
+    ``BAGUA_PALLAS_COMPRESSION`` (operator kill switch); else auto-selection
+    — which requires the ``PALLAS_TPU.json`` hardware-validation record to
+    show this kernel Mosaic-compiling, numerics-exact, AND faster than the
+    jnp path on a real chip (no record -> jnp).  The Pallas entry points
+    themselves still fall back to jnp per-call when a chunk doesn't satisfy
+    TPU tiling — so every configuration is semantically identical.
     """
     from bagua_tpu.kernels._config import resolve_use_pallas
 

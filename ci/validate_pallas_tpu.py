@@ -169,6 +169,18 @@ def main():
     print(json.dumps(result, indent=1))
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
+    if not args.interpret:
+        # Refresh the packaged copy too (package data), so non-editable
+        # wheel installs carry the evidence that gates kernel auto-select
+        # (ADVICE r4: the repo-root artifact is invisible to them).
+        packaged = os.path.join(
+            REPO, "bagua_tpu", "kernels", "_pallas_validation.json"
+        )
+        try:
+            with open(packaged, "w") as f:
+                json.dump(result, f, indent=1)
+        except OSError as e:
+            print(f"warning: could not refresh {packaged}: {e}", file=sys.stderr)
     sys.exit(0 if result["all_ok"] else 1)
 
 
