@@ -79,6 +79,15 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
         self._latest = None  # rank-stacked params of the newest dispatched step
         self._published_step = 0
         self._pending = None  # (generation, delta tree) awaiting fold
+        # Set by the averager thread once the pending delta's buffers have
+        # landed; read by host_pre_dispatch.  The r4 chip session showed the
+        # per-step per-leaf ``is_ready()`` probes were NOT free on the
+        # tunneled PJRT backend (async stayed at 183 img/s with ~130 ms of
+        # per-step host overhead both before and after the non-blocking-
+        # averager fix) — so the step path now reads this plain bool and
+        # performs ZERO backend queries; readiness detection lives on the
+        # averager thread (``_watch_pending``).  Guarded by _pending_lock.
+        self._pending_ready = False
         # Double-fold guard.  A delta is ``mean(snap) - snap``; applying it is
         # only correct if no OTHER fold landed between its snapshot and its
         # consumption — an intervening fold's correction would be re-applied
@@ -170,11 +179,20 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
         if jax.process_count() > 1 and self._published_step < self.warmup_steps:
             return
         with self._cycle_lock:
+            with self._pending_lock:
+                # An unconsumed delta (still in flight, or landed but no
+                # step has folded it yet) makes a new dispatch pure waste —
+                # the average would be displaced unconsumed.  Folded into
+                # the negotiated ``ready`` flag rather than an early return
+                # so the multi-process collective sequence stays in
+                # lockstep (every rank still negotiates every cycle).
+                slot_free = self._pending is None
             ready = (
                 self._status == "running"
                 and not stop_event.is_set()
                 and self._latest is not None
                 and self._published_step >= self.warmup_steps
+                and slot_free
             )
             if not self._negotiate(ready):
                 return
@@ -196,8 +214,12 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
                     if self._pending is not None:
                         # An unconsumed previous delta is displaced — drain
                         # it below so no untracked program outlives the cycle.
+                        # (Unreachable in the background mode now that
+                        # ``slot_free`` gates the dispatch; kept for manual
+                        # _cycle() callers.)
                         self._orphans.append(self._pending[1])
                     self._pending = (gen, delta)
+                    self._pending_ready = bool(wait)  # wait=True: landed
                 else:
                     # Publish suppressed (abort or a racing fold): the
                     # orphaned program still drains below, so abort()'s
@@ -217,6 +239,54 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
             except Exception:
                 pass  # a failed orphan is quiet by definition
 
+    def _watch_pending(self, stop_event):
+        """Mark the pending delta ready once its buffers land — on THIS
+        thread, so the training-step path never queries the backend.
+
+        Polls one representative leaf: all outputs of a single executable
+        become ready together when it completes, so one probe stands for the
+        tree (and one probe per poll is what keeps this cheap over a
+        tunneled PJRT client).  Runs lock-free between probes; bails when
+        the pending slot changes under it (fold consumed it / abort)."""
+        poll_s = min(0.01, self.sync_interval_ms / 1000.0 / 4)
+        warned = False
+        t0 = None
+        while not stop_event.is_set():
+            with self._pending_lock:
+                if self._pending is None or self._pending_ready:
+                    return
+                gen, delta = self._pending
+            leaf = next(
+                (l for l in jax.tree.leaves(delta) if hasattr(l, "is_ready")),
+                None,
+            )
+            try:
+                landed = leaf is None or leaf.is_ready()
+            except Exception as e:
+                with self._pending_lock:
+                    if self._pending is not None and self._pending[0] == gen:
+                        self._orphans.append(self._pending[1])
+                        self._pending = None
+                        self._pending_ready = False
+                self._log_fold_failure("pending delta unusable", e)
+                return
+            if landed:
+                with self._pending_lock:
+                    if self._pending is not None and self._pending[0] == gen:
+                        self._pending_ready = True
+                return
+            import time as _time
+
+            if t0 is None:
+                t0 = _time.monotonic()
+            elif not warned and _time.monotonic() - t0 > 30.0:
+                warned = True
+                logging.getLogger(__name__).warning(
+                    "async model average: delta in flight >30s — device "
+                    "stalled? averaging is paused until it lands"
+                )
+            stop_event.wait(poll_s)
+
     def _run(self, stop_event, wake):
         while True:
             wake.wait(self.sync_interval_ms / 1000.0)
@@ -224,6 +294,7 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
             if stop_event.is_set():
                 return
             self._cycle(stop_event, wait=False)
+            self._watch_pending(stop_event)
 
     def _ensure_thread(self):
         if self._shutdown:
@@ -251,8 +322,17 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
         )
 
     def host_pre_dispatch(self, state):
+        """Fold a landed average into the params about to be dispatched.
+
+        ZERO backend queries on this path: readiness is a plain bool set by
+        the averager thread (``_watch_pending``).  The r4 chip session
+        established that per-leaf ``is_ready()`` probes here cost ~130 ms
+        per step over the tunneled PJRT client — 4x the whole VGG16 step —
+        while a delta still in flight simply stays pending for a later step
+        (the training loop never waits on the averager, the reference's
+        defining property, async_model_average.py:208-230)."""
         with self._pending_lock:
-            if self._pending is None:
+            if self._pending is None or not self._pending_ready:
                 return state
             gen, delta = self._pending
             if gen != self._fold_generation:
@@ -262,28 +342,10 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
                 # abort may wait on it); a fresh delta comes next cycle.
                 self._orphans.append(delta)
                 self._pending = None
-                return state
-            try:
-                if not all(
-                    leaf.is_ready() for leaf in jax.tree.leaves(delta)
-                    if hasattr(leaf, "is_ready")
-                ):
-                    # In flight: leave it pending for a later step — the
-                    # training loop must never wait on the averager (the
-                    # reference's defining property,
-                    # async_model_average.py:208-230).
-                    return state
-            except Exception as e:
-                # Host-visible delta failure (e.g. deleted/donated buffers):
-                # degrade to a skipped average, never kill training.  A
-                # DEVICE-side async failure is NOT catchable here — it
-                # surfaces at the training loop's next await, like any other
-                # algorithm's collective failure would.
-                self._log_fold_failure("pending delta unusable", e)
-                self._orphans.append(delta)
-                self._pending = None
+                self._pending_ready = False
                 return state
             self._pending = None
+            self._pending_ready = False
         try:
             folded = self._jit_fold(state.params, delta)
         except Exception as e:
@@ -320,6 +382,7 @@ class AsyncModelAverageAlgorithmImpl(AlgorithmImpl):
                 if self._pending is not None:
                     self._orphans.append(self._pending[1])
                     self._pending = None
+                self._pending_ready = False
             self._drain_orphans()  # device-side drain, failures included
 
     def resume(self):

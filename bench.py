@@ -88,6 +88,9 @@ def _bench_algorithm(name, make_ddp, params, batch, deadline, max_iters=12,
         state, losses = ddp.train_step(state, (x, y))
         jax.block_until_ready(losses)
         HARNESS.note(f"{name}: compile + warmup done (2 steps)")
+        # Reset attribution so the snapshot covers ONLY the timed window —
+        # the warmup steps' compile seconds would otherwise swamp it.
+        ddp.host_overhead_snapshot(reset=True)
         t0 = time.perf_counter()
         state, losses = ddp.train_step(state, (x, y))
         jax.block_until_ready(losses)
@@ -101,6 +104,10 @@ def _bench_algorithm(name, make_ddp, params, batch, deadline, max_iters=12,
         jax.block_until_ready(losses)
         elapsed = time.perf_counter() - t0
         HARNESS.note(f"{name}: {n_iters} steps in {elapsed:.2f}s")
+        # Host-side attribution (VERDICT r4 #3): where each step's wall time
+        # went OUTSIDE device execution — pre-dispatch fold, lock waits,
+        # enqueue, post-dispatch.  The async 183 img/s mystery lived here.
+        HARNESS.note(f"{name}: host overhead {ddp.host_overhead_snapshot()}")
         return x.shape[0] * n_iters / elapsed / ddp.group.size
     except Exception as e:  # noqa: BLE001 — per-algorithm isolation
         HARNESS.note(f"{name}: FAILED {type(e).__name__}: {e}")
