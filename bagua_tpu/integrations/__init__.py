@@ -1,0 +1,10 @@
+"""External-framework integrations.
+
+The reference ships a pytorch-lightning strategy
+(``bagua/pytorch_lightning/__init__.py``, tested at
+``tests/pytorch_lightning/test_bagua_strategy.py:30-60``) so users of an
+external training framework can adopt its algorithms without rewriting
+their loop.  The TPU-native analog integrates with the Flax ecosystem:
+:mod:`bagua_tpu.integrations.flax` adapts a
+``flax.training.train_state.TrainState`` to the bagua engine and back.
+"""

@@ -1,0 +1,158 @@
+"""Flax TrainState integration — the Lightning-strategy analog.
+
+The reference lets a pytorch-lightning user switch a working ``Trainer``
+onto bagua by passing ``strategy=BaguaStrategy(...)``, with exact-parity
+tests against manual training (``tests/pytorch_lightning/
+test_bagua_strategy.py:30-60``).  The Flax ecosystem's equivalent of the
+Lightning loop is a ``flax.training.train_state.TrainState`` threaded
+through a jitted step; this module adapts one to the bagua engine in three
+calls:
+
+.. code-block:: python
+
+    from flax.training import train_state
+    import optax
+    from bagua_tpu.integrations.flax import FlaxBaguaStrategy
+
+    fstate = train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.adam(1e-3))
+
+    strategy = FlaxBaguaStrategy(loss_fn, algorithm="bytegrad")
+    bstate = strategy.init_from_flax(fstate)        # enter the DP engine
+    for batch in data:                              # global batches
+        bstate, losses = strategy.train_step(bstate, batch)
+    fstate = strategy.to_flax(bstate, fstate)       # back to flax land
+
+``loss_fn(params, batch) -> scalar`` is the same contract as
+:class:`~bagua_tpu.ddp.DistributedDataParallel` (build it from
+``model.apply`` exactly as in a plain Flax loop).
+
+Design note — why the hot loop stays on the bagua state: the engine's
+state is rank-stacked (leading axis = DP rank) and donated every step;
+converting to/from the flax layout per step would add a full parameter
+copy each direction.  ``to_flax`` is the checkpoint/eval/export boundary:
+it materializes rank 0's view (for the decentralized family, ranks
+legitimately differ mid-training — rank 0 is that family's convention for
+"the" model, matching the reference's checkpointing) and syncs ``step``
+and ``opt_state`` so orbax/flax checkpoints, eval loops, and metric code
+keep working unchanged.
+"""
+
+from typing import Callable, Optional, Union
+
+import jax
+
+from bagua_tpu.algorithms import build_algorithm
+from bagua_tpu.algorithms.base import Algorithm
+from bagua_tpu.ddp import DistributedDataParallel, TrainState
+
+# Module-level so repeated to_flax calls hit the jit cache (an eval loop
+# may cross this boundary every few hundred steps).
+_row0 = jax.jit(lambda t: jax.tree.map(lambda x: x[0], t))
+
+
+class FlaxBaguaStrategy:
+    """Adapt a ``flax.training.train_state.TrainState`` to the bagua engine.
+
+    Args:
+        loss_fn: ``loss_fn(params, batch) -> scalar`` on the local batch,
+            where ``params`` has the flax state's ``params`` structure.
+        algorithm: an algorithm name (``"gradient_allreduce"``, ``"bytegrad"``,
+            ...) or an :class:`~bagua_tpu.algorithms.base.Algorithm`.
+        process_group: defaults to the global group.
+        dp_filter: as for :class:`DistributedDataParallel`.
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable,
+        algorithm: Union[str, Algorithm] = "gradient_allreduce",
+        process_group=None,
+        dp_filter=None,
+        **algorithm_kwargs,
+    ):
+        if isinstance(algorithm, str):
+            algorithm = build_algorithm(algorithm, **algorithm_kwargs)
+        elif algorithm_kwargs:
+            raise ValueError("algorithm_kwargs require an algorithm name")
+        self._loss_fn = loss_fn
+        self._algorithm = algorithm
+        self._group = process_group
+        self._dp_filter = dp_filter
+        self.ddp: Optional[DistributedDataParallel] = None
+
+    # -- flax -> bagua -------------------------------------------------------
+
+    def init_from_flax(self, flax_state) -> TrainState:
+        """Enter the DP engine from a flax TrainState.
+
+        The flax state supplies the optimizer (``tx``) and initial params;
+        the returned rank-stacked :class:`~bagua_tpu.ddp.TrainState` is what
+        ``train_step`` consumes.  A non-zero ``flax_state.step`` is
+        preserved (resuming mid-run keeps warmup/variant schedules aligned).
+        """
+        if self.ddp is not None:
+            # Re-entering with a new flax state: tear down the previous
+            # engine first or its background machinery (the async averager
+            # thread) outlives any reachable shutdown() path.
+            self.ddp.shutdown()
+        self.ddp = DistributedDataParallel(
+            self._loss_fn,
+            flax_state.tx,
+            self._algorithm,
+            process_group=self._group,
+            dp_filter=self._dp_filter,
+        )
+        bundled = getattr(self.ddp.impl, "optimizer", None)
+        if bundled is not None and hasattr(bundled, "to_optax"):
+            # QAdam transforms gradients into the full Adam update direction
+            # and requires its own engine-side rule (q_adam.py:23-30);
+            # applying the flax state's tx on top would train with updates
+            # matching neither QAdam nor the user's optimizer.
+            self.ddp.shutdown()
+            self.ddp = None
+            raise ValueError(
+                "this algorithm bundles its own optimizer (e.g. qadam) and "
+                "cannot run under a flax TrainState's tx — use "
+                "DistributedDataParallel(loss_fn, None, algorithm) directly"
+            )
+        bstate = self.ddp.init(flax_state.params)
+        step = int(jax.device_get(flax_state.step))
+        if step:
+            bstate = bstate._replace(step=bstate.step + step)
+        return bstate
+
+    def train_step(self, bstate: TrainState, batch):
+        """One DP step; ``batch`` leaves carry the global batch dim (divisible
+        by the group size).  Returns ``(new_bstate, per_rank_losses)``."""
+        if self.ddp is None:
+            raise RuntimeError("call init_from_flax first")
+        return self.ddp.train_step(bstate, batch)
+
+    # -- bagua -> flax -------------------------------------------------------
+
+    def to_flax(self, bstate: TrainState, flax_state):
+        """Materialize the flax view of the engine state (rank 0's replica),
+        with ``step`` and ``opt_state`` synced — the checkpoint/eval/export
+        boundary.  ``flax_state`` supplies the target structure (apply_fn,
+        tx are carried over unchanged)."""
+        step_arr = bstate.step
+        if isinstance(step_arr, jax.Array) and not step_arr.is_fully_addressable:
+            # Multi-host group: rank 0's slice may live on another process;
+            # read whichever shard this process holds (all ranks agree on
+            # the step counter) — same handling as ddp.train_step's seed.
+            import jax.numpy as jnp
+
+            local = step_arr.addressable_shards[0].data
+            step = int(jnp.reshape(local, (-1,))[0])
+        else:
+            step = int(jax.device_get(step_arr)[0])
+        return flax_state.replace(
+            params=_row0(bstate.params),
+            opt_state=_row0(bstate.opt_state),
+            step=step,
+        )
+
+    def shutdown(self):
+        if self.ddp is not None:
+            self.ddp.shutdown()
