@@ -137,6 +137,66 @@ def main():
     # forward only
     fwd = jax.jit(lambda p, x: model.apply({"params": p}, x))
     result["forward_ms"] = round(timed(fwd, params, x) * 1e3, 3)
+
+    # Per-stage forward attribution: each VGG conv stage (and the classifier)
+    # timed in isolation on inputs of its real shape.  Independent of xprof —
+    # the tunneled backend's profiler RPC has never been exercised, and this
+    # breakdown alone localizes the MFU gap to a stage (e.g. the 3-channel
+    # first conv's MXU underutilization vs the big 512-channel stages).
+    import flax.linen as nn
+    from bagua_tpu.models.vgg import VGG16_CFG
+
+    stages, cur = [], []
+    for v in VGG16_CFG:
+        if v == "M":
+            stages.append(cur + ["M"])
+            cur = []
+        else:
+            cur.append(v)
+    per_stage = []
+    h = args.image_size
+    c = 3
+    flops_per_img_total = 0.0
+    for i, stage_cfg in enumerate(stages):
+
+        class Stage(nn.Module):
+            cfg: tuple
+
+            @nn.compact
+            def __call__(self, s):
+                for u in self.cfg:
+                    if u == "M":
+                        s = nn.max_pool(s, (2, 2), strides=(2, 2))
+                    else:
+                        s = nn.Conv(int(u), (3, 3), padding=1,
+                                    dtype=jnp.bfloat16)(s)
+                        s = nn.relu(s)
+                return s
+
+        stage = Stage(cfg=tuple(stage_cfg))
+        sx = jnp.asarray(
+            rng.rand(args.batch, h, h, c).astype(np.float32), jnp.bfloat16
+        )
+        sp = stage.init(jax.random.PRNGKey(i), sx)
+        sfwd = jax.jit(lambda p, s, stage=stage: stage.apply(p, s))
+        t_ms = timed(sfwd, sp, sx) * 1e3
+        gflop = 0.0
+        cc = c
+        for u in stage_cfg:
+            if u != "M":
+                gflop += 2 * h * h * int(u) * cc * 9 / 1e9
+                cc = int(u)
+        gflop *= args.batch
+        flops_per_img_total += gflop
+        per_stage.append({
+            "stage": i + 1, "cfg": stage_cfg, "in_hw": h, "in_ch": c,
+            "time_ms": round(t_ms, 3), "gflop": round(gflop, 2),
+            "tflops": round(gflop / t_ms, 2),
+        })
+        c = cc
+        h //= 2
+    result["forward_stage_breakdown"] = per_stage
+    result["stage_sum_ms"] = round(sum(s["time_ms"] for s in per_stage), 3)
     # forward + backward (no optimizer, no restack)
     grad = jax.jit(lambda p, b: jax.value_and_grad(loss_fn)(p, b))
     result["fwd_bwd_ms"] = round(timed(grad, params, (x, y)) * 1e3, 3)
@@ -177,9 +237,11 @@ def main():
     finally:
         ddp.shutdown()
 
-    print(json.dumps(result, indent=1)[:4000])
+    # Write the artifact BEFORE printing: a closed stdout (session cap, head)
+    # must not cost the measurement.
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
+    print(json.dumps(result, indent=1)[:4000])
 
 
 if __name__ == "__main__":

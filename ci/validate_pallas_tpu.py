@@ -166,9 +166,11 @@ def main():
         "kernels": report,
         "all_ok": all(e["ok"] for e in report),
     }
-    print(json.dumps(result, indent=1))
+    # Artifact first, stdout second: a closed pipe or session cap must not
+    # cost the measurement.
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
+    print(json.dumps(result, indent=1))
     if not args.interpret:
         # Refresh the packaged copy too (package data), so non-editable
         # wheel installs carry the evidence that gates kernel auto-select
