@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Llama-family pretraining throughput per chip (tokens/s + MFU).
+
+The VGG16/BERT benches mirror the reference's CI workloads; this adds the
+LLM-pretraining headline the reference never had (SCALING_PROJECTION's
+Llama row has been compute-projected until a chip measurement exists —
+``ci/scaling_projection.py`` marks it ``projected_compute``).  Model: a
+~550M-param Llama shape (GQA 12q/4kv, head_dim 128 — MXU-native) that fits
+one v5e chip with f32 SGD state at seq 1024, batch 4/chip, bf16 compute,
+gradient_allreduce DP.
+
+MFU uses the standard 6·N·T estimate (attention FLOPs excluded), peak
+197 bf16 TFLOP/s (v5e).
+
+Emission protocol shared with bench.py (``_bench_common``).  CPU smoke:
+``BENCH_FORCE_CPU=1 BENCH_LLAMA_SMALL=1 python bench_llama.py``.
+"""
+
+import os
+import time
+
+from _bench_common import BenchHarness
+
+HARNESS = BenchHarness(
+    "llama_tokens_per_sec_per_chip", "tokens/s/chip",
+    recorded_artifact="BENCH_LLAMA_TPU.json",
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+PEAK_BF16_TFLOPS = {"tpu": 197.0, "axon": 197.0}
+SEQ = 1024
+PER_CHIP_BATCH = 4
+
+
+def main():
+    import bagua_tpu
+    from bagua_tpu.algorithms import build_algorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.models.llama import (
+        LlamaConfig,
+        LlamaModel,
+        llama_loss_fn,
+        llama_test_config,
+    )
+
+    deadline = HARNESS.t0 + float(os.environ.get("BENCH_DEADLINE_SEC", "420"))
+    HARNESS.note(f"jax ready: {len(jax.devices())} {jax.devices()[0].platform} device(s)")
+    group = bagua_tpu.init_process_group()
+    n = group.size
+
+    small = bool(os.environ.get("BENCH_LLAMA_SMALL"))
+    if small:
+        cfg = llama_test_config(compute_dtype=jnp.bfloat16)
+        seq, per_chip_batch = 32, 2
+    else:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, num_layers=16, num_heads=12,
+            num_kv_heads=4, intermediate_size=4096,
+            max_position_embeddings=SEQ, compute_dtype=jnp.bfloat16,
+        )
+        seq, per_chip_batch = SEQ, PER_CHIP_BATCH
+
+    model = LlamaModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, seq), jnp.int32)
+    )["params"]
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    HARNESS.note(f"model initialized: {n_params / 1e6:.1f}M params")
+
+    ddp = DistributedDataParallel(
+        llama_loss_fn(model), optax.sgd(3e-4, momentum=0.9),
+        build_algorithm("gradient_allreduce"), process_group=group,
+    )
+    state = ddp.init(params)
+    rng = np.random.RandomState(0)
+    bs = per_chip_batch * n
+    # lm_loss_fn's batch is the token ids themselves (next-token targets are
+    # the shifted ids, models/gpt.py:135-139)
+    batch = jnp.asarray(rng.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
+
+    def _emit(tokens_per_sec, provisional=False):
+        extra = {"vs_baseline": None, "params_m": round(n_params / 1e6, 1)}
+        if small:
+            extra["config"] = "SMOKE (test-config shapes)"
+        else:
+            extra["config"] = (
+                f"llama {n_params/1e6:.0f}M GQA12q/4kv seq{seq} "
+                f"batch{per_chip_batch}/chip gradient_allreduce bf16"
+            )
+            peak = PEAK_BF16_TFLOPS.get(jax.devices()[0].platform)
+            if peak:
+                gflop_per_token = 6 * n_params / 1e9
+                extra["mfu"] = round(
+                    tokens_per_sec * gflop_per_token / (peak * 1e3), 3
+                )
+        HARNESS.emit(tokens_per_sec, provisional=provisional, extra=extra)
+
+    for i in range(2):  # compile + steady-state executable (see bench.py)
+        state, losses = ddp.train_step(state, batch)
+        jax.block_until_ready(losses)
+    HARNESS.note("compile + warmup done (2 steps)")
+    ddp.host_overhead_snapshot(reset=True)  # attribution covers the timed window only
+
+    t0 = time.perf_counter()
+    state, losses = ddp.train_step(state, batch)
+    jax.block_until_ready(losses)
+    _emit(bs * seq / (time.perf_counter() - t0) / n, provisional=True)
+
+    n_iters = 1
+    while n_iters < 12 and time.perf_counter() < deadline:
+        state, losses = ddp.train_step(state, batch)
+        n_iters += 1
+    jax.block_until_ready(losses)
+    elapsed = time.perf_counter() - t0
+    HARNESS.note(f"{n_iters} steps in {elapsed:.2f}s; "
+                 f"host overhead {ddp.host_overhead_snapshot()}")
+    ddp.shutdown()
+    _emit(bs * seq * n_iters / elapsed / n)
+
+
+if __name__ == "__main__":
+    HARNESS.guard(main)

@@ -36,7 +36,7 @@ cd "$(dirname "$0")/.."
 # benchmark_check default to DIFFERENT dirs otherwise — the floors gate
 # depends on reusing step 1's VGG16 compilations).
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
-SESSION_BUDGET_S=${SESSION_BUDGET_S:-5400}
+SESSION_BUDGET_S=${SESSION_BUDGET_S:-6600}
 FRESH_S=${FRESH_S:-21600}
 T0=$(date +%s)
 echo "=== tpu_session $(date) (budget ${SESSION_BUDGET_S}s) ===" | tee -a tpu_session.log
@@ -134,7 +134,7 @@ guard() {  # guard <name> <cap> <out> <cmd...>: freshness skip, budget
 
 # Step order (VERDICT r3 #3, r4 #4): artifacts that have NEVER landed run
 # FIRST; the benches (already committed from the r4 14:01 UTC session)
-# refresh LAST.  Caps sum to 5280s of a 5400s default budget; the global
+# refresh LAST.  Caps sum to 5820s of a 6600s default budget; the global
 # budget check keeps the tail from overrunning regardless.
 
 # 1. Pallas kernels through Mosaic (writes PALLAS_TPU.json itself) — the
@@ -173,5 +173,9 @@ guard bench 660 BENCH_TPU.json env BENCH_DEADLINE_SEC=580 python bench.py
 
 # 9. BERT-Large ByteGrad bench.
 guard bench_bert 600 BENCH_BERT_TPU.json env BENCH_DEADLINE_SEC=520 python bench_bert.py
+
+# 10. Llama ~550M pretraining tokens/s + MFU — first Llama-family chip
+#     measurement (converts SCALING_PROJECTION's projected_compute row).
+guard bench_llama 540 BENCH_LLAMA_TPU.json env BENCH_DEADLINE_SEC=460 python bench_llama.py
 
 echo "=== tpu_session done $(date) ($(($(date +%s) - T0))s elapsed) ===" | tee -a tpu_session.log

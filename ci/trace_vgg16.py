@@ -138,8 +138,10 @@ def main():
     fwd = jax.jit(lambda p, x: model.apply({"params": p}, x))
     result["forward_ms"] = round(timed(fwd, params, x) * 1e3, 3)
 
-    # Per-stage forward attribution: each VGG conv stage (and the classifier)
-    # timed in isolation on inputs of its real shape.  Independent of xprof —
+    # Per-stage forward attribution: each VGG conv stage timed in isolation
+    # on inputs of its real shape (plus the FC classifier as its own entry,
+    # so forward_ms - stage_sum_ms leaves only fusion/dispatch residue).
+    # Independent of xprof —
     # the tunneled backend's profiler RPC has never been exercised, and this
     # breakdown alone localizes the MFU gap to a stage (e.g. the 3-channel
     # first conv's MXU underutilization vs the big 512-channel stages).
@@ -156,7 +158,6 @@ def main():
     per_stage = []
     h = args.image_size
     c = 3
-    flops_per_img_total = 0.0
     for i, stage_cfg in enumerate(stages):
 
         class Stage(nn.Module):
@@ -187,7 +188,6 @@ def main():
                 gflop += 2 * h * h * int(u) * cc * 9 / 1e9
                 cc = int(u)
         gflop *= args.batch
-        flops_per_img_total += gflop
         per_stage.append({
             "stage": i + 1, "cfg": stage_cfg, "in_hw": h, "in_ch": c,
             "time_ms": round(t_ms, 3), "gflop": round(gflop, 2),
@@ -195,6 +195,26 @@ def main():
         })
         c = cc
         h //= 2
+
+    class Classifier(nn.Module):
+        @nn.compact
+        def __call__(self, s):
+            s = s.reshape((s.shape[0], -1))
+            s = nn.relu(nn.Dense(4096, dtype=jnp.bfloat16)(s))
+            s = nn.relu(nn.Dense(4096, dtype=jnp.bfloat16)(s))
+            return nn.Dense(1000, dtype=jnp.bfloat16)(s)
+
+    clf = Classifier()
+    cx = jnp.asarray(rng.rand(args.batch, h, h, c).astype(np.float32), jnp.bfloat16)
+    cp = clf.init(jax.random.PRNGKey(99), cx)
+    t_ms = timed(jax.jit(lambda p, s: clf.apply(p, s)), cp, cx) * 1e3
+    flat = h * h * c
+    gflop = 2 * (flat * 4096 + 4096 * 4096 + 4096 * 1000) * args.batch / 1e9
+    per_stage.append({
+        "stage": "classifier", "cfg": [flat, 4096, 4096, 1000], "in_hw": h,
+        "in_ch": c, "time_ms": round(t_ms, 3), "gflop": round(gflop, 2),
+        "tflops": round(gflop / t_ms, 2),
+    })
     result["forward_stage_breakdown"] = per_stage
     result["stage_sum_ms"] = round(sum(s["time_ms"] for s in per_stage), 3)
     # forward + backward (no optimizer, no restack)
