@@ -64,6 +64,7 @@ run() {  # run <name> <timeout_s> <out_or_-> <cmd...>
     # committed last real measurement its recorded_artifact field points at.
     grep '^{' "$tmp" | grep -v '"error"' > "$out"
   fi
+  cp "$tmp" .last_step_out  # guard inspects it for verdict-vs-crash on rc=1
   rm -f "$tmp"
   echo "--- $name rc=$rc" | tee -a tpu_session.log
   LAST_RC=$rc
@@ -128,7 +129,18 @@ guard() {  # guard <name> <cap> <out> <cmd...>: freshness skip, budget
     *)    run "$name" "$cap" "$out" "$@" ;;
   esac
   case "$out" in
-    @*.ok) [ "$LAST_RC" -eq 0 ] && date > "$fresh_target" ;;
+    @*.ok)
+      # Mark fresh when the step reached a VERDICT: rc 0, or rc 1 whose
+      # stdout carries FAIL verdict lines (the floors gate prints them) —
+      # re-running a deterministic FAIL every watcher pass would burn the
+      # budget.  An rc-1 CRASH (uncaught traceback, e.g. the tunnel dying
+      # mid-step: no verdict lines on stdout) stays unmarked and retries,
+      # as do timeouts/kills (rc > 1).
+      if [ "$LAST_RC" -eq 0 ] \
+         || { [ "$LAST_RC" -eq 1 ] && grep -q "FAIL" .last_step_out; }; then
+        echo "rc=$LAST_RC $(date)" > "$fresh_target"
+      fi
+      ;;
   esac
 }
 
