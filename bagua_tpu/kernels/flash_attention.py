@@ -10,9 +10,12 @@ other tensor combined.  This module fuses it:
   **unnormalized** contribution ``(o, l, m)`` (max-shifted weighted values,
   normalizer, row max).  Carry-free, so the Pallas version needs no awkward
   cross-call carry layouts.
-* :func:`block_attention_pallas` — Pallas TPU kernel, one grid step per
-  ``(batch x head)``: scores, masking, max, exp and both matmuls stay in
-  VMEM; only ``(t, d)`` tiles and ``(1, t)`` row-stat vectors touch HBM.
+* :func:`block_attention_pallas` — tiled Pallas TPU kernel, grid
+  ``(batch x head, t_q/block_q, t_k/block_k)`` with the online-softmax state
+  accumulated across the sequential k axis: scores, masking, max, exp and
+  both matmuls stay in VMEM at tile granularity, so VMEM use is independent
+  of sequence length; only ``(t, d)`` tiles and ``(1, t)`` row-stat vectors
+  touch HBM.
 * :func:`merge_blocks` — the cheap elementwise online-softmax combine of two
   contributions (XLA fuses it; no kernel needed).
 
@@ -23,8 +26,8 @@ directly; reducing the minor axis would need an unsupported sublane↔lane
 transpose.  Masked entries use a large negative finite (``-1e30``), never
 ``-inf``, so fully-masked columns stay NaN-free through the merges.
 
-Padding: ``t_q`` pads to 128 (lanes), ``t_k`` to 8 (sublanes), ``d`` to 128;
-padded keys are masked out, padded queries/channels sliced off after.
+Padding: ``t_q``/``t_k`` pad to their (128-aligned) tile edges, ``d`` to
+128; padded keys are masked out, padded queries/channels sliced off after.
 """
 
 import functools
@@ -82,22 +85,36 @@ def merge_blocks(carry, block):
 # ---------------------------------------------------------------------------
 
 _LANE = 128
-_SUB = 8
-# VMEM budget for one grid step (v5e has ~16MB; leave headroom for Mosaic's
-# own buffers).  Above this the wrapper falls back to the jnp path, which
-# XLA tiles freely — correctness is identical either way.
+# Default score-tile edge: (BLOCK_K x BLOCK_Q) f32 scores = 1 MB in VMEM,
+# with q/k/v/o tiles at d=128 adding ~1.3 MB — comfortably double-buffered
+# in a ~16 MB/core arena at any sequence length.
+BLOCK_Q = 512
+BLOCK_K = 512
+# Per-grid-step VMEM budget (v5e arena ~16 MB; headroom for Mosaic's own
+# buffers).  Checked against the ACTUAL tile sizes, so callers pushing
+# block_q/block_k (or huge head dims) get the graceful jnp fallback, not a
+# Mosaic VMEM rejection at runtime.
 _VMEM_BUDGET_BYTES = 10 * 1024 * 1024
 
 
-def flash_block_supported(tq: int, tk: int, d: int) -> bool:
-    """Whether one (batch x head) block fits the kernel's VMEM budget."""
-    tq_p = tq + (-tq) % _LANE
-    tk_p = tk + (-tk) % _SUB
+def _tiles_fit_vmem(bq: int, bk: int, d_p: int) -> bool:
+    tiles = (bq * d_p + 2 * 2 * bk * d_p + d_p * bq) * 4  # q + k,v (dbl-buf) + oT
+    scores = bk * bq * 4 * 2  # s + p
+    mask = 2 * bk * bq  # int8, double-buffered
+    return tiles + scores + mask <= _VMEM_BUDGET_BYTES
+
+
+def flash_block_supported(tq: int, tk: int, d: int,
+                          block_q: int = BLOCK_Q, block_k: int = BLOCK_K) -> bool:
+    """Whether the tiled kernel handles this shape within its VMEM budget.
+    Sequence lengths are unrestricted (the kernel tiles them); the check is
+    on one grid step's working set at the effective tile sizes."""
     d_p = d + (-d) % _LANE
-    scores = tk_p * tq_p * 4 * 2  # s + p
-    tiles = (tq_p * d_p * 2 + tk_p * d_p * 2) * 4  # q, o, k, v
-    mask = tk_p * tq_p
-    return scores + tiles + mask <= _VMEM_BUDGET_BYTES
+    bq = min(block_q, tq + (-tq) % _LANE)
+    bq += (-bq) % _LANE
+    bk = min(block_k, tk + (-tk) % _LANE)
+    bk += (-bk) % _LANE
+    return _tiles_fit_vmem(bq, bk, d_p)
 
 
 def _pad_to(x, mult, axis):
@@ -109,85 +126,130 @@ def _pad_to(x, mult, axis):
     return jnp.pad(x, widths)
 
 
-def _block_flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, l_ref, m_ref):
-    q = q_ref[0]  # (t_q, d) f32, pre-scaled
-    k = k_ref[0].astype(jnp.float32)  # (t_k, d)
-    v = v_ref[0].astype(jnp.float32)  # (t_k, d)
-    mask = mask_ref[0]  # (t_k, t_q) int8, transposed layout
+def _tiled_flash_kernel(q_ref, k_ref, v_ref, mask_ref, ot_ref, l_ref, m_ref):
+    """One (BLOCK_K, BLOCK_Q) score tile, accumulated across the sequential
+    innermost k-grid axis (TPU grids iterate in order, and the output blocks'
+    index maps ignore ``ik`` — so ``ot/l/m`` stay VMEM-resident across the
+    whole k sweep and carry the online-softmax running state).
 
-    # scores transposed: queries along lanes, so row stats are (1, t_q)
+    Layout: scores are (t_k, t_q) — queries on lanes — so the row stats are
+    (1, t_q) lane vectors, and the output tile is kept TRANSPOSED, ``(d,
+    t_q)``: the per-query rescale ``exp(m_prev - m_new)`` is a (1, t_q) lane
+    vector that broadcasts over sublanes (d).  Rescaling a (t_q, d) tile
+    would need the sublane<->lane transpose Mosaic doesn't do.  The wrapper
+    transposes once in HBM at the end.
+    """
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        ot_ref[...] = jnp.zeros_like(ot_ref)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG)
+
+    q = q_ref[0]  # (BQ, d) f32, pre-scaled
+    k = k_ref[0].astype(jnp.float32)  # (BK, d)
+    v = v_ref[0].astype(jnp.float32)  # (BK, d)
+    mask = mask_ref[0]  # (BK, BQ) int8, transposed layout
+
     s = jax.lax.dot_general(
         k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (t_k, t_q)
+    )  # (BK, BQ)
     s = jnp.where(mask != 0, s, NEG)
-    m_blk = jnp.max(s, axis=0, keepdims=True)  # (1, t_q)
-    p = jnp.exp(s - m_blk)
+    m_prev = m_ref[0]  # (1, BQ)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
+    p = jnp.exp(s - m_new)
     p = jnp.where(mask != 0, p, 0.0)
-    l_blk = jnp.sum(p, axis=0, keepdims=True)  # (1, t_q)
-    o_blk = jax.lax.dot_general(
-        p, v, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (t_q, d)
-    o_ref[0] = o_blk
-    l_ref[0] = l_blk
-    m_ref[0] = m_blk
+    c = jnp.exp(m_prev - m_new)  # (1, BQ) — rescale of the running state
+    l_ref[0] = l_ref[0] * c + jnp.sum(p, axis=0, keepdims=True)
+    ot_ref[0] = ot_ref[0] * c + jax.lax.dot_general(
+        v, p, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (d, BQ): contraction over BK on the MXU
+    m_ref[0] = m_new
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "block_q", "block_k"))
 def block_attention_pallas(
     qf: jnp.ndarray,
     k_blk: jnp.ndarray,
     v_blk: jnp.ndarray,
     mask: jnp.ndarray,
     interpret: bool = False,
+    block_q: int = BLOCK_Q,
+    block_k: int = BLOCK_K,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Pallas version of :func:`block_attention` (same contract)."""
+    """Pallas version of :func:`block_attention` (same contract), tiled:
+    grid ``(b*h, t_q/block_q, t_k/block_k)`` with the online-softmax state
+    accumulated across the sequential k axis — VMEM use is independent of
+    sequence length, so ring-attention shards of any size run fused (the
+    old whole-sequence kernel capped out near t=1k and fell back to jnp,
+    which materializes the full score matrix in HBM)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, tq, h, d = qf.shape
     tk = k_blk.shape[1]
-    if not flash_block_supported(tq, tk, d):
+    if not flash_block_supported(tq, tk, d, block_q, block_k):
         return block_attention(qf, k_blk, v_blk, mask)
+
+    # Tile edges: lane-aligned (128) and at most the padded sequence.
+    bq = min(block_q, tq + (-tq) % _LANE)
+    bq += (-bq) % _LANE
+    bk = min(block_k, tk + (-tk) % _LANE)
+    bk += (-bk) % _LANE
 
     # (b, t, h, d) -> (b*h, t, d)
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], x.shape[3])
 
-    q3 = _pad_to(_pad_to(to_bh(qf.astype(jnp.float32)), _LANE, 1), _LANE, 2)
-    k3 = _pad_to(_pad_to(to_bh(k_blk), _SUB, 1), _LANE, 2)
-    v3 = _pad_to(_pad_to(to_bh(v_blk), _SUB, 1), _LANE, 2)
+    q3 = _pad_to(_pad_to(to_bh(qf.astype(jnp.float32)), bq, 1), _LANE, 2)
+    k3 = _pad_to(_pad_to(to_bh(k_blk), bk, 1), _LANE, 2)
+    v3 = _pad_to(_pad_to(to_bh(v_blk), bk, 1), _LANE, 2)
     tq_p, d_p = q3.shape[1], q3.shape[2]
     tk_p = k3.shape[1]
 
-    # mask: (b, t_q, t_k) -> transposed, head-expanded, padded (b*h, t_k, t_q)
+    # mask: (b, t_q, t_k) -> transposed + padded (b, t_k, t_q).  NOT
+    # head-expanded: the mask is head-invariant, so the BlockSpec below
+    # indexes it with i // h — replicating it to (b*h, ...) in HBM would be
+    # an O(h t^2) allocation (128 MiB at h=8, t=4k), re-creating the very
+    # HBM traffic the fused kernel removes.
     mT = jnp.transpose(mask, (0, 2, 1)).astype(jnp.int8)  # (b, t_k, t_q)
-    mT = _pad_to(_pad_to(mT, _SUB, 1), _LANE, 2)  # padded keys/queries masked off
-    mT = jnp.broadcast_to(mT[:, None], (b, h, tk_p, tq_p)).reshape(b * h, tk_p, tq_p)
+    mT = _pad_to(_pad_to(mT, bk, 1), bq, 2)  # padded keys/queries masked off
 
     bh = b * h
-    o3, l3, m3 = pl.pallas_call(
-        _block_flash_kernel,
-        grid=(bh,),
+    ot3, l3, m3 = pl.pallas_call(
+        _tiled_flash_kernel,
+        grid=(bh, tq_p // bq, tk_p // bk),
         in_specs=[
-            pl.BlockSpec((1, tq_p, d_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tk_p, d_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tk_p, d_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tk_p, tq_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d_p), lambda i, iq, ik: (i, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d_p), lambda i, iq, ik: (i, ik, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d_p), lambda i, iq, ik: (i, ik, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, bq), lambda i, iq, ik: (i // h, ik, iq),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, tq_p, d_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, tq_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, tq_p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d_p, bq), lambda i, iq, ik: (i, 0, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda i, iq, ik: (i, 0, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda i, iq, ik: (i, 0, iq),
+                         memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tq_p, d_p), jnp.float32),
+            jax.ShapeDtypeStruct((bh, d_p, tq_p), jnp.float32),
             jax.ShapeDtypeStruct((bh, 1, tq_p), jnp.float32),
             jax.ShapeDtypeStruct((bh, 1, tq_p), jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3, mT)
 
-    o = o3[:, :tq, :d].reshape(b, h, tq, d)
+    # Undo the kernel's transposed output layout (one HBM pass).
+    o = jnp.transpose(ot3, (0, 2, 1))[:, :tq, :d].reshape(b, h, tq, d)
     l = l3[:, 0, :tq].reshape(b, h, tq)
     m = m3[:, 0, :tq].reshape(b, h, tq)
     return o, l, m

@@ -251,6 +251,47 @@ def test_flash_block_pallas_matches_jnp():
     np.testing.assert_allclose(np.asarray(m_p), np.asarray(m_ref), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize(
+    "tq,tk,d,bq,bk,masktype",
+    [
+        (384, 640, 64, 128, 128, "causal"),    # 3x5 k-accumulating tiles
+        (256, 512, 128, 128, 256, "full"),     # 2x2 tiles
+        (200, 300, 64, 128, 128, "causal"),    # unaligned seqs: pad + tile
+        (256, 256, 128, 512, 512, "firstcol"), # blocks > seq: single tile
+    ],
+)
+def test_flash_tiled_multi_block_matches_jnp(tq, tk, d, bq, bk, masktype):
+    """The TILED kernel's online-softmax accumulation across the sequential
+    k-grid must reproduce the jnp reference for every tiling regime —
+    multi-tile causal, full, unaligned-with-padding, and rows where only the
+    first key survives (running-max rescale correctness)."""
+    from bagua_tpu.kernels.flash_attention import (
+        block_attention,
+        block_attention_pallas,
+    )
+
+    rng = np.random.RandomState(0)
+    b, h = 1, 2
+    qf = jnp.asarray(rng.randn(b, tq, h, d).astype(np.float32)) / np.sqrt(d)
+    k = jnp.asarray(rng.randn(b, tk, h, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, tk, h, d).astype(np.float32))
+    if masktype == "causal":
+        mask = jnp.broadcast_to(
+            jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq), (b, tq, tk)
+        )
+    elif masktype == "firstcol":
+        mask = jnp.zeros((b, tq, tk), bool).at[:, :, 0].set(True)
+    else:
+        mask = jnp.ones((b, tq, tk), bool)
+    o_p, l_p, m_p = block_attention_pallas(
+        qf, k, v, mask, interpret=True, block_q=bq, block_k=bk
+    )
+    o_j, l_j, m_j = block_attention(qf, k, v, mask)
+    np.testing.assert_allclose(np.asarray(o_p), np.asarray(o_j), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(l_p), np.asarray(l_j), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(m_p), np.asarray(m_j), atol=2e-5)
+
+
 def test_ring_attention_pallas_matches_oracle():
     """Full ring attention with the Pallas block kernel (interpret mode)
     equals full attention on the gathered sequence."""
