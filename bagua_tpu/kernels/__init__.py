@@ -9,6 +9,7 @@ from bagua_tpu.kernels.minmax_uint8 import (  # noqa: F401
 )
 from bagua_tpu.kernels.flash_attention import (  # noqa: F401
     block_attention,
+    block_attention_fused,
     block_attention_pallas,
     merge_blocks,
 )

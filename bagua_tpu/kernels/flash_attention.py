@@ -31,6 +31,7 @@ Padding: ``t_q``/``t_k`` pad to their (128-aligned) tile edges, ``d`` to
 """
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -104,16 +105,38 @@ def _tiles_fit_vmem(bq: int, bk: int, d_p: int) -> bool:
     return tiles + scores + mask <= _VMEM_BUDGET_BYTES
 
 
+def _tile_edges(tq: int, tk: int, block_q: int, block_k: int):
+    """Effective (bq, bk): lane-aligned (128), at most the padded sequence.
+    Shared by the VMEM admission check and the kernel launch — they MUST
+    agree or an admitted shape could still be rejected by Mosaic."""
+    bq = min(block_q, tq + (-tq) % _LANE)
+    bq += (-bq) % _LANE
+    bk = min(block_k, tk + (-tk) % _LANE)
+    bk += (-bk) % _LANE
+    return bq, bk
+
+
+def _resolve_tiles(block_q, block_k):
+    """Explicit args win; else the ``BAGUA_PALLAS_FLASH_TILES`` env pin
+    ("BQxBK" — how a chip session's sweep winner is applied in production);
+    else the defaults.  Resolved OUTSIDE the jitted kernel launch, so the
+    pin takes effect per call (per trace, for in-jit callers)."""
+    if block_q is not None and block_k is not None:
+        return int(block_q), int(block_k)
+    env = os.environ.get("BAGUA_PALLAS_FLASH_TILES")
+    if env:
+        bq_s, _, bk_s = env.partition("x")
+        return int(bq_s), int(bk_s)
+    return BLOCK_Q, BLOCK_K
+
+
 def flash_block_supported(tq: int, tk: int, d: int,
                           block_q: int = BLOCK_Q, block_k: int = BLOCK_K) -> bool:
     """Whether the tiled kernel handles this shape within its VMEM budget.
     Sequence lengths are unrestricted (the kernel tiles them); the check is
     on one grid step's working set at the effective tile sizes."""
     d_p = d + (-d) % _LANE
-    bq = min(block_q, tq + (-tq) % _LANE)
-    bq += (-bq) % _LANE
-    bk = min(block_k, tk + (-tk) % _LANE)
-    bk += (-bk) % _LANE
+    bq, bk = _tile_edges(tq, tk, block_q, block_k)
     return _tiles_fit_vmem(bq, bk, d_p)
 
 
@@ -170,35 +193,41 @@ def _tiled_flash_kernel(q_ref, k_ref, v_ref, mask_ref, ot_ref, l_ref, m_ref):
     m_ref[0] = m_new
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "block_q", "block_k"))
 def block_attention_pallas(
     qf: jnp.ndarray,
     k_blk: jnp.ndarray,
     v_blk: jnp.ndarray,
     mask: jnp.ndarray,
     interpret: bool = False,
-    block_q: int = BLOCK_Q,
-    block_k: int = BLOCK_K,
+    block_q: int = None,
+    block_k: int = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Pallas version of :func:`block_attention` (same contract), tiled:
     grid ``(b*h, t_q/block_q, t_k/block_k)`` with the online-softmax state
     accumulated across the sequential k axis — VMEM use is independent of
     sequence length, so ring-attention shards of any size run fused (the
     old whole-sequence kernel capped out near t=1k and fell back to jnp,
-    which materializes the full score matrix in HBM)."""
+    which materializes the full score matrix in HBM).  Tile sizes resolve
+    args -> ``BAGUA_PALLAS_FLASH_TILES`` env pin -> defaults (see
+    :func:`_resolve_tiles`)."""
+    block_q, block_k = _resolve_tiles(block_q, block_k)
+    b, tq, h, d = qf.shape
+    tk = k_blk.shape[1]
+    if not flash_block_supported(tq, tk, d, block_q, block_k):
+        return block_attention(qf, k_blk, v_blk, mask)
+    return _block_attention_pallas_jit(
+        qf, k_blk, v_blk, mask, interpret, block_q, block_k
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_q", "block_k"))
+def _block_attention_pallas_jit(qf, k_blk, v_blk, mask, interpret, block_q, block_k):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, tq, h, d = qf.shape
     tk = k_blk.shape[1]
-    if not flash_block_supported(tq, tk, d, block_q, block_k):
-        return block_attention(qf, k_blk, v_blk, mask)
-
-    # Tile edges: lane-aligned (128) and at most the padded sequence.
-    bq = min(block_q, tq + (-tq) % _LANE)
-    bq += (-bq) % _LANE
-    bk = min(block_k, tk + (-tk) % _LANE)
-    bk += (-bk) % _LANE
+    bq, bk = _tile_edges(tq, tk, block_q, block_k)
 
     # (b, t, h, d) -> (b*h, t, d)
     def to_bh(x):
@@ -253,3 +282,65 @@ def block_attention_pallas(
     l = l3[:, 0, :tq].reshape(b, h, tq)
     m = m3[:, 0, :tq].reshape(b, h, tq)
     return o, l, m
+
+
+def block_attention_fused(
+    qf: jnp.ndarray,
+    k_blk: jnp.ndarray,
+    v_blk: jnp.ndarray,
+    mask: jnp.ndarray,
+    interpret: bool = False,
+    block_q: int = None,
+    block_k: int = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Differentiable :func:`block_attention_pallas`: fused Pallas forward,
+    jnp-derived backward.
+
+    ``pallas_call`` has no autodiff rule — ``jax.grad`` through the raw
+    kernel fails at trace time, which would crash every TRAINING use of
+    ring attention the moment the hardware-validation record flips the
+    kernel auto-ON.  The backward here is the exact ``jax.vjp`` of the jnp
+    reference (identical math to fp tolerance), so XLA re-materializes the
+    block's scores for the gradient only — the forward (and any
+    inference/eval path) keeps the tiled kernel's VMEM-bounded profile.  A
+    fused flash backward kernel can replace ``f_bwd`` without touching
+    callers."""
+
+    return _block_attention_fused_vjp[(interpret, block_q, block_k)](
+        qf, k_blk, v_blk, mask
+    )
+
+
+class _FusedVjpCache(dict):
+    """One custom_vjp function per static config.  The mask is an explicit
+    primal argument (a closed-over mask would be a TRACER inside jit/
+    shard_map traces — 'no constant handler' at lowering) with a ``None``
+    cotangent (bool input, tangent type float0)."""
+
+    def __missing__(self, key):
+        interpret, block_q, block_k = key
+
+        @jax.custom_vjp
+        def f(qf, k_blk, v_blk, mask):
+            return block_attention_pallas(
+                qf, k_blk, v_blk, mask,
+                interpret=interpret, block_q=block_q, block_k=block_k,
+            )
+
+        def f_fwd(qf, k_blk, v_blk, mask):
+            return f(qf, k_blk, v_blk, mask), (qf, k_blk, v_blk, mask)
+
+        def f_bwd(res, cot):
+            qf, k_blk, v_blk, mask = res
+            _, vjp = jax.vjp(
+                lambda a, b_, c: block_attention(a, b_, c, mask),
+                qf, k_blk, v_blk,
+            )
+            return (*vjp(cot), None)
+
+        f.defvjp(f_fwd, f_bwd)
+        self[key] = f
+        return f
+
+
+_block_attention_fused_vjp = _FusedVjpCache()

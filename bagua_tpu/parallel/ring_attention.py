@@ -11,9 +11,10 @@ Numerics use the online-softmax (flash-attention style) accumulation, with
 the per-block compute factored into ``kernels.flash_attention``:
 
 * ``block_attention`` — fused jnp (XLA) implementation;
-* ``block_attention_pallas`` — Pallas TPU kernel keeping the (t_q, t_k)
-  score matrix entirely in VMEM (``use_pallas=None`` auto-selects it on
-  TPU backends);
+* ``block_attention_fused`` — the tiled Pallas TPU kernel (VMEM use
+  independent of shard length) wrapped with a custom VJP so training
+  differentiates through it (``use_pallas=None`` auto-selects it on TPU
+  backends once the hardware-validation record approves);
 * ``merge_blocks`` — the cheap elementwise combine.
 
 Each block contributes exactly once, so the result equals full attention on
@@ -28,7 +29,6 @@ import jax.numpy as jnp
 from bagua_tpu.kernels.flash_attention import (
     NEG,
     block_attention,
-    block_attention_pallas,
     merge_blocks,
 )
 
@@ -51,7 +51,11 @@ def _pick_block_fn(use_pallas, interpret):
 
     if resolve_use_pallas(use_pallas, "BAGUA_PALLAS_ATTENTION",
                           kernel="flash_attention_block"):
-        return lambda qf, k, v, mask: block_attention_pallas(
+        # The _fused wrapper carries the custom VJP: the raw pallas_call has
+        # no autodiff rule, and ring attention's main consumer is TRAINING.
+        from bagua_tpu.kernels.flash_attention import block_attention_fused
+
+        return lambda qf, k, v, mask: block_attention_fused(
             qf, k, v, mask, interpret=interpret
         )
     return block_attention

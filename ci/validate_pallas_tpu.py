@@ -162,13 +162,21 @@ def validate_flash(interpret, report):
         jax.block_until_ready((o_p, o_j))
         entry["out_max_abs_diff"] = float(jnp.max(jnp.abs(o_p - o_j)))
         entry["lse_max_abs_diff"] = float(jnp.max(jnp.abs(l_p - l_j)))
-        # Tile-size sweep (bq, bk): the winner is recorded as pallas_ms.
+        # Tile-size sweep (bq, bk): the winner is recorded as pallas_ms, and
+        # applies in production via BAGUA_PALLAS_FLASH_TILES="BQxBK".  Only
+        # configs the VMEM guard admits are swept — an over-budget config
+        # silently falls back to jnp inside block_attention_pallas, and a
+        # jnp time must never masquerade as a Pallas measurement in the
+        # auto-ON gate.
+        from bagua_tpu.kernels.flash_attention import flash_block_supported
+
         sweep_bench(
             {
                 f"{bq}x{bk}": (lambda bq=bq, bk=bk: block_attention_pallas(
                     q, k, v, mask, interpret=interpret,
                     block_q=bq, block_k=bk))
                 for bq, bk in ((256, 256), (512, 512), (512, 1024), (1024, 512))
+                if flash_block_supported(tq, tk, d, bq, bk)
             },
             entry, "tile_sweep_ms", "best_tile", "pallas_ms",
             lambda: block_attention_pallas(q, k, v, mask, interpret=interpret),
