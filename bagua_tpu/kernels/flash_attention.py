@@ -117,17 +117,29 @@ def _tile_edges(tq: int, tk: int, block_q: int, block_k: int):
 
 
 def _resolve_tiles(block_q, block_k):
-    """Explicit args win; else the ``BAGUA_PALLAS_FLASH_TILES`` env pin
-    ("BQxBK" — how a chip session's sweep winner is applied in production);
-    else the defaults.  Resolved OUTSIDE the jitted kernel launch, so the
-    pin takes effect per call (per trace, for in-jit callers)."""
-    if block_q is not None and block_k is not None:
-        return int(block_q), int(block_k)
+    """Per-side resolution: explicit arg wins; else the
+    ``BAGUA_PALLAS_FLASH_TILES`` env pin ("BQxBK" — how a chip session's
+    sweep winner is applied in production); else the default.  A malformed
+    env value falls back to the defaults with a warning — an ops knob must
+    degrade, not crash every attention call.  Resolved OUTSIDE the jitted
+    kernel launch, so the pin takes effect per call (per trace, for in-jit
+    callers)."""
+    env_q, env_k = None, None
     env = os.environ.get("BAGUA_PALLAS_FLASH_TILES")
     if env:
-        bq_s, _, bk_s = env.partition("x")
-        return int(bq_s), int(bk_s)
-    return BLOCK_Q, BLOCK_K
+        try:
+            bq_s, _, bk_s = env.partition("x")
+            env_q, env_k = int(bq_s), int(bk_s)
+        except ValueError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "BAGUA_PALLAS_FLASH_TILES=%r is not 'BQxBK'; using defaults",
+                env,
+            )
+    bq = int(block_q) if block_q is not None else (env_q or BLOCK_Q)
+    bk = int(block_k) if block_k is not None else (env_k or BLOCK_K)
+    return bq, bk
 
 
 def flash_block_supported(tq: int, tk: int, d: int,
@@ -138,6 +150,23 @@ def flash_block_supported(tq: int, tk: int, d: int,
     d_p = d + (-d) % _LANE
     bq, bk = _tile_edges(tq, tk, block_q, block_k)
     return _tiles_fit_vmem(bq, bk, d_p)
+
+
+def _bwd_tiles_fit_vmem(bq: int, bk: int, d_p: int) -> bool:
+    """The backward's working set is larger than the forward's: four
+    score-sized temporaries (sT, pT, dpT, dsT) plus q/k/v/do in and a
+    dq (or dk+dv) accumulator out."""
+    tiles = (2 * bq * d_p + 2 * 2 * bk * d_p + 2 * bq * d_p) * 4  # q,do + k,v(dbl) + out
+    scores = bk * bq * 4 * 4  # sT, pT, dpT, dsT
+    mask = 2 * bk * bq
+    return tiles + scores + mask <= _VMEM_BUDGET_BYTES
+
+
+def flash_bwd_supported(tq: int, tk: int, d: int,
+                        block_q: int = BLOCK_Q, block_k: int = BLOCK_K) -> bool:
+    d_p = d + (-d) % _LANE
+    bq, bk = _tile_edges(tq, tk, block_q, block_k)
+    return _bwd_tiles_fit_vmem(bq, bk, d_p)
 
 
 def _pad_to(x, mult, axis):
@@ -284,6 +313,198 @@ def _block_attention_pallas_jit(qf, k_blk, v_blk, mask, interpret, block_q, bloc
     return o, l, m
 
 
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, m_ref, dl_ref, do_ref,
+                         dq_ref):
+    """dq tile, accumulated across the sequential k axis.
+
+    Recomputes the probability tile from (q, k, m) residuals — no O(t^2)
+    saved activations.  Same transposed score layout as the forward:
+    ``m``/``dl`` are (1, t_q) lane vectors broadcasting over key sublanes.
+    """
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    q = q_ref[0]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    mask = mask_ref[0]
+    sT = jax.lax.dot_general(
+        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (bk, bq)
+    pT = jnp.where(mask != 0, jnp.exp(sT - m_ref[0]), 0.0)
+    dpT = jax.lax.dot_general(
+        v, do_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) + dl_ref[0]  # (bk, bq): do.v per (key, query) + the l-path constant
+    dsT = pT * dpT
+    dq_ref[0] += jax.lax.dot_general(
+        dsT, k, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (bq, d)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, m_ref, dl_ref, do_ref,
+                          dk_ref, dv_ref):
+    """dk/dv tiles, accumulated across the sequential q axis."""
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    q = q_ref[0]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    mask = mask_ref[0]
+    do = do_ref[0]
+    sT = jax.lax.dot_general(
+        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (bk, bq)
+    pT = jnp.where(mask != 0, jnp.exp(sT - m_ref[0]), 0.0)
+    dv_ref[0] += jax.lax.dot_general(
+        pT, do, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (bk, d)
+    dpT = jax.lax.dot_general(
+        v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) + dl_ref[0]
+    dsT = pT * dpT
+    dk_ref[0] += jax.lax.dot_general(
+        dsT, q, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (bk, d)
+
+
+def flash_attention_bwd_pallas(
+    qf: jnp.ndarray,
+    k_blk: jnp.ndarray,
+    v_blk: jnp.ndarray,
+    mask: jnp.ndarray,
+    m: jnp.ndarray,
+    dl: jnp.ndarray,
+    do: jnp.ndarray,
+    interpret: bool = False,
+    block_q: int = None,
+    block_k: int = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused flash backward: ``(dq, dk, dv)`` from residuals ``(q, k, v,
+    mask, m)`` and cotangents ``(do, dl)`` — probabilities are recomputed
+    tile by tile, so backward HBM traffic is O(t·d) like the forward
+    instead of the jnp VJP's O(t²) score materialization.
+
+    Semantics: the row-max ``m`` is treated as a CONSTANT (stop-gradient),
+    and the ``m`` cotangent is dropped by the caller.  This is exact for
+    any consumer whose final function is invariant to the max shift —
+    ring/zigzag attention's merge + normalization, this kernel's only user
+    — where the dropped terms cancel identically (see
+    ``block_attention_fused``).  It is NOT the per-block ``jax.vjp`` of
+    :func:`block_attention`, which routes subgradients through argmax.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    block_q, block_k = _resolve_tiles(block_q, block_k)
+    b, tq, h, d = qf.shape
+    tk = k_blk.shape[1]
+    if not flash_bwd_supported(tq, tk, d, block_q, block_k):
+        # Same graceful-fallback contract as the forward: over-budget tiles
+        # get the exact jnp VJP (with the dm cotangent the caller already
+        # dropped set to zero), never a Mosaic VMEM rejection mid-training-
+        # step.  Exact-vjp and stop-grad-m backwards differ per block but
+        # agree on every composed (merge+normalize) gradient — see the
+        # block_attention_fused docstring — so mixing them per shape is fine.
+        _, vjp = jax.vjp(
+            lambda a, b_, c: block_attention(a, b_, c, mask), qf, k_blk, v_blk
+        )
+        return vjp((do, dl, jnp.zeros_like(m)))
+    bq, bk = _tile_edges(tq, tk, block_q, block_k)
+
+    def to_bh(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], x.shape[3])
+
+    q3 = _pad_to(_pad_to(to_bh(qf.astype(jnp.float32)), bq, 1), _LANE, 2)
+    k3 = _pad_to(_pad_to(to_bh(k_blk), bk, 1), _LANE, 2)
+    v3 = _pad_to(_pad_to(to_bh(v_blk), bk, 1), _LANE, 2)
+    do3 = _pad_to(_pad_to(to_bh(do.transpose(0, 2, 1, 3)), bq, 1), _LANE, 2)
+    tq_p, d_p = q3.shape[1], q3.shape[2]
+    tk_p = k3.shape[1]
+    mT = jnp.transpose(mask, (0, 2, 1)).astype(jnp.int8)
+    mT = _pad_to(_pad_to(mT, bk, 1), bq, 2)
+    # (b, h, tq) -> (bh, 1, tq_p); padded queries are masked, values moot
+    m3 = _pad_to(m.reshape(b * h, 1, tq), bq, 2)
+    dl3 = _pad_to(dl.reshape(b * h, 1, tq), bq, 2)
+
+    bh = b * h
+    dq3 = pl.pallas_call(
+        _flash_bwd_dq_kernel,
+        grid=(bh, tq_p // bq, tk_p // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d_p), lambda i, iq, ik: (i, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d_p), lambda i, iq, ik: (i, ik, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d_p), lambda i, iq, ik: (i, ik, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, bq), lambda i, iq, ik: (i // h, ik, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda i, iq, ik: (i, 0, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda i, iq, ik: (i, 0, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d_p), lambda i, iq, ik: (i, iq, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d_p), lambda i, iq, ik: (i, iq, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, tq_p, d_p), jnp.float32),
+        interpret=interpret,
+    )(q3, k3, v3, mT, m3, dl3, do3)
+
+    dk3, dv3 = pl.pallas_call(
+        _flash_bwd_dkv_kernel,
+        grid=(bh, tk_p // bk, tq_p // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d_p), lambda i, ik, iq: (i, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d_p), lambda i, ik, iq: (i, ik, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d_p), lambda i, ik, iq: (i, ik, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, bq), lambda i, ik, iq: (i // h, ik, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda i, ik, iq: (i, 0, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda i, ik, iq: (i, 0, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d_p), lambda i, ik, iq: (i, iq, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d_p), lambda i, ik, iq: (i, ik, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d_p), lambda i, ik, iq: (i, ik, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk_p, d_p), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tk_p, d_p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, mT, m3, dl3, do3)
+
+    def from_bh(x3, t):
+        return x3[:, :t, :d].reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    dq = from_bh(dq3, tq)  # (b, tq, h, d) — qf's layout
+    dk = from_bh(dk3, tk).astype(k_blk.dtype)
+    dv = from_bh(dv3, tk).astype(v_blk.dtype)
+    return dq, dk, dv
+
+
 def block_attention_fused(
     qf: jnp.ndarray,
     k_blk: jnp.ndarray,
@@ -299,12 +520,18 @@ def block_attention_fused(
     ``pallas_call`` has no autodiff rule — ``jax.grad`` through the raw
     kernel fails at trace time, which would crash every TRAINING use of
     ring attention the moment the hardware-validation record flips the
-    kernel auto-ON.  The backward here is the exact ``jax.vjp`` of the jnp
-    reference (identical math to fp tolerance), so XLA re-materializes the
-    block's scores for the gradient only — the forward (and any
-    inference/eval path) keeps the tiled kernel's VMEM-bounded profile.  A
-    fused flash backward kernel can replace ``f_bwd`` without touching
-    callers."""
+    kernel auto-ON.  Two backward paths:
+
+    * **fused** (:func:`flash_attention_bwd_pallas`): tile-recomputed
+      probabilities, O(t·d) HBM traffic, stop-gradient-on-``m`` semantics —
+      exact for the ring merge + normalization composition (the only
+      consumer), where the max-shift terms cancel identically.  Selected by
+      ``BAGUA_PALLAS_FLASH_BWD`` / the ``flash_attention_bwd`` record in
+      the hardware-validation artifact.
+    * **jnp** (default until chip-validated): the exact ``jax.vjp`` of the
+      jnp reference — XLA re-materializes the block's O(t²) scores for the
+      gradient only; the forward keeps the tiled kernel's profile either
+      way."""
 
     return _block_attention_fused_vjp[(interpret, block_q, block_k)](
         qf, k_blk, v_blk, mask
@@ -328,10 +555,24 @@ class _FusedVjpCache(dict):
             )
 
         def f_fwd(qf, k_blk, v_blk, mask):
-            return f(qf, k_blk, v_blk, mask), (qf, k_blk, v_blk, mask)
+            o, l, m = block_attention_pallas(
+                qf, k_blk, v_blk, mask,
+                interpret=interpret, block_q=block_q, block_k=block_k,
+            )
+            return (o, l, m), (qf, k_blk, v_blk, mask, m)
 
         def f_bwd(res, cot):
-            qf, k_blk, v_blk, mask = res
+            qf, k_blk, v_blk, mask, m = res
+            do, dl, _dm = cot  # dm dropped: see the fused-path note above
+            from bagua_tpu.kernels._config import resolve_use_pallas
+
+            if resolve_use_pallas(None, "BAGUA_PALLAS_FLASH_BWD",
+                                  kernel="flash_attention_bwd"):
+                dq, dk, dv = flash_attention_bwd_pallas(
+                    qf, k_blk, v_blk, mask, m, dl, do,
+                    interpret=interpret, block_q=block_q, block_k=block_k,
+                )
+                return dq, dk, dv, None
             _, vjp = jax.vjp(
                 lambda a, b_, c: block_attention(a, b_, c, mask),
                 qf, k_blk, v_blk,

@@ -187,6 +187,78 @@ def validate_flash(interpret, report):
         entry["ok"] = False
         entry["error"] = f"{type(e).__name__}: {e}"[:800]
     report.append(entry)
+    validate_flash_bwd(interpret, report)
+
+
+def validate_flash_bwd(interpret, report):
+    """The fused flash backward: composed-gradient parity with the jnp path
+    (normalized attention — the composition where stop-grad-m is exact) and
+    an A/B of the two backward implementations.  Its record gates
+    ``BAGUA_PALLAS_FLASH_BWD`` auto-ON via ``validated_on_hardware``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bagua_tpu.kernels.flash_attention import (
+        block_attention,
+        block_attention_fused,
+        flash_attention_bwd_pallas,
+    )
+
+    entry = {"kernel": "flash_attention_bwd"}
+    try:
+        b, h, tq, tk, d = (1, 2, 256, 256, 64) if INTERPRET_SMOKE else (1, 8, 2048, 2048, 128)
+        rs = np.random.RandomState(2)
+        q = jnp.asarray(rs.randn(b, tq, h, d).astype(np.float32)) / np.sqrt(d)
+        k = jnp.asarray(rs.randn(b, tk, h, d).astype(np.float32))
+        v = jnp.asarray(rs.randn(b, tk, h, d).astype(np.float32))
+        mask = jnp.broadcast_to(jnp.tril(jnp.ones((tq, tk), bool)), (b, tq, tk))
+
+        def normalized(block_fn):
+            def f(q, k, v):
+                o, l, m = block_fn(q, k, v, mask)
+                return jnp.sum(jnp.sin(o / (l[..., None] + 1e-9)))
+
+            return f
+
+        # jnp composed reference gradient
+        g_ref = jax.grad(normalized(block_attention), argnums=(0, 1, 2))(q, k, v)
+        # fused backward, driven through the same composition
+        os.environ["BAGUA_PALLAS_FLASH_BWD"] = "1"
+        try:
+            fused = lambda a, b_, c, m_: block_attention_fused(  # noqa: E731
+                a, b_, c, m_, interpret=interpret)
+            g_fused = jax.jit(jax.grad(normalized(
+                lambda a, b_, c, m_=mask: fused(a, b_, c, m_)), argnums=(0, 1, 2)
+            ))(q, k, v)
+        finally:
+            os.environ.pop("BAGUA_PALLAS_FLASH_BWD", None)
+        entry["grad_max_abs_diff"] = float(max(
+            jnp.max(jnp.abs(a - b_)) for a, b_ in zip(g_fused, g_ref)
+        ))
+
+        # A/B the backward alone: fused kernels vs the jnp VJP
+        o, l, m = block_attention(q, k, v, mask)
+        do = jnp.asarray(rs.randn(*o.shape).astype(np.float32))
+        dl = jnp.asarray(rs.randn(*l.shape).astype(np.float32))
+        entry["pallas_ms"] = round(bench(
+            lambda: flash_attention_bwd_pallas(
+                q, k, v, mask, m, dl, do, interpret=interpret)), 3)
+
+        # Build the VJP closure ONCE so the timed loop runs the backward
+        # alone — jax.vjp evaluates the forward too, and timing that would
+        # bias the validated_on_hardware auto-ON gate toward the fused
+        # kernel (forward+backward vs backward-only).
+        _, jnp_vjp = jax.vjp(
+            lambda a, b_, c: block_attention(a, b_, c, mask), q, k, v
+        )
+        zero_dm = jnp.zeros_like(m)
+        entry["jnp_ms"] = round(bench(lambda: jnp_vjp((do, dl, zero_dm))), 3)
+        entry["ok"] = entry["grad_max_abs_diff"] < 2e-2
+    except Exception as e:  # noqa: BLE001
+        entry["ok"] = False
+        entry["error"] = f"{type(e).__name__}: {e}"[:800]
+    report.append(entry)
 
 
 def main():
