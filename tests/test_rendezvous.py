@@ -254,8 +254,13 @@ for i in range(start, 8):
     if ws == 1:
         # Pace the solo phase so the test's fresh node-1 launcher has time to
         # join and trigger the scale-up re-form before training completes.
+        # 10s/iter x ~6 solo iters ~= 60s of window: a fresh launcher boots a
+        # whole jax process (tens of seconds on a loaded single-core box —
+        # 1s/iter was observed losing the race under a concurrent full-suite
+        # run).  Passing runs don't pay the full window: the re-form restarts
+        # this worker mid-sleep, so the remaining solo iterations never run.
         import time as _t
-        _t.sleep(1.0)
+        _t.sleep(10.0)
     if node == "1" and i >= 1 and not os.path.exists(crash_flag):
         open(crash_flag, "w").write("gone")
         os._exit(7)  # hard node death: no atexit handshakes
