@@ -75,27 +75,32 @@ def run(use_pallas, n_iters):
         mlm_loss_fn(model), optax.sgd(1e-3),
         Algorithm.init("bytegrad", use_pallas=use_pallas), process_group=group,
     )
-    state = ddp.init(params)
+    try:
+        state = ddp.init(params)
 
-    rng = np.random.RandomState(0)
-    bs = per_chip_batch * n
-    x = jnp.asarray(rng.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
-    y = jnp.asarray(rng.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
+        rng = np.random.RandomState(0)
+        bs = per_chip_batch * n
+        x = jnp.asarray(rng.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
+        y = jnp.asarray(rng.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
 
-    state, losses = ddp.train_step(state, (x, y))
-    jax.block_until_ready(losses)
-    HARNESS.note(f"compile + warmup done (pallas={use_pallas})")
-
-    t0 = time.perf_counter()
-    state, losses = ddp.train_step(state, (x, y))
-    jax.block_until_ready(losses)
-    first = bs / (time.perf_counter() - t0) / n
-
-    t0 = time.perf_counter()
-    for _ in range(n_iters):
         state, losses = ddp.train_step(state, (x, y))
-    jax.block_until_ready(losses)
-    sps = bs * n_iters / (time.perf_counter() - t0) / n
+        jax.block_until_ready(losses)
+        HARNESS.note(f"compile + warmup done (pallas={use_pallas})")
+        ddp.host_overhead_snapshot(reset=True)  # timed window only
+
+        t0 = time.perf_counter()
+        state, losses = ddp.train_step(state, (x, y))
+        jax.block_until_ready(losses)
+        first = bs / (time.perf_counter() - t0) / n
+
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            state, losses = ddp.train_step(state, (x, y))
+        jax.block_until_ready(losses)
+        sps = bs * n_iters / (time.perf_counter() - t0) / n
+        HARNESS.note(f"pallas={use_pallas}: host overhead {ddp.host_overhead_snapshot()}")
+    finally:
+        ddp.shutdown()
     return first, sps
 
 

@@ -110,6 +110,7 @@ def main():
             state, losses = ddp.train_step(state, (tokens, targets))
             jax.block_until_ready(losses)
         HARNESS.note("compile + warmup done (2 steps)")
+        ddp.host_overhead_snapshot(reset=True)  # timed window only
         t0 = time.perf_counter()
         n_iters = 0
         while n_iters < 12 and (n_iters < 2 or time.perf_counter() < deadline):
@@ -117,7 +118,8 @@ def main():
             n_iters += 1
         jax.block_until_ready(losses)
         elapsed = time.perf_counter() - t0
-        HARNESS.note(f"{n_iters} steps in {elapsed:.2f}s")
+        HARNESS.note(f"{n_iters} steps in {elapsed:.2f}s; "
+                     f"host overhead {ddp.host_overhead_snapshot()}")
         value = tokens.shape[0] * n_iters / elapsed / n
         extra = {
             "config": f"hidden{hidden} L{layers} seq{SEQ} {EXPERTS}experts top{TOP_K}",
