@@ -123,6 +123,11 @@ class BenchHarness:
             relay=relay,
         )
         if result["ok"]:
+            # Settle before claiming: in the r4 session the step launched 3s
+            # after a clean client exit hung at init — if the pool needs a
+            # beat to free the previous lease, 5s is cheap insurance (the
+            # watchdog still guards the main init either way).
+            time.sleep(5.0)
             self.note("preflight: probe healthy — proceeding to backend init")
             return
         with self._lock:
