@@ -238,11 +238,24 @@ def block_attention_pallas(
     old whole-sequence kernel capped out near t=1k and fell back to jnp,
     which materializes the full score matrix in HBM).  Tile sizes resolve
     args -> ``BAGUA_PALLAS_FLASH_TILES`` env pin -> defaults (see
-    :func:`_resolve_tiles`)."""
+    :func:`_resolve_tiles`).
+
+    Grouped-query attention is native: when ``k_blk``/``v_blk`` carry
+    ``h // groups`` heads, the K/V BlockSpecs map each query head's grid
+    step to its shared K/V tile (index arithmetic) — no ``jnp.repeat``
+    materialization, so K/V HBM traffic stays at the grouped head count.
+    """
     block_q, block_k = _resolve_tiles(block_q, block_k)
     b, tq, h, d = qf.shape
     tk = k_blk.shape[1]
+    h_kv = k_blk.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads ({h}) must divide by kv heads ({h_kv})")
     if not flash_block_supported(tq, tk, d, block_q, block_k):
+        g = h // h_kv
+        if g > 1:
+            k_blk = jnp.repeat(k_blk, g, axis=2)
+            v_blk = jnp.repeat(v_blk, g, axis=2)
         return block_attention(qf, k_blk, v_blk, mask)
     return _block_attention_pallas_jit(
         qf, k_blk, v_blk, mask, interpret, block_q, block_k
@@ -256,11 +269,15 @@ def _block_attention_pallas_jit(qf, k_blk, v_blk, mask, interpret, block_q, bloc
 
     b, tq, h, d = qf.shape
     tk = k_blk.shape[1]
+    h_kv = k_blk.shape[2]
+    g = h // h_kv  # GQA group size (1 = MHA)
     bq, bk = _tile_edges(tq, tk, block_q, block_k)
 
-    # (b, t, h, d) -> (b*h, t, d)
+    # (b, t, heads, d) -> (b*heads, t, d)
     def to_bh(x):
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], x.shape[3])
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(
+            b * x.shape[2], x.shape[1], x.shape[3]
+        )
 
     q3 = _pad_to(_pad_to(to_bh(qf.astype(jnp.float32)), bq, 1), _LANE, 2)
     k3 = _pad_to(_pad_to(to_bh(k_blk), bk, 1), _LANE, 2)
@@ -272,9 +289,14 @@ def _block_attention_pallas_jit(qf, k_blk, v_blk, mask, interpret, block_q, bloc
     # head-expanded: the mask is head-invariant, so the BlockSpec below
     # indexes it with i // h — replicating it to (b*h, ...) in HBM would be
     # an O(h t^2) allocation (128 MiB at h=8, t=4k), re-creating the very
-    # HBM traffic the fused kernel removes.
+    # HBM traffic the fused kernel removes.  K/V get the same treatment for
+    # GQA: grid step i (query head h_i = i % h of batch i // h) reads shared
+    # K/V row (i // h) * h_kv + h_i // g.
     mT = jnp.transpose(mask, (0, 2, 1)).astype(jnp.int8)  # (b, t_k, t_q)
     mT = _pad_to(_pad_to(mT, bk, 1), bq, 2)  # padded keys/queries masked off
+
+    def kv_row(i):
+        return (i // h) * h_kv + (i % h) // g
 
     bh = b * h
     ot3, l3, m3 = pl.pallas_call(
@@ -283,9 +305,9 @@ def _block_attention_pallas_jit(qf, k_blk, v_blk, mask, interpret, block_q, bloc
         in_specs=[
             pl.BlockSpec((1, bq, d_p), lambda i, iq, ik: (i, iq, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d_p), lambda i, iq, ik: (i, ik, 0),
+            pl.BlockSpec((1, bk, d_p), lambda i, iq, ik: (kv_row(i), ik, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d_p), lambda i, iq, ik: (i, ik, 0),
+            pl.BlockSpec((1, bk, d_p), lambda i, iq, ik: (kv_row(i), ik, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bk, bq), lambda i, iq, ik: (i // h, ik, iq),
                          memory_space=pltpu.VMEM),
@@ -348,12 +370,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, m_ref, dl_ref, do_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, m_ref, dl_ref, do_ref,
                           dk_ref, dv_ref):
-    """dk/dv tiles, accumulated across the sequential q axis."""
+    """dk/dv tiles, accumulated across the two sequential innermost grid
+    axes: the GQA head group (each shared K/V head collects gradient from
+    its ``g`` query heads) and the q axis.  MHA is the ``g == 1`` case."""
     from jax.experimental import pallas as pl
 
-    iq = pl.program_id(2)
+    ig = pl.program_id(2)
+    iq = pl.program_id(3)
 
-    @pl.when(iq == 0)
+    @pl.when(jnp.logical_and(ig == 0, iq == 0))
     def _init():
         dk_ref[...] = jnp.zeros_like(dk_ref)
         dv_ref[...] = jnp.zeros_like(dv_ref)
@@ -377,6 +402,25 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, m_ref, dl_ref, do_ref,
     dk_ref[0] += jax.lax.dot_general(
         dsT, q, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )  # (bk, d)
+
+
+def _jnp_block_vjp(qf, k_blk, v_blk, mask, cot):
+    """The exact jnp VJP of :func:`block_attention`, GQA-aware: grouped K/V
+    are repeated for the reference math and the resulting gradients are
+    summed back over each shared head's query group."""
+    b, _, h, d = qf.shape
+    tk, h_kv = k_blk.shape[1], k_blk.shape[2]
+    g = h // h_kv
+    k_r = jnp.repeat(k_blk, g, axis=2) if g > 1 else k_blk
+    v_r = jnp.repeat(v_blk, g, axis=2) if g > 1 else v_blk
+    _, vjp = jax.vjp(
+        lambda a, b_, c: block_attention(a, b_, c, mask), qf, k_r, v_r
+    )
+    dq, dk, dv = vjp(cot)
+    if g > 1:
+        dk = dk.reshape(b, tk, h_kv, g, d).sum(axis=3)
+        dv = dv.reshape(b, tk, h_kv, g, d).sum(axis=3)
+    return dq, dk, dv
 
 
 def flash_attention_bwd_pallas(
@@ -410,6 +454,10 @@ def flash_attention_bwd_pallas(
     block_q, block_k = _resolve_tiles(block_q, block_k)
     b, tq, h, d = qf.shape
     tk = k_blk.shape[1]
+    h_kv = k_blk.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads ({h}) must divide by kv heads ({h_kv})")
+    g = h // h_kv  # GQA group size (1 = MHA)
     if not flash_bwd_supported(tq, tk, d, block_q, block_k):
         # Same graceful-fallback contract as the forward: over-budget tiles
         # get the exact jnp VJP (with the dm cotangent the caller already
@@ -417,14 +465,13 @@ def flash_attention_bwd_pallas(
         # step.  Exact-vjp and stop-grad-m backwards differ per block but
         # agree on every composed (merge+normalize) gradient — see the
         # block_attention_fused docstring — so mixing them per shape is fine.
-        _, vjp = jax.vjp(
-            lambda a, b_, c: block_attention(a, b_, c, mask), qf, k_blk, v_blk
-        )
-        return vjp((do, dl, jnp.zeros_like(m)))
+        return _jnp_block_vjp(qf, k_blk, v_blk, mask, (do, dl, jnp.zeros_like(m)))
     bq, bk = _tile_edges(tq, tk, block_q, block_k)
 
     def to_bh(x):
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], x.shape[3])
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(
+            b * x.shape[2], x.shape[1], x.shape[3]
+        )
 
     q3 = _pad_to(_pad_to(to_bh(qf.astype(jnp.float32)), bq, 1), _LANE, 2)
     k3 = _pad_to(_pad_to(to_bh(k_blk), bk, 1), _LANE, 2)
@@ -438,6 +485,9 @@ def flash_attention_bwd_pallas(
     m3 = _pad_to(m.reshape(b * h, 1, tq), bq, 2)
     dl3 = _pad_to(dl.reshape(b * h, 1, tq), bq, 2)
 
+    def kv_row(i):
+        return (i // h) * h_kv + (i % h) // g
+
     bh = b * h
     dq3 = pl.pallas_call(
         _flash_bwd_dq_kernel,
@@ -445,9 +495,9 @@ def flash_attention_bwd_pallas(
         in_specs=[
             pl.BlockSpec((1, bq, d_p), lambda i, iq, ik: (i, iq, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d_p), lambda i, iq, ik: (i, ik, 0),
+            pl.BlockSpec((1, bk, d_p), lambda i, iq, ik: (kv_row(i), ik, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d_p), lambda i, iq, ik: (i, ik, 0),
+            pl.BlockSpec((1, bk, d_p), lambda i, iq, ik: (kv_row(i), ik, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bk, bq), lambda i, iq, ik: (i // h, ik, iq),
                          memory_space=pltpu.VMEM),
@@ -464,44 +514,52 @@ def flash_attention_bwd_pallas(
         interpret=interpret,
     )(q3, k3, v3, mT, m3, dl3, do3)
 
+    # dk/dv: each shared K/V head accumulates over its g query heads (the
+    # group axis) and the q tiles — both sequential innermost grid dims, so
+    # the output tiles stay VMEM-resident for the whole sweep.  Grid step
+    # (i, ik, ig, iq): i indexes (batch x kv head); its query-head row is
+    # (i // h_kv) * h + (i % h_kv) * g + ig.
+    def q_row(i, ig):
+        return (i // h_kv) * h + (i % h_kv) * g + ig
+
     dk3, dv3 = pl.pallas_call(
         _flash_bwd_dkv_kernel,
-        grid=(bh, tk_p // bk, tq_p // bq),
+        grid=(b * h_kv, tk_p // bk, g, tq_p // bq),
         in_specs=[
-            pl.BlockSpec((1, bq, d_p), lambda i, ik, iq: (i, iq, 0),
+            pl.BlockSpec((1, bq, d_p), lambda i, ik, ig, iq: (q_row(i, ig), iq, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d_p), lambda i, ik, iq: (i, ik, 0),
+            pl.BlockSpec((1, bk, d_p), lambda i, ik, ig, iq: (i, ik, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d_p), lambda i, ik, iq: (i, ik, 0),
+            pl.BlockSpec((1, bk, d_p), lambda i, ik, ig, iq: (i, ik, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, bq), lambda i, ik, iq: (i // h, ik, iq),
+            pl.BlockSpec((1, bk, bq), lambda i, ik, ig, iq: (i // h_kv, ik, iq),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq), lambda i, ik, iq: (i, 0, iq),
+            pl.BlockSpec((1, 1, bq), lambda i, ik, ig, iq: (q_row(i, ig), 0, iq),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq), lambda i, ik, iq: (i, 0, iq),
+            pl.BlockSpec((1, 1, bq), lambda i, ik, ig, iq: (q_row(i, ig), 0, iq),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d_p), lambda i, ik, iq: (i, iq, 0),
+            pl.BlockSpec((1, bq, d_p), lambda i, ik, ig, iq: (q_row(i, ig), iq, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d_p), lambda i, ik, iq: (i, ik, 0),
+            pl.BlockSpec((1, bk, d_p), lambda i, ik, ig, iq: (i, ik, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d_p), lambda i, ik, iq: (i, ik, 0),
+            pl.BlockSpec((1, bk, d_p), lambda i, ik, ig, iq: (i, ik, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tk_p, d_p), jnp.float32),
-            jax.ShapeDtypeStruct((bh, tk_p, d_p), jnp.float32),
+            jax.ShapeDtypeStruct((b * h_kv, tk_p, d_p), jnp.float32),
+            jax.ShapeDtypeStruct((b * h_kv, tk_p, d_p), jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3, mT, m3, dl3, do3)
 
-    def from_bh(x3, t):
-        return x3[:, :t, :d].reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    def from_bh(x3, t, heads):
+        return x3[:, :t, :d].reshape(b, heads, t, d).transpose(0, 2, 1, 3)
 
-    dq = from_bh(dq3, tq)  # (b, tq, h, d) — qf's layout
-    dk = from_bh(dk3, tk).astype(k_blk.dtype)
-    dv = from_bh(dv3, tk).astype(v_blk.dtype)
+    dq = from_bh(dq3, tq, h)  # (b, tq, h, d) — qf's layout
+    dk = from_bh(dk3, tk, h_kv).astype(k_blk.dtype)
+    dv = from_bh(dv3, tk, h_kv).astype(v_blk.dtype)
     return dq, dk, dv
 
 
@@ -573,11 +631,7 @@ class _FusedVjpCache(dict):
                     interpret=interpret, block_q=block_q, block_k=block_k,
                 )
                 return dq, dk, dv, None
-            _, vjp = jax.vjp(
-                lambda a, b_, c: block_attention(a, b_, c, mask),
-                qf, k_blk, v_blk,
-            )
-            return (*vjp(cot), None)
+            return (*_jnp_block_vjp(qf, k_blk, v_blk, mask, cot), None)
 
         f.defvjp(f_fwd, f_bwd)
         self[key] = f

@@ -47,6 +47,10 @@ def _axis_and_size(axis_name):
 
 
 def _pick_block_fn(use_pallas, interpret):
+    """Returns ``(block_fn, gqa_native)``: ``gqa_native`` means the fn takes
+    grouped (unrepeated) K/V directly — the fused kernel maps each query
+    head's grid step to its shared K/V tile, so the ``jnp.repeat``
+    materialization is skipped entirely on the pallas path."""
     from bagua_tpu.kernels._config import resolve_use_pallas
 
     if resolve_use_pallas(use_pallas, "BAGUA_PALLAS_ATTENTION",
@@ -55,10 +59,10 @@ def _pick_block_fn(use_pallas, interpret):
         # no autodiff rule, and ring attention's main consumer is TRAINING.
         from bagua_tpu.kernels.flash_attention import block_attention_fused
 
-        return lambda qf, k, v, mask: block_attention_fused(
+        return (lambda qf, k, v, mask: block_attention_fused(
             qf, k, v, mask, interpret=interpret
-        )
-    return block_attention
+        )), True
+    return block_attention, False
 
 
 def ring_attention(
@@ -113,19 +117,23 @@ def ring_attention(
     qf = q.astype(jnp.float32) * scale
     if kv_mask is None:
         kv_mask = jnp.ones((b, k.shape[1]), bool)
-    block_fn = _pick_block_fn(use_pallas, interpret)
+    block_fn, gqa_native = _pick_block_fn(use_pallas, interpret)
     if kv_groups > 1:
         if k.shape[2] * kv_groups != h:
             raise ValueError(
                 f"kv_groups={kv_groups} needs K/V with {h // kv_groups} heads, "
                 f"got {k.shape[2]} (q has {h})"
             )
-        inner = block_fn
-        # Expand the shared K/V heads at compute time only; everything that
-        # travels (the ring hops below) stays at the grouped head count.
-        block_fn = lambda qf_, k_, v_, m_: inner(  # noqa: E731
-            qf_, jnp.repeat(k_, kv_groups, axis=2), jnp.repeat(v_, kv_groups, axis=2), m_
-        )
+        if not gqa_native:
+            inner = block_fn
+            # jnp path: expand the shared K/V heads at compute time only;
+            # everything that travels (the ring hops below) stays at the
+            # grouped head count.  The fused kernel needs no expansion at
+            # all — its K/V BlockSpecs index the shared tiles directly.
+            block_fn = lambda qf_, k_, v_, m_: inner(  # noqa: E731
+                qf_, jnp.repeat(k_, kv_groups, axis=2),
+                jnp.repeat(v_, kv_groups, axis=2), m_
+            )
 
     if sp == 1:
         # zigzag of 1 rank is the identity layout
