@@ -9,8 +9,12 @@ Llama row has been compute-projected until a chip measurement exists —
 one v5e chip with f32 SGD state at seq 1024, batch 4/chip, bf16 compute,
 gradient_allreduce DP.
 
-MFU uses the standard 6·N·T estimate (attention FLOPs excluded), peak
-197 bf16 TFLOP/s (v5e).
+MFU uses the standard 6·N·T estimate, peak 197 bf16 TFLOP/s (v5e);
+attention FLOPs are excluded at seq 1024 (negligible) and included as
+model FLOPs (x3 fwd+bwd, recompute NOT counted — MFU, not HFU) in the
+``BENCH_LLAMA_LONGCTX=1`` mode, where they dominate.  Longctx runs seq
+8192 through the fused Pallas attention kernels (forward + flash
+backward) under a distinct metric name and artifact.
 
 Emission protocol shared with bench.py (``_bench_common``).  CPU smoke:
 ``BENCH_FORCE_CPU=1 BENCH_LLAMA_SMALL=1 python bench_llama.py``.
@@ -21,9 +25,13 @@ import time
 
 from _bench_common import BenchHarness
 
+_LONGCTX = bool(os.environ.get("BENCH_LLAMA_LONGCTX"))
 HARNESS = BenchHarness(
-    "llama_tokens_per_sec_per_chip", "tokens/s/chip",
-    recorded_artifact="BENCH_LLAMA_TPU.json",
+    ("llama_longctx_tokens_per_sec_per_chip" if _LONGCTX
+     else "llama_tokens_per_sec_per_chip"),
+    "tokens/s/chip",
+    recorded_artifact=("BENCH_LLAMA_LONGCTX_TPU.json" if _LONGCTX
+                       else "BENCH_LLAMA_TPU.json"),
 )
 
 import jax
@@ -53,9 +61,31 @@ def main():
     n = group.size
 
     small = bool(os.environ.get("BENCH_LLAMA_SMALL"))
+    longctx = bool(os.environ.get("BENCH_LLAMA_LONGCTX"))
     if small:
         cfg = llama_test_config(compute_dtype=jnp.bfloat16)
         seq, per_chip_batch = 32, 2
+    elif longctx:
+        # Long-context mode: seq 8192 through the FUSED attention path (the
+        # jnp path's 8k^2 score matrices would need ~9 GiB/layer).  sp_axis
+        # binds to the DDP mesh axes (size 1 per chip -> the ring
+        # degenerates to one fused block over the full local sequence);
+        # the kernels are forced on — this bench measures them.  The CPU
+        # smoke of this script shrinks the shape and keeps the jnp path
+        # (Pallas without interpret has no CPU lowering).
+        cpu_smoke = bool(os.environ.get("BENCH_FORCE_CPU"))
+        if not cpu_smoke:
+            os.environ["BAGUA_PALLAS_ATTENTION"] = "1"
+            os.environ["BAGUA_PALLAS_FLASH_BWD"] = "1"
+        seq = 256 if cpu_smoke else 8192
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, num_layers=8, num_heads=8,
+            num_kv_heads=4, intermediate_size=2816,
+            max_position_embeddings=seq, compute_dtype=jnp.bfloat16,
+            sp_axis=("inter", "intra"),
+        )
+        per_chip_batch = 1
+        small = cpu_smoke  # shrunken shapes must not emit chip-grade MFU
     else:
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1536, num_layers=16, num_heads=12,
@@ -85,15 +115,28 @@ def main():
     def _emit(tokens_per_sec, provisional=False):
         extra = {"vs_baseline": None, "params_m": round(n_params / 1e6, 1)}
         if small:
-            extra["config"] = "SMOKE (test-config shapes)"
+            extra["config"] = ("SMOKE (longctx config, shrunken seq, jnp path)"
+                               if longctx else "SMOKE (test-config shapes)")
         else:
+            gqa = f"GQA{cfg.num_heads}q/{cfg.num_kv_heads}kv"
             extra["config"] = (
-                f"llama {n_params/1e6:.0f}M GQA12q/4kv seq{seq} "
+                f"llama {n_params/1e6:.0f}M {gqa} seq{seq} "
                 f"batch{per_chip_batch}/chip gradient_allreduce bf16"
+                + (" FUSED-ATTENTION (longctx)" if longctx else "")
             )
             peak = PEAK_BF16_TFLOPS.get(jax.devices()[0].platform)
             if peak:
                 gflop_per_token = 6 * n_params / 1e9
+                if longctx:
+                    # attention dominates at long seq with a small model.
+                    # MFU convention: model FLOPs only (x3 fwd+bwd, like
+                    # 6N itself) — the flash backward's recompute is NOT
+                    # counted (that would be HFU).
+                    head_dim = cfg.hidden_size // cfg.num_heads
+                    gflop_per_token += (
+                        3 * 4 * seq * head_dim * cfg.num_heads
+                        * cfg.num_layers / 2 / 1e9
+                    )
                 extra["mfu"] = round(
                     tokens_per_sec * gflop_per_token / (peak * 1e3), 3
                 )

@@ -186,8 +186,13 @@ guard bench 660 BENCH_TPU.json env BENCH_DEADLINE_SEC=580 python bench.py
 # 9. BERT-Large ByteGrad bench.
 guard bench_bert 600 BENCH_BERT_TPU.json env BENCH_DEADLINE_SEC=520 python bench_bert.py
 
-# 10. Llama ~550M pretraining tokens/s + MFU — first Llama-family chip
+# 10. Llama ~500M pretraining tokens/s + MFU — first Llama-family chip
 #     measurement (converts SCALING_PROJECTION's projected_compute row).
 guard bench_llama 540 BENCH_LLAMA_TPU.json env BENCH_DEADLINE_SEC=460 python bench_llama.py
+
+# 11. Long-context Llama: seq 8192 through the fused attention kernels
+#     (forward + flash backward) in a real train step.
+guard bench_llama_longctx 540 BENCH_LLAMA_LONGCTX_TPU.json \
+  env BENCH_DEADLINE_SEC=460 BENCH_LLAMA_LONGCTX=1 python bench_llama.py
 
 echo "=== tpu_session done $(date) ($(($(date +%s) - T0))s elapsed) ===" | tee -a tpu_session.log

@@ -27,7 +27,8 @@ fi
 # are fresh there is nothing left to claim the chip for.
 ARTIFACTS=(PALLAS_TPU.json AUTOTUNE_TPU.ok FLOORS_TPU.ok TRACE_VGG16_TPU.ok
            BENCH_SCALING_TPU.json BENCH_MOE_TPU.json COMPILE_STABILITY_TPU.ok
-           BENCH_TPU.json BENCH_BERT_TPU.json BENCH_LLAMA_TPU.json)
+           BENCH_TPU.json BENCH_BERT_TPU.json BENCH_LLAMA_TPU.json
+           BENCH_LLAMA_LONGCTX_TPU.json)
 FRESH_S=${FRESH_S:-21600}
 
 all_fresh() {
