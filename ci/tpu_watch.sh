@@ -10,11 +10,17 @@
 # flock guarantees a single instance — two concurrent sessions would
 # contend for the one-chip pool and interleave artifact writes.
 #
-# Usage: bash ci/tpu_watch.sh [poll_interval_s] >> tpu_watch.log 2>&1 &
+# Usage: bash ci/tpu_watch.sh [poll_interval_s] [stop_epoch] >> tpu_watch.log 2>&1 &
+#   stop_epoch: unix time after which the watcher exits WITHOUT starting a
+#   new session pass — and refuses to start one that couldn't finish by
+#   then.  The round driver runs its own bench.py at round end; a watcher
+#   session holding the single chip at that moment would sabotage the one
+#   measurement that becomes BENCH_r{N}.json.
 
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL=${1:-480}
+STOP_EPOCH=${2:-0}
 LOCK=/tmp/bagua_tpu_watch.lock
 
 exec 9> "$LOCK"
@@ -41,8 +47,13 @@ all_fresh() {
   return 0
 }
 
-echo "=== tpu_watch start $(date) (interval ${INTERVAL}s) ==="
+echo "=== tpu_watch start $(date) (interval ${INTERVAL}s, stop_epoch ${STOP_EPOCH}) ==="
+SESSION_BUDGET="${SESSION_BUDGET_S:-6600}"
 while true; do
+  if [ "$STOP_EPOCH" -gt 0 ] && [ "$(( STOP_EPOCH - $(date +%s) ))" -lt "$(( SESSION_BUDGET + 120 ))" ]; then
+    echo "=== stop_epoch near: a session pass could overlap the driver's bench — exiting $(date) ==="
+    exit 0
+  fi
   if all_fresh; then
     echo "=== all artifacts fresh $(date) — watcher converged, exiting ==="
     exit 0
