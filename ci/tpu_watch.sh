@@ -49,8 +49,13 @@ all_fresh() {
 
 echo "=== tpu_watch start $(date) (interval ${INTERVAL}s, stop_epoch ${STOP_EPOCH}) ==="
 SESSION_BUDGET="${SESSION_BUDGET_S:-6600}"
+# Admission margin: the watcher's own probes before a pass (30s relay-gate +
+# 150s full probe) plus the session's overshoot beyond its budget (last step
+# admitted at remaining==cap, its probes, the 20s kill-after) — ~600s covers
+# the worst case with slack.
+MARGIN=600
 while true; do
-  if [ "$STOP_EPOCH" -gt 0 ] && [ "$(( STOP_EPOCH - $(date +%s) ))" -lt "$(( SESSION_BUDGET + 120 ))" ]; then
+  if [ "$STOP_EPOCH" -gt 0 ] && [ "$(( STOP_EPOCH - $(date +%s) ))" -lt "$(( SESSION_BUDGET + MARGIN ))" ]; then
     echo "=== stop_epoch near: a session pass could overlap the driver's bench — exiting $(date) ==="
     exit 0
   fi
@@ -62,7 +67,8 @@ while true; do
   if timeout 30 python ci/tpu_probe.py --relay-gate --attempts 1 --cap 60 2>/dev/null | grep -q '"ok": true' \
      || timeout 150 python ci/tpu_probe.py --attempts 1 --cap 60 2>/dev/null | grep -q '"ok": true'; then
     echo "=== tunnel HEALTHY $(date) — running session ==="
-    bash ci/tpu_session.sh
+    # One value governs both the admission check above and the session.
+    SESSION_BUDGET_S="$SESSION_BUDGET" bash ci/tpu_session.sh
     echo "=== session pass done $(date); continuing watch ==="
   else
     echo "tunnel still down $(date)"
