@@ -28,6 +28,12 @@ transpose.  Masked entries use a large negative finite (``-1e30``), never
 
 Padding: ``t_q``/``t_k`` pad to their (128-aligned) tile edges, ``d`` to
 128; padded keys are masked out, padded queries/channels sliced off after.
+
+Known limit: the mask is a dense ``(b, t_k, t_q)`` int8 array — the one
+remaining O(t²) HBM object on this path (256 MiB at t=16k; ~16 GiB at
+128k).  Compute and gradients are already tile-local, so the next step for
+beyond-32k shards is in-kernel mask generation (causal offsets / segment
+ids via iota, splash-attention style) replacing the materialized array.
 """
 
 import functools
