@@ -207,25 +207,31 @@ def _tiled_flash_kernel(q_ref, k_ref, v_ref, mask_ref, ot_ref, l_ref, m_ref):
         l_ref[...] = jnp.zeros_like(l_ref)
         m_ref[...] = jnp.full_like(m_ref, NEG)
 
-    q = q_ref[0]  # (BQ, d) f32, pre-scaled
-    k = k_ref[0].astype(jnp.float32)  # (BK, d)
-    v = v_ref[0].astype(jnp.float32)  # (BK, d)
     mask = mask_ref[0]  # (BK, BQ) int8, transposed layout
 
-    s = jax.lax.dot_general(
-        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (BK, BQ)
-    s = jnp.where(mask != 0, s, NEG)
-    m_prev = m_ref[0]  # (1, BQ)
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
-    p = jnp.exp(s - m_new)
-    p = jnp.where(mask != 0, p, 0.0)
-    c = jnp.exp(m_prev - m_new)  # (1, BQ) — rescale of the running state
-    l_ref[0] = l_ref[0] * c + jnp.sum(p, axis=0, keepdims=True)
-    ot_ref[0] = ot_ref[0] * c + jax.lax.dot_general(
-        v, p, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (d, BQ): contraction over BK on the MXU
-    m_ref[0] = m_new
+    # Fully-masked tiles leave the running state untouched (p would be all
+    # zeros: m_new == m_prev, c == 1) — skip both MXU matmuls and the exp.
+    # Under a causal mask ~half the tiles are dead, so causal long-context
+    # forward compute halves with bit-identical results.
+    @pl.when(jnp.any(mask != 0))
+    def _live_tile():
+        q = q_ref[0]  # (BQ, d) f32, pre-scaled
+        k = k_ref[0].astype(jnp.float32)  # (BK, d)
+        v = v_ref[0].astype(jnp.float32)  # (BK, d)
+        s = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BK, BQ)
+        s = jnp.where(mask != 0, s, NEG)
+        m_prev = m_ref[0]  # (1, BQ)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask != 0, p, 0.0)
+        c = jnp.exp(m_prev - m_new)  # (1, BQ) — rescale of the running state
+        l_ref[0] = l_ref[0] * c + jnp.sum(p, axis=0, keepdims=True)
+        ot_ref[0] = ot_ref[0] * c + jax.lax.dot_general(
+            v, p, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (d, BQ): contraction over BK on the MXU
+        m_ref[0] = m_new
 
 
 def block_attention_pallas(
@@ -357,21 +363,24 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, m_ref, dl_ref, do_ref,
     def _init():
         dq_ref[...] = jnp.zeros_like(dq_ref)
 
-    q = q_ref[0]
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
     mask = mask_ref[0]
-    sT = jax.lax.dot_general(
-        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (bk, bq)
-    pT = jnp.where(mask != 0, jnp.exp(sT - m_ref[0]), 0.0)
-    dpT = jax.lax.dot_general(
-        v, do_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) + dl_ref[0]  # (bk, bq): do.v per (key, query) + the l-path constant
-    dsT = pT * dpT
-    dq_ref[0] += jax.lax.dot_general(
-        dsT, k, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (bq, d)
+
+    @pl.when(jnp.any(mask != 0))  # dead tiles contribute exactly zero
+    def _live_tile():
+        q = q_ref[0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        sT = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bk, bq)
+        pT = jnp.where(mask != 0, jnp.exp(sT - m_ref[0]), 0.0)
+        dpT = jax.lax.dot_general(
+            v, do_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) + dl_ref[0]  # (bk, bq): do.v per (key, query) + the l-path constant
+        dsT = pT * dpT
+        dq_ref[0] += jax.lax.dot_general(
+            dsT, k, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, d)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, m_ref, dl_ref, do_ref,
@@ -389,25 +398,28 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, m_ref, dl_ref, do_ref,
         dk_ref[...] = jnp.zeros_like(dk_ref)
         dv_ref[...] = jnp.zeros_like(dv_ref)
 
-    q = q_ref[0]
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
     mask = mask_ref[0]
-    do = do_ref[0]
-    sT = jax.lax.dot_general(
-        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (bk, bq)
-    pT = jnp.where(mask != 0, jnp.exp(sT - m_ref[0]), 0.0)
-    dv_ref[0] += jax.lax.dot_general(
-        pT, do, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (bk, d)
-    dpT = jax.lax.dot_general(
-        v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) + dl_ref[0]
-    dsT = pT * dpT
-    dk_ref[0] += jax.lax.dot_general(
-        dsT, q, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (bk, d)
+
+    @pl.when(jnp.any(mask != 0))  # dead tiles contribute exactly zero
+    def _live_tile():
+        q = q_ref[0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0]
+        sT = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bk, bq)
+        pT = jnp.where(mask != 0, jnp.exp(sT - m_ref[0]), 0.0)
+        dv_ref[0] += jax.lax.dot_general(
+            pT, do, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bk, d)
+        dpT = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) + dl_ref[0]
+        dsT = pT * dpT
+        dk_ref[0] += jax.lax.dot_general(
+            dsT, q, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bk, d)
 
 
 def _jnp_block_vjp(qf, k_blk, v_blk, mask, cot):
