@@ -1,0 +1,120 @@
+"""The relay classifier in ci/tpu_probe.py is load-bearing: the bench
+preflight, the session script, and the watcher all branch on it.  Pin its
+verdicts against live sockets exhibiting each behavior."""
+
+import importlib.util
+import os
+import socket
+import threading
+
+from helpers import free_port
+
+# Load ci/tpu_probe.py by path — a sys.path.insert of ci/ would shadow
+# same-named modules for the rest of the pytest session.
+_spec = importlib.util.spec_from_file_location(
+    "tpu_probe",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "ci", "tpu_probe.py"),
+)
+tpu_probe = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(tpu_probe)
+
+
+def _serve(handler):
+    """One-connection TCP server on an ephemeral port; returns the port."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def run():
+        try:
+            conn, _ = srv.accept()
+            handler(conn)
+        except OSError:
+            pass
+        finally:
+            srv.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    return port
+
+
+def test_accepted_then_dropped_is_dead_upstream_signature():
+    port = _serve(lambda conn: conn.close())  # accept, drop immediately
+    assert tpu_probe.relay_diagnosis("127.0.0.1", port) == "accepted-then-dropped"
+
+
+def test_accepted_held_is_healthy_signature():
+    import time
+
+    def hold(conn):
+        time.sleep(6.0)
+        conn.close()
+
+    port = _serve(hold)
+    assert tpu_probe.relay_diagnosis("127.0.0.1", port, hold_s=1.0) == "accepted-held"
+
+
+def test_server_that_speaks_is_held():
+    def greet(conn):
+        conn.sendall(b"hello")
+        import time
+
+        time.sleep(3.0)
+        conn.close()
+
+    port = _serve(greet)
+    assert tpu_probe.relay_diagnosis("127.0.0.1", port, hold_s=1.0) == "accepted-held"
+
+
+def test_refused_when_nothing_listens():
+    port = free_port()  # bound then released: next connect is refused
+    assert tpu_probe.relay_diagnosis("127.0.0.1", port) in ("refused", "no-listener")
+
+
+def test_failure_summary_names_phase_and_relay():
+    result = {
+        "ok": False,
+        "attempts": [{"ok": False, "last_phase": "devices +0.0s", "elapsed": 50.0}],
+        "relay": "accepted-then-dropped",
+        "last_phase": "devices +0.0s",
+    }
+    s = tpu_probe.failure_summary(result)
+    assert "devices" in s and "upstream tunnel dead" in s and "1x" in s
+
+
+def test_probe_once_caps_a_hung_child_and_names_the_phase(monkeypatch):
+    """A child whose init hangs forever must come back within the cap with
+    the stuck phase named — the exact dead-tunnel behavior.  The child body
+    is swapped for one that prints its phases then blocks (ignoring
+    SIGINT, like the PJRT client's retry loop), so this also exercises the
+    SIGINT -> SIGKILL escalation."""
+    import time
+
+    monkeypatch.setattr(tpu_probe, "_CHILD", r"""
+import signal, time
+signal.signal(signal.SIGINT, signal.SIG_IGN)
+print("phase:import +0.0s", flush=True)
+print("phase:devices +0.1s", flush=True)
+time.sleep(600)
+""")
+    t0 = time.monotonic()
+    r = tpu_probe.probe_once(cap_s=3.0)
+    elapsed = time.monotonic() - t0
+    assert r["ok"] is False
+    assert r["last_phase"].startswith("devices"), r
+    # cap (3s) + SIGINT grace (10s) + SIGKILL communicate (5s) + slack
+    assert elapsed < 25.0, elapsed
+
+
+def test_probe_once_reports_success():
+    """A child that completes all phases yields ok=True."""
+    import unittest.mock as mock
+
+    with mock.patch.object(tpu_probe, "_CHILD", r"""
+print("phase:import +0.0s", flush=True)
+print("phase:matmul-ok +0.1s", flush=True)
+"""):
+        r = tpu_probe.probe_once(cap_s=30.0)
+    assert r["ok"] is True and r["last_phase"].startswith("matmul-ok")
