@@ -1,0 +1,113 @@
+"""Pin ci/tpu_session.sh's guard logic: freshness skips, budget admission,
+marker semantics, and artifact-write hygiene — with the probe functions
+stubbed so no chip is involved.
+
+The guard is what decides how a scarce chip session spends its budget;
+regressions here silently burn sessions (r4 lost ~45 minutes re-running
+landed artifacts).
+"""
+
+import os
+import subprocess
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Extract guard/run/fresh/remaining from the real script by sourcing it with
+# the step section stripped: everything between the function definitions and
+# the first `guard` invocation is driven by the test harness instead.
+HARNESS = textwrap.dedent("""
+    set -u
+    cd "$WORK"
+    SESSION_BUDGET_S=${SESSION_BUDGET_S:-300}
+    FRESH_S=${FRESH_S:-3600}
+    T0=$(date +%s)
+    # functions lifted verbatim from ci/tpu_session.sh by the test
+    {FUNCS}
+    LAST_RC=0
+    TUNNEL_DOWN=0
+    probe_fast() { true; }
+    probe_full() { true; }
+    {BODY}
+""")
+
+
+def _funcs_from_script():
+    """The function definitions (remaining/run/fresh/guard) from the real
+    script, so the test exercises the shipped code, not a copy."""
+    src = open(os.path.join(REPO, "ci", "tpu_session.sh")).read()
+    start = src.index("remaining()")
+    end = src.index("# Step order")
+    funcs = src[start:end]
+    # neutralize the real probes (the harness stubs them after sourcing)
+    return funcs
+
+
+def _run(body, env=None, work=None, tmp_path=None):
+    import tempfile
+
+    work = work or (str(tmp_path) if tmp_path is not None
+                    else tempfile.mkdtemp(prefix="tpu_session_test_"))
+    script = HARNESS.replace("{FUNCS}", _funcs_from_script()).replace("{BODY}", body)
+    proc = subprocess.run(
+        ["bash", "-c", script],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, **(env or {}), "WORK": work},
+    )
+    return proc, work
+
+
+def test_redirect_marker_fresh_and_budget_paths(tmp_path):
+    body = """
+    guard step1 60 out.json echo '{"metric":"x","value":1}'
+    guard step1b 60 out.json echo '{"metric":"x","value":2}'     # fresh skip
+    guard step2 60 @M.ok true                                    # marker
+    guard step2b 60 @M.ok true                                   # fresh skip
+    guard step3 9999 - echo never                                # budget skip
+    cat out.json
+    """
+    proc, work = _run(body, tmp_path=tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert '"value":1' in proc.stdout                      # first write won
+    assert proc.stdout.count("SKIPPED") == 3, proc.stdout  # 1b, 2b, 3
+    assert os.path.exists(os.path.join(work, "M.ok"))
+
+
+def test_error_lines_never_clobber_artifacts(tmp_path):
+    body = """
+    echo '{"metric":"x","value":42}' > out.json
+    touch -d '8 hours ago' out.json                      # stale -> re-run
+    guard step 60 out.json sh -c 'echo "{\\"error\\":\\"tunnel died\\",\\"value\\":0}"; exit 3'
+    cat out.json
+    """
+    proc, _ = _run(body, tmp_path=tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert '"value":42' in proc.stdout  # healthy artifact preserved
+
+
+def test_fail_verdict_marks_fresh_but_crash_does_not(tmp_path):
+    body = """
+    guard gate 60 @G.ok sh -c 'echo "vgg16/async throughput=1 floor(190)=FAIL"; exit 1'
+    [ -f G.ok ] && echo "verdict-marked"
+    rm -f G.ok
+    guard gate2 60 @G.ok sh -c 'echo "Traceback (most recent call last): boom"; exit 1'
+    [ -f G.ok ] || echo "crash-not-marked"
+    """
+    proc, _ = _run(body, tmp_path=tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "verdict-marked" in proc.stdout
+    assert "crash-not-marked" in proc.stdout
+
+
+def test_tunnel_down_cached_after_double_probe_failure(tmp_path):
+    body = """
+    probe_fast() { false; }
+    probe_full() { false; }
+    LAST_RC=1
+    guard a 60 - echo ran-a
+    guard b 60 - echo ran-b
+    """
+    proc, _ = _run(body, tmp_path=tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "ran-a" not in proc.stdout and "ran-b" not in proc.stdout
+    assert proc.stdout.count("tunnel down") == 2
