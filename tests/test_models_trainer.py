@@ -70,6 +70,7 @@ def test_gpt_causal_sp_matches_local():
     np.testing.assert_allclose(got, ref, rtol=5e-3, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_trainer_fit_with_checkpointing(group, tmp_path):
     from bagua_tpu.algorithms import Algorithm
     from bagua_tpu.models.mlp import init_mlp, mse_loss
@@ -296,6 +297,7 @@ def test_gpt_causal_sp_zigzag_matches_local():
     np.testing.assert_allclose(got, ref, rtol=5e-3, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_gpt_zigzag_lm_loss_masks_seam():
     """Under the zigzag SP layout the mid-block seam pair is excluded from
     the LM loss; per-rank losses must match the oracle computed from the

@@ -260,6 +260,7 @@ def test_flash_block_pallas_matches_jnp():
         (256, 256, 128, 512, 512, "firstcol"), # blocks > seq: single tile
     ],
 )
+@pytest.mark.slow
 def test_flash_tiled_multi_block_matches_jnp(tq, tk, d, bq, bk, masktype):
     """The TILED kernel's online-softmax accumulation across the sequential
     k-grid must reproduce the jnp reference for every tiling regime —
@@ -353,6 +354,7 @@ def test_ring_attention_pallas_trains():
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
 def test_ring_attention_gqa_native_fused_matches_jnp(layout, monkeypatch):
     """GQA through the fused kernel WITHOUT jnp.repeat (K/V BlockSpecs index
@@ -386,6 +388,7 @@ def test_ring_attention_gqa_native_fused_matches_jnp(layout, monkeypatch):
                                    rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
 def test_ring_attention_fused_backward_matches_jnp(layout, monkeypatch):
     """The FUSED flash backward (tile-recomputed probabilities, stop-grad-m

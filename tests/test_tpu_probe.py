@@ -2,6 +2,7 @@
 preflight, the session script, and the watcher all branch on it.  Pin its
 verdicts against live sockets exhibiting each behavior."""
 
+import pytest
 import importlib.util
 import os
 import socket
@@ -84,6 +85,7 @@ def test_failure_summary_names_phase_and_relay():
     assert "devices" in s and "upstream tunnel dead" in s and "1x" in s
 
 
+@pytest.mark.slow
 def test_probe_once_caps_a_hung_child_and_names_the_phase(monkeypatch):
     """A child whose init hangs forever must come back within the cap with
     the stuck phase named — the exact dead-tunnel behavior.  The child body
