@@ -124,9 +124,17 @@ def validate_minmax(interpret, report):
             lambda: compress_minmax_uint8_pallas(x, interpret=interpret),
         )
         entry["jnp_compress_ms"] = round(bench(compress_minmax_uint8, x), 3)
+        # Time decompress at the compress sweep's winning block size — the
+        # pair runs with one pinned BAGUA_PALLAS_MINMAX_BLOCK_CHUNKS value
+        # in production, so mixed-bc timings would misstate the deployable
+        # configuration.
+        best_bc = entry.get("best_block_chunks")
         entry["pallas_decompress_ms"] = round(
             bench(
-                lambda a, b: decompress_minmax_uint8_pallas(a, b, interpret=interpret),
+                lambda a, b: decompress_minmax_uint8_pallas(
+                    a, b, interpret=interpret,
+                    block_chunks=int(best_bc) if best_bc else None,
+                ),
                 q_p, mm_p,
             ), 3,
         )
