@@ -129,15 +129,24 @@ def validate_minmax(interpret, report):
         # in production, so mixed-bc timings would misstate the deployable
         # configuration.
         best_bc = entry.get("best_block_chunks")
-        entry["pallas_decompress_ms"] = round(
-            bench(
-                lambda a, b: decompress_minmax_uint8_pallas(
-                    a, b, interpret=interpret,
-                    block_chunks=int(best_bc) if best_bc else None,
-                ),
-                q_p, mm_p,
-            ), 3,
-        )
+        try:
+            entry["pallas_decompress_ms"] = round(
+                bench(
+                    lambda a, b: decompress_minmax_uint8_pallas(
+                        a, b, interpret=interpret,
+                        block_chunks=int(best_bc) if best_bc else None,
+                    ),
+                    q_p, mm_p,
+                ), 3,
+            )
+        except Exception as e:  # noqa: BLE001 — a timing-config failure must
+            # not masquerade as a kernel-validation failure (numerics passed
+            # above); record it and fall back to the auto-picked block size.
+            entry["decompress_at_best_bc_error"] = f"{type(e).__name__}"
+            entry["pallas_decompress_ms"] = round(
+                bench(lambda a, b: decompress_minmax_uint8_pallas(
+                    a, b, interpret=interpret), q_p, mm_p), 3,
+            )
         entry["jnp_decompress_ms"] = round(bench(decompress_minmax_uint8, q_j, mm_j), 3)
         entry["ok"] = entry["compress_bitwise_equal"] and entry["decompress_max_abs_diff"] < 1e-5
     except Exception as e:  # noqa: BLE001 — Mosaic rejection is a finding, not a crash
