@@ -1,6 +1,7 @@
 """Shared harness for the driver-facing benchmark scripts (bench.py,
-bench_bert.py): deadline watchdog, JSON-line emission protocol, stderr
-progress notes, persistent compilation cache.
+bench_bert.py, bench_moe.py, bench_scaling.py, bench_llama.py): tunnel
+preflight, deadline watchdog, JSON-line emission protocol, stderr progress
+notes, persistent compilation cache.
 
 Contract (what the driver parses): every script prints JSON lines to stdout;
 the LAST line is authoritative.  A provisional line lands as soon as the
