@@ -34,6 +34,17 @@ def _run_example(script, args, timeout=300):
     return r.stdout
 
 
+def test_flax_strategy_example():
+    """The three-call Flax adoption path trains and exits through to_flax."""
+    out = _run_example(
+        os.path.join(EXAMPLES, "flax_strategy", "main.py"),
+        ["--algorithm", "gradient_allreduce", "--steps", "12", "--batch", "32"],
+    )
+    assert "final step 12" in out
+    losses = [float(l.split("loss")[1]) for l in out.splitlines() if "loss" in l]
+    assert losses[-1] < losses[0], out  # it actually learned
+
+
 def test_mnist_real_idx(tmp_path):
     rng = np.random.RandomState(0)
     imgs = (rng.rand(256, 28, 28) * 255).astype(np.uint8)
