@@ -33,11 +33,6 @@ class BenchHarness:
         self._lock = threading.Lock()
         self._emitted = False
         threading.Thread(target=self._watchdog, daemon=True).start()
-        # Persistent compilation cache: a cold re-run skips the compile.
-        os.environ.setdefault(
-            "JAX_COMPILATION_CACHE_DIR",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-        )
         import jax
 
         if os.environ.get("BENCH_FORCE_CPU"):
@@ -45,10 +40,15 @@ class BenchHarness:
             # sitecustomize force-selects its platform via config.update,
             # which overrides JAX_PLATFORMS (see tests/conftest.py).
             jax.config.update("jax_platforms", "cpu")
-        jax.config.update(
-            "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+        # Persistent compilation cache: a cold re-run skips the compile.
+        # BAGUA_COMPILE_CACHE_DIR overrides; default is the repo-local dir.
+        from bagua_tpu.env import setup_compile_cache
+
+        setup_compile_cache(
+            default_dir=os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+            )
         )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     def _error_line(self, error: str) -> str:
         line = {
@@ -118,10 +118,18 @@ class BenchHarness:
         # Dead/ambiguous relay: bounded probes are ground truth (the relay
         # classification is heuristic — wait_healthy always runs at least
         # one real init attempt regardless of remaining budget).
+        #
+        # Fail-fast on the accepted-then-dropped signature: five rounds of
+        # history say a relay that accepts then drops has a dead upstream
+        # tunnel and never recovers mid-window, so burn ONE bounded probe as
+        # ground truth instead of four, emit the structured error record
+        # immediately, and salvage the session with the CPU-sim scaling
+        # bench rather than spending the whole deadline on retries.
         deadline = self.t0 + float(os.environ.get("BENCH_DEADLINE_SEC", "420"))
+        fail_fast = relay == "accepted-then-dropped"
         result = tpu_probe.wait_healthy(
-            attempts=4, cap_s=50.0, note=self.note, deadline=deadline - 90.0,
-            relay=relay,
+            attempts=1 if fail_fast else 4, cap_s=50.0, note=self.note,
+            deadline=deadline - 90.0, relay=relay,
         )
         if result["ok"]:
             # Settle before claiming: in the r4 session the step launched 3s
@@ -131,11 +139,63 @@ class BenchHarness:
             time.sleep(5.0)
             self.note("preflight: probe healthy — proceeding to backend init")
             return
+        err = None
         with self._lock:
             if not self._emitted:
-                print(self._error_line(tpu_probe.failure_summary(result)), flush=True)
+                err = self._error_line(tpu_probe.failure_summary(result))
+                print(err, flush=True)
                 self._emitted = True
+        if fail_fast and err is not None:
+            self._cpu_sim_fallback(err)
         os._exit(3)
+
+    def _cpu_sim_fallback(self, error_line: str) -> None:
+        """Dead tunnel salvage: run the scaling bench on the 8-device CPU sim
+        so the session still yields a real (if simulated) measurement.  The
+        fallback's JSON lines are forwarded tagged ``"fallback": "cpu-sim"``,
+        and the structured error record is re-printed LAST so the driver's
+        last-line parse still sees this metric's abort, not a foreign one."""
+        import subprocess
+
+        script = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_scaling.py"
+        )
+        # Budget from the wall clock the fail-fast just saved: the child gets
+        # a deadline short enough that ITS watchdog emits (provisional width
+        # lines land as they complete) before our kill, and everything ends
+        # before this harness's own watchdog thread can os._exit mid-forward.
+        watchdog_wall = (
+            self.t0 + float(os.environ.get("BENCH_DEADLINE_SEC", "420")) + 60.0
+        )
+        remaining = watchdog_wall - time.perf_counter() - 30.0
+        child_deadline = max(120.0, remaining - 90.0)
+        env = dict(os.environ)
+        env.update(
+            BENCH_FORCE_CPU="1",  # fallback preflight short-circuits: no recursion
+            BENCH_BATCH_PER_CHIP="4",
+            BENCH_IMAGE_SIZE="64",
+            XLA_FLAGS="--xla_force_host_platform_device_count=8",
+            BENCH_DEADLINE_SEC=str(int(child_deadline)),
+        )
+        self.note(
+            "fail-fast: tunnel dead — falling back to CPU-sim scaling bench "
+            f"({child_deadline:.0f}s budget)"
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, script], env=env, capture_output=True,
+                text=True, timeout=child_deadline + 80.0,
+            )
+            for line in proc.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                rec["fallback"] = "cpu-sim"
+                print(json.dumps(rec), flush=True)
+        except Exception as e:  # noqa: BLE001 — salvage must not mask the abort
+            self.note(f"cpu-sim fallback failed: {type(e).__name__}: {e}")
+        print(error_line, flush=True)
 
     def guard(self, main_fn) -> None:
         """Run the benchmark body; on ANY exception emit a parseable error

@@ -105,6 +105,30 @@ class AlgorithmImpl:
     def on_step_end(self, params, state, ctx: StepContext):
         return params, state
 
+    # -- overlap execution mode ---------------------------------------------
+
+    #: Algorithms that implement :meth:`overlap_exchange` set this True; the
+    #: engine's ``overlap="auto"`` resolves on it.  Algorithms that leave it
+    #: False keep the monolithic :meth:`transform_gradients` path regardless
+    #: of the engine knob (explicit ``overlap=True`` is rejected at init).
+    supports_overlap = False
+
+    def overlap_exchange(self, bucket_idx: int, grads, ctx: StepContext):
+        """Exchange ONE bucket's gradients from inside the backward pass.
+
+        Called by the per-bucket ``custom_vjp`` backward rule the engine
+        installs in overlap mode (:func:`bagua_tpu.bucket.wrap_params_for_overlap`):
+        ``grads`` is the list of this bucket's gradient leaves in slot order,
+        complete at this point of the backward computation; return them
+        exchanged (same structure/shapes/dtypes).  When overlap is on the
+        engine does NOT call :meth:`transform_gradients` — this hook subsumes
+        it bucket-by-bucket.  :meth:`transform_gradients` remains the
+        fallback whenever overlap is off or unsupported."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement overlap_exchange "
+            "(supports_overlap is False); run with overlap=False or 'auto'"
+        )
+
     # -- host-side integration (non-traced) ----------------------------------
 
     #: Optional ``threading.Lock``.  When set, the engine serializes step

@@ -38,9 +38,12 @@ from bagua_tpu.communication import (
     allreduce_inplace,
     hierarchical_allreduce_inplace,
 )
+from bagua_tpu.utils import from_bagua_datatype
 
 
 class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
+    supports_overlap = True
+
     def __init__(
         self,
         process_group,
@@ -89,6 +92,31 @@ class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
             self._from_wire(reduce(self._to_wire(flat), op=op), flat) for flat in flats
         ]
         return ctx.plan.debucketize(out, grads), params, state
+
+    def overlap_exchange(self, bucket_idx: int, grads, ctx: StepContext):
+        # One bucket's exchange, issued from inside the backward pass (the
+        # engine's custom_vjp rule).  Same wire program per bucket as
+        # transform_gradients — tuple fuse emits one variadic all-reduce over
+        # the leaves, flat fuse materializes the padded bucket buffer first —
+        # but anchored at the ops producing this bucket's cotangents instead
+        # of after the whole backward.
+        spec = ctx.plan.specs[bucket_idx]
+        op = ReduceOp.AVG if self.average else ReduceOp.SUM
+        reduce = hierarchical_allreduce_inplace if self.hierarchical else allreduce_inplace
+        if self.fuse == "tuple":
+            grads = list(grads)
+            return self._from_wire(reduce(self._to_wire(grads), op=op), grads)
+        parts = [g.reshape(-1) for g in grads]
+        used = sum(p.shape[0] for p in parts)
+        if used < spec.numel:
+            parts.append(
+                jnp.zeros((spec.numel - used,), from_bagua_datatype(spec.dtype))
+            )
+        flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        out = self._from_wire(reduce(self._to_wire(flat), op=op), flat)
+        return [
+            out[s.offset : s.offset + s.numel].reshape(s.shape) for s in spec.slots
+        ]
 
 
 class GradientAllReduceAlgorithm(Algorithm):

@@ -236,6 +236,28 @@ class BucketPlan:
 
     # -- introspection ------------------------------------------------------
 
+    def backward_order(self) -> List[int]:
+        """Bucket indices in expected gradient-readiness order.
+
+        Buckets are filled in forward (tree-leaf registration) order, so
+        during backward the gradients of the *last* bucket's tensors complete
+        first — the reverse-topological order the reference's scheduler
+        learns from backward-hook spans (``autotune_service.py:274-294``).
+        Sort key: each bucket's latest leaf position in treedef order,
+        descending.  The actual issue order on device is set by XLA's data
+        dependences (each overlap collective hangs off the op producing its
+        cotangents), so this is the host-side view used for wrapping order
+        and introspection, not a schedule the runtime must obey."""
+        dummy = self._treedef.unflatten(range(self._treedef.num_leaves))
+        pos = {
+            jax.tree_util.keystr(p): i
+            for i, (p, _) in enumerate(jax.tree_util.tree_flatten_with_path(dummy)[0])
+        }
+        return sorted(
+            range(len(self.specs)),
+            key=lambda bi: -max(pos.get(s.name, -1) for s in self.specs[bi].slots),
+        )
+
     def declarations(self) -> List[List[TensorDeclaration]]:
         return [spec.declarations() for spec in self.specs]
 
@@ -248,6 +270,52 @@ class BucketPlan:
 
     def __repr__(self) -> str:
         return f"BucketPlan(buckets={[(len(s.slots), s.numel, s.dtype) for s in self.specs]})"
+
+
+def _make_overlap_identity(bucket_idx: int, exchange_fn):
+    """A variadic identity whose backward rule runs one bucket's exchange.
+
+    Forward: pass the bucket's parameter leaves through untouched.  Backward:
+    hand the incoming cotangents (the bucket's gradients, complete at this
+    point of the backward pass) to ``exchange_fn`` and emit its result as the
+    parameter cotangents.  Because the collective inside ``exchange_fn`` is a
+    *consumer of these specific cotangents*, XLA anchors it right after the
+    ops that produced them — bucket k's all-reduce issues while the backward
+    of earlier layers is still running (the fused computation-collective
+    placement of arXiv:2305.06942, without a scheduler thread)."""
+
+    @jax.custom_vjp
+    def ident(*leaves):
+        return leaves
+
+    def fwd(*leaves):
+        return leaves, None
+
+    def bwd(_, cts):
+        return tuple(exchange_fn(bucket_idx, list(cts)))
+
+    ident.defvjp(fwd, bwd)
+    return ident
+
+
+def wrap_params_for_overlap(plan: BucketPlan, params, exchange_fn):
+    """Wrap each bucket's parameter leaves in a gradient-exchanging identity.
+
+    ``exchange_fn(bucket_idx, grads) -> grads`` receives the bucket's
+    gradient leaves in slot order and returns them exchanged (an algorithm's
+    ``overlap_exchange`` partially applied with its step context).  Leaves
+    outside every bucket (excluded by a ``dp_filter``) pass through
+    unwrapped, so their gradients stay local exactly as on the monolithic
+    path.  Traceable; called inside the loss function ahead of
+    ``value_and_grad``."""
+    groups = plan.group_leaves(params)
+    wrapped = []
+    for bi in plan.backward_order():
+        spec = plan.specs[bi]
+        leaves = [groups[bi][s.name] for s in spec.slots]
+        new_leaves = _make_overlap_identity(bi, exchange_fn)(*leaves)
+        wrapped.append({s.name: l for s, l in zip(spec.slots, new_leaves)})
+    return plan.ungroup_leaves(wrapped, params)
 
 
 def split_declarations(

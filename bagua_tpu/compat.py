@@ -1,0 +1,72 @@
+"""JAX version graft: present one API surface across the JAX versions the
+container fleet actually ships.
+
+The codebase targets the current public names (``jax.shard_map`` with its
+``check_vma`` knob, ``jax.lax.axis_size``).  Older runtimes (<= 0.4.x) only
+have ``jax.experimental.shard_map.shard_map`` (whose knob is spelled
+``check_rep``) and no ``axis_size`` — on those, importing :mod:`bagua_tpu`
+installs thin forwarders onto the ``jax`` namespace so every call site (the
+engine, the parallel layers, the test-suite's direct ``jax.shard_map`` uses)
+works unmodified.  On runtimes that already provide the names this module is
+a no-op, so upgrading JAX silently sheds the graft.
+"""
+
+import jax
+
+
+def _shard_map_forwarder():
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    import inspect
+
+    accepts_check_rep = "check_rep" in inspect.signature(_shard_map).parameters
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kwargs):
+        if check_vma is not None and accepts_check_rep:
+            # same semantics, pre-rename spelling (check_rep -> check_vma)
+            kwargs.setdefault("check_rep", check_vma)
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+
+    return shard_map
+
+
+def _axis_size(axis_name):
+    """``lax.axis_size`` backfill: ``psum(1, axis)`` folds to the static
+    mesh-axis size at trace time (the long-standing idiom the primitive
+    replaced)."""
+    if isinstance(axis_name, (tuple, list)):
+        n = 1
+        for a in axis_name:
+            n *= _axis_size(a)
+        return n
+    return jax.lax.psum(1, axis_name)
+
+
+def _distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized`` backfill: the distributed client
+    lives on the private global state in older runtimes."""
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except (ImportError, AttributeError):
+        return False
+
+
+def install() -> None:
+    """Idempotently graft missing public names onto ``jax``."""
+    if not hasattr(jax, "shard_map"):
+        try:
+            jax.shard_map = _shard_map_forwarder()
+        except ImportError:  # no experimental fallback either: leave as-is
+            pass
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size
+    if not hasattr(jax.distributed, "is_initialized"):
+        jax.distributed.is_initialized = _distributed_is_initialized
+
+
+install()

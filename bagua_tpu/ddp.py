@@ -32,7 +32,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
-from bagua_tpu.bucket import BucketPlan
+from bagua_tpu.bucket import BucketPlan, wrap_params_for_overlap
 from bagua_tpu.communication import ALL_AXES, BaguaProcessGroup, get_default_group
 from bagua_tpu.env import get_default_bucket_size
 from bagua_tpu.utils import SpeedMeter
@@ -76,6 +76,16 @@ class DistributedDataParallel:
             integration passes ``lambda name: "experts" not in name`` — the
             analog of the reference excluding expert params from DP bucketing
             (``bagua_distributed.py:172``, ``moe/utils.py:4-7``).
+        overlap: execution mode for the gradient exchange.  ``False`` keeps
+            the monolithic path (one ``transform_gradients`` call after the
+            whole backward pass).  ``True`` runs per-bucket collectives from
+            *inside* the backward computation via a ``custom_vjp`` identity
+            per bucket (:func:`bagua_tpu.bucket.wrap_params_for_overlap`),
+            so bucket k's all-reduce overlaps with the still-running backward
+            of earlier layers — BAGUA's bucketed-overlap relaxation, realized
+            through XLA's latency-hiding scheduler rather than a scheduler
+            thread.  Requires ``impl.supports_overlap``.  ``"auto"``
+            (default) enables it exactly when the algorithm supports it.
     """
 
     def __init__(
@@ -86,6 +96,7 @@ class DistributedDataParallel:
         process_group: Optional[BaguaProcessGroup] = None,
         bucket_size_bytes: Optional[int] = None,
         dp_filter: Optional[Callable[[str], bool]] = None,
+        overlap="auto",
     ):
         self.loss_fn = loss_fn
         self.group = process_group or get_default_group()
@@ -105,6 +116,21 @@ class DistributedDataParallel:
         self.optimizer = optimizer
         self.bucket_size_bytes = bucket_size_bytes or get_default_bucket_size()
         self.dp_filter = dp_filter
+        if overlap not in (True, False, "auto"):
+            raise ValueError(f"overlap must be True, False or 'auto', got {overlap!r}")
+        if overlap is True:
+            if not getattr(self.impl, "supports_overlap", False):
+                raise ValueError(
+                    f"{type(self.impl).__name__} does not implement "
+                    "overlap_exchange; pass overlap=False or 'auto'"
+                )
+            if getattr(self.impl, "holds_bucketized_state", False):
+                raise ValueError(
+                    f"{type(self.impl).__name__} keeps per-bucket state; its "
+                    "exchange cannot be split into independent backward-time "
+                    "bucket collectives — pass overlap=False or 'auto'"
+                )
+        self.overlap = overlap
         self.plan: Optional[BucketPlan] = None
         self._step_fns = {}
         self._host_step: Optional[int] = None  # seeded from state on first step
@@ -181,11 +207,28 @@ class DistributedDataParallel:
             params = jax.tree.map(np.asarray, params)
         return jax.jit(build, out_shardings=sharding)(params)
 
+    # -- execution mode -----------------------------------------------------
+
+    @property
+    def overlap_enabled(self) -> bool:
+        """The resolved execution mode for the next compiled step.  ``"auto"``
+        resolves to True exactly when the algorithm can run its exchange
+        per-bucket inside backward (and holds no per-bucket state whose
+        chunk semantics a split exchange would break)."""
+        if self.overlap == "auto":
+            return bool(getattr(self.impl, "supports_overlap", False)) and not (
+                getattr(self.impl, "holds_bucketized_state", False)
+            )
+        return bool(self.overlap)
+
     # -- re-bucketing (autotune) -------------------------------------------
 
     def rebucket(self, plan: BucketPlan) -> None:
         """Adopt a new bucket plan; next step re-jits (reference
-        ``_reset_buckets``)."""
+        ``_reset_buckets``).  Under overlap mode the per-bucket ``custom_vjp``
+        wrappers are re-derived from the new plan at the next ``_build_step``
+        (wrapping happens inside the step trace), so re-bucketing re-wraps
+        correctly with no extra bookkeeping."""
         if getattr(self.impl, "holds_bucketized_state", False):
             raise ValueError(
                 f"{type(self.impl).__name__} keeps per-bucket state; "
@@ -200,6 +243,7 @@ class DistributedDataParallel:
 
     def _build_step(self, variant: str):
         impl, plan, group = self.impl, self.plan, self.group
+        overlap = self.overlap_enabled
 
         def local_step(state: TrainState, batch):
             params, opt_state, algo_state, step = (
@@ -211,10 +255,25 @@ class DistributedDataParallel:
             ctx = StepContext(group=group, step=step, plan=plan, extras={"variant": variant})
 
             params, algo_state = impl.on_step_start(params, algo_state, ctx)
-            loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
-            grads, params, algo_state = impl.transform_gradients(
-                grads, params, algo_state, ctx
-            )
+            if overlap:
+                # Per-bucket exchange rides the backward pass: each bucket's
+                # collective hangs off the custom_vjp that receives its
+                # cotangents, so it issues the moment those gradients are
+                # complete — while earlier layers' backward is still running.
+                # overlap_exchange subsumes transform_gradients here.
+                def overlapped_loss(p, b):
+                    wrapped = wrap_params_for_overlap(
+                        plan, p,
+                        lambda bi, leaves: impl.overlap_exchange(bi, leaves, ctx),
+                    )
+                    return self.loss_fn(wrapped, b)
+
+                loss, grads = jax.value_and_grad(overlapped_loss)(params, batch)
+            else:
+                loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
+                grads, params, algo_state = impl.transform_gradients(
+                    grads, params, algo_state, ctx
+                )
             if getattr(impl, "skips_optimizer_update", False):
                 # Accumulating algorithms (no_sync analog) apply the optimizer
                 # only on their boundary steps — a zero-grad update would
@@ -427,6 +486,7 @@ class AutotuneSession:
             current_wire_bf16=(
                 getattr(ddp.impl, "wire_dtype", None) == jnp.dtype(jnp.bfloat16)
             ),
+            current_overlap=ddp.overlap_enabled,
         )
         from bagua_tpu.observability import SpanRecorder
 
@@ -492,4 +552,14 @@ class AutotuneSession:
             want = jnp.dtype(jnp.bfloat16) if hp.wire_bf16 else None
             if want != self.ddp.impl.wire_dtype:
                 self.ddp.impl.wire_dtype = want
+                self.ddp._step_fns = {}
+        # Execution-mode knob, same tri-state contract as wire_bf16: only
+        # algorithms that can run their exchange per-bucket inside backward
+        # participate; ``hp.overlap is None`` = dimension not tuned, leave a
+        # user-configured mode untouched.
+        if hp.overlap is not None and getattr(
+            self.ddp.impl, "supports_overlap", False
+        ):
+            if bool(hp.overlap) != self.ddp.overlap_enabled:
+                self.ddp.overlap = bool(hp.overlap)
                 self.ddp._step_fns = {}

@@ -71,6 +71,11 @@ class BaguaHyperparameter(BaseModel):
     #: live proposals from a ``tune_wire_dtype=True`` service, which then
     #: owns the knob.
     wire_bf16: Optional[bool] = None
+    #: execution-mode knob: run each bucket's collective from inside the
+    #: backward pass (custom_vjp per bucket) instead of one monolithic
+    #: exchange after it.  Same tri-state contract as ``wire_bf16``: ``None``
+    #: means the service is not tuning this dimension.
+    overlap: Optional[bool] = None
 
     def update(self, param_dict: Dict) -> "BaguaHyperparameter":
         tmp = self.model_dump()

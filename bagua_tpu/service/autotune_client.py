@@ -46,16 +46,18 @@ class AutotuneClient:
     def register_tensors(
         self, model_name: str, tensor_list: List[TensorDeclaration],
         current_wire_bf16: bool = False,
+        current_overlap: bool = False,
     ) -> BaguaHyperparameter:
         resp = self._post(
             "/api/v1/register_tensors",
             {
                 "model_name": model_name,
                 "tensor_list": [td.model_dump() for td in tensor_list],
-                # the wire dtype the scores will initially be measured under
-                # (a tune_wire_dtype service labels its first GP sample with
-                # this, instead of assuming f32)
+                # the wire dtype / execution mode the scores will initially
+                # be measured under (a tuning service labels its first GP
+                # sample with these, instead of assuming f32 / monolithic)
                 "current_wire_bf16": bool(current_wire_bf16),
+                "current_overlap": bool(current_overlap),
             },
         )
         return BaguaHyperparameter(**resp["recommended_hyperparameters"])

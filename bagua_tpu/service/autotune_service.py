@@ -39,6 +39,7 @@ class AutotuneService:
         is_output_autotune_log: bool = False,
         default_bucket_size: int = 10 * 1024 ** 2,
         tune_wire_dtype: bool = False,
+        tune_overlap: bool = False,
     ):
         self.world_size = world_size
         self.autotune_level = autotune_level
@@ -48,6 +49,7 @@ class AutotuneService:
         self.is_output_autotune_log = is_output_autotune_log
         self.default_bucket_size = default_bucket_size
         self.tune_wire_dtype = tune_wire_dtype
+        self.tune_overlap = tune_overlap
 
         self._lock = threading.Lock()
         self._managers: Dict[str, AutotuneTaskManager] = {}
@@ -72,6 +74,7 @@ class AutotuneService:
             self._managers[model_name] = AutotuneTaskManager(
                 model_name, self.is_output_autotune_log,
                 tune_wire_dtype=self.tune_wire_dtype,
+                tune_overlap=self.tune_overlap,
             )
             self._start_time[model_name] = time.time()
             self._last_sample_time[model_name] = 0.0
@@ -91,20 +94,27 @@ class AutotuneService:
                     {
                         "bucket_size_2p": max(10, self.default_bucket_size.bit_length() - 1),
                         "is_hierarchical_reduce": 0,
-                        # label the pre-tuning samples with the wire dtype
-                        # they are actually measured under (the client may
-                        # have preconfigured bf16)
+                        # label the pre-tuning samples with the wire dtype /
+                        # execution mode they are actually measured under
+                        # (the client may have preconfigured bf16 or overlap)
                         "wire_bf16": int(bool(payload.get("current_wire_bf16", False))),
+                        "overlap": int(bool(payload.get("current_overlap", False))),
                     }
                 )
                 mgr.hyperparameter.bucket_size = self.default_bucket_size
-            elif self.tune_wire_dtype and mgr.sampling_counter == 0:
+            elif mgr.sampling_counter == 0:
                 # Re-registration before any GP proposal: the restarted gang
-                # may have changed its preconfigured wire dtype — refresh the
-                # label so its pre-tuning samples credit the right wire_bf16.
-                mgr.hyperparameter.wire_bf16 = bool(
-                    payload.get("current_wire_bf16", False)
-                )
+                # may have changed its preconfigured wire dtype / execution
+                # mode — refresh the labels so its pre-tuning samples credit
+                # the right knob values.
+                if self.tune_wire_dtype:
+                    mgr.hyperparameter.wire_bf16 = bool(
+                        payload.get("current_wire_bf16", False)
+                    )
+                if self.tune_overlap:
+                    mgr.hyperparameter.overlap = bool(
+                        payload.get("current_overlap", False)
+                    )
             # (Re-)registration = a (re)started gang whose train_iter restarts
             # from 0: reset the per-rank ask ratchet and re-base the
             # effective-from history on the current hyperparameters, or new

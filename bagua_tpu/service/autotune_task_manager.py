@@ -24,17 +24,25 @@ class AutotuneTaskManager:
         model_name: str,
         is_output_autotune_log: bool = False,
         tune_wire_dtype: bool = False,
+        tune_overlap: bool = False,
     ):
         self.model_name = model_name
         self.tensor_list: List[TensorDeclaration] = []
         self.hyperparameter = BaguaHyperparameter()
         self.tune_wire_dtype = tune_wire_dtype
+        self.tune_overlap = tune_overlap
         params = [IntParam("bucket_size_2p", 10, 31), BoolParam("is_hierarchical_reduce")]
         if tune_wire_dtype:
             # opt-in third dimension: bf16 wire exchange trades ~3 decimal
             # digits of gradient mantissa for half the allreduce bytes —
             # a numerics-affecting knob, so never explored silently
             params.append(BoolParam("wire_bf16"))
+        if tune_overlap:
+            # execution-mode dimension: backward-overlapped per-bucket
+            # collectives vs one monolithic exchange.  Numerically neutral
+            # but interacts with bucket_size (more buckets = finer overlap,
+            # more collective launches), so it is worth co-tuning.
+            params.append(BoolParam("overlap"))
         self.optimizer = BayesianOptimizer(params)
         self.sampling_counter = 0
         self.best_score = float("-inf")
@@ -67,6 +75,7 @@ class AutotuneTaskManager:
             # None = dimension not tuned; the client must not touch a
             # user-configured wire dtype in that case
             wire_bf16=bool(param_dict.get("wire_bf16", 0)) if self.tune_wire_dtype else None,
+            overlap=bool(param_dict.get("overlap", 0)) if self.tune_overlap else None,
         )
 
     # -- optimizer loop ----------------------------------------------------
@@ -79,6 +88,8 @@ class AutotuneTaskManager:
         }
         if self.tune_wire_dtype:
             current["wire_bf16"] = int(bool(self.hyperparameter.wire_bf16))
+        if self.tune_overlap:
+            current["overlap"] = int(bool(self.hyperparameter.overlap))
         self.optimizer.tell(current, score)
         self.sampling_counter += 1
         if score > self.best_score:

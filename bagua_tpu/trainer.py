@@ -49,6 +49,15 @@ class Trainer:
         profile_dir: Optional[str] = None,
         profile_steps: Tuple[int, int] = (10, 13),
     ):
+        # Env-gated persistent compile cache (BAGUA_COMPILE_CACHE_DIR): a
+        # restarted trainer deserializes the step executable instead of
+        # paying the multi-second XLA compile again.  No default dir — the
+        # Trainer never writes a cache the user didn't ask for.
+        from bagua_tpu.env import setup_compile_cache
+
+        cache_dir = setup_compile_cache()
+        if cache_dir:
+            logger.info("persistent compilation cache at %s", cache_dir)
         self.ddp = DistributedDataParallel(
             loss_fn, optimizer, algorithm, process_group=process_group, dp_filter=dp_filter
         )

@@ -11,6 +11,12 @@ few steps with compile logging hooked and asserts:
 * no post-warmup step slower than ``--stall-factor`` x the steady median
   (catches silent recompiles and layout-copy stalls regardless of logging).
 
+It also measures the persistent compilation cache (gated by
+``BAGUA_COMPILE_CACHE_DIR``, falling back to the repo-local ``.jax_cache``):
+after the timed loop the in-memory executable cache is dropped and the step
+rebuilt — with the disk cache on, the rebuild deserializes instead of
+recompiling, and the cold-vs-warm compile seconds land in the JSON artifact.
+
 Runs on any backend: CPU sim for CI (``--cpu``), the real chip when the
 tunnel is up.  Writes ``COMPILE_STABILITY.json`` at the repo root with
 per-step timings.
@@ -26,7 +32,8 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+if REPO not in sys.path:  # runnable from any cwd without an editable install
+    sys.path.insert(0, REPO)
 
 
 class _CompileCounter(logging.Handler):
@@ -63,7 +70,13 @@ def main():
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
+    from bagua_tpu.env import setup_compile_cache
+
+    # min_compile_secs=0: persist even the CPU-sim mlp step (< 1s compile)
+    # so the cold-vs-warm record is meaningful on every backend.
+    cache_dir = setup_compile_cache(
+        default_dir=os.path.join(REPO, ".jax_cache"), min_compile_secs=0.0
+    )
     jax.config.update("jax_log_compiles", True)
     counter = _CompileCounter()
     # Root "jax" logger: survives internal module renames across JAX versions.
@@ -114,6 +127,22 @@ def main():
         state, losses = ddp.train_step(state, batch)
         jax.block_until_ready(losses)
         times.append(round(time.perf_counter() - t0, 4))
+
+    # Cold-vs-warm persistent-cache measurement: drop the in-memory
+    # executable cache and rebuild the step from scratch.  With the disk
+    # cache enabled the rebuild deserializes the executable instead of
+    # recompiling, so warm << cold; with it disabled the two match.  The
+    # snapshot of the compile counter is taken FIRST — the warm rebuild
+    # legitimately logs a second "Compiling", which is not a recompile of
+    # the steady loop.
+    n_compiles = len(counter.compiles)
+    cold_compile_s = times[0]
+    jax.clear_caches()
+    ddp._step_fns = {}
+    t0 = time.perf_counter()
+    state, losses = ddp.train_step(state, batch)
+    jax.block_until_ready(losses)
+    warm_compile_s = round(time.perf_counter() - t0, 4)
     ddp.shutdown()
 
     steady = times[2:] or times[1:]
@@ -127,16 +156,19 @@ def main():
         "n_devices": len(jax.devices()),
         "model": args.model,
         "step_times_s": times,
-        "local_step_compiles": len(counter.compiles),
+        "local_step_compiles": n_compiles,
+        "compile_cache_dir": cache_dir,
+        "cold_compile_s": cold_compile_s,
+        "warm_compile_s": warm_compile_s,
         "stalled_steps": stalled,
-        "ok": len(counter.compiles) == 1 and not stalled,
+        "ok": n_compiles == 1 and not stalled,
         # Distinguish WHY the gate failed: 0 detected compiles with clean
         # timings means the log hook missed (JAX changed its message), not
         # that the invariant broke.
         "failure_reason": (
             "stall" if stalled
-            else "recompile" if len(counter.compiles) > 1
-            else "compile_log_not_detected" if not counter.compiles
+            else "recompile" if n_compiles > 1
+            else "compile_log_not_detected" if not n_compiles
             else None
         ),
     }

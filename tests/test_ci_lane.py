@@ -1,0 +1,50 @@
+"""Tier-1 lane for the CI perf gates: the overlap wire-pattern assertion.
+
+Drives ``ci/perf_audit.py --quick --model=mlp --ddp-only`` as a subprocess —
+the same entry point CI uses — so a regression in the overlap census (bucket
+collectives merged back into a monolithic tail, or wire bytes drifting from
+the monolithic path) fails the ``not slow`` suite, not just a nightly.  The
+mlp model keeps this at seconds scale; the VGG16 audit stays in the full
+``ci/perf_audit.py`` run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_perf_audit_quick_overlap_census(tmp_path):
+    out = tmp_path / "audit"
+    env = dict(os.environ)
+    # the subprocess builds its own 8-device CPU sim; don't inherit a
+    # conflicting device count from the test session
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "ci", "perf_audit.py"),
+            "--quick", "--model=mlp", "--ddp-only", "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (
+        f"perf_audit --quick failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    assert "overlap wire-pattern assertion passed" in proc.stderr
+
+    with open(str(out) + ".json") as f:
+        audit = json.load(f)
+    rows = audit["ddp"]
+    for name in (
+        "gradient_allreduce", "gradient_allreduce[flat]",
+        "gradient_allreduce[overlap]", "gradient_allreduce[overlap,flat]",
+    ):
+        assert name in rows, f"missing audit row {name}"
+    ov_flat = rows["gradient_allreduce[overlap,flat]"]
+    assert ov_flat["overlap"] is True
+    assert ov_flat["census"]["all-reduce"]["count"] == ov_flat["buckets"]
+    assert ov_flat["buckets"] < ov_flat["slots"]  # multi-slot plan: the
+    # per-bucket count is genuinely distinguishable from per-leaf

@@ -1,0 +1,189 @@
+"""Backward-overlapped execution mode: parity, wire pattern, guard rails.
+
+The overlap mode anchors each bucket's collective inside the backward pass
+via a per-bucket ``custom_vjp`` identity (``bucket.wrap_params_for_overlap``).
+These tests pin its contract on the 8-device CPU sim:
+
+* numerics match the monolithic ``transform_gradients`` path to float
+  tolerance for every fuse × wire-dtype combination;
+* the compiled step carries exactly one ``all-reduce`` per bucket;
+* ``rebucket()`` re-wraps against the new plan;
+* algorithms without ``overlap_exchange`` (or with per-bucket state) reject
+  explicit ``overlap=True`` and resolve ``"auto"`` to the monolithic path.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bagua_tpu.algorithms import build_algorithm
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.bucket import BucketPlan
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+N_STEPS = 3
+GLOBAL_BATCH = 32
+DIM_IN, DIM_OUT = 12, 4
+LAYERS = [DIM_IN, 16, 16, DIM_OUT]
+
+
+def make_data(seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(N_STEPS, GLOBAL_BATCH, DIM_IN).astype(np.float32)
+    ys = rng.randn(N_STEPS, GLOBAL_BATCH, DIM_OUT).astype(np.float32)
+    return xs, ys
+
+
+def make_ddp(group, overlap, fuse="tuple", wire=None, bucket_size=1 << 9):
+    return DistributedDataParallel(
+        mse_loss,
+        optax.sgd(0.1),
+        GradientAllReduceAlgorithm(fuse=fuse, wire_dtype=wire),
+        process_group=group,
+        bucket_size_bytes=bucket_size,  # small: forces several buckets
+        overlap=overlap,
+    )
+
+
+def run_steps(ddp, params, xs, ys):
+    state = ddp.init(params)
+    for i in range(len(xs)):
+        state, _ = ddp.train_step(state, (jnp.asarray(xs[i]), jnp.asarray(ys[i])))
+    return state
+
+
+def count_allreduces(text):
+    return sum(
+        1
+        for line in text.splitlines()
+        if re.search(r"\ball-reduce(-start)?\(", line)
+    )
+
+
+@pytest.mark.parametrize("wire", [None, jnp.bfloat16], ids=["f32", "bf16"])
+@pytest.mark.parametrize("fuse", ["tuple", "flat"])
+def test_overlap_matches_monolithic(group, fuse, wire):
+    """Acceptance: overlap == monolithic to float tolerance for all four
+    fuse × wire-dtype combos.  Both paths run the same per-bucket cast →
+    reduce → cast-back, just anchored at different program points, so even
+    the bf16 wire pairs stay within a few ulps of each other."""
+    params = init_mlp(jax.random.PRNGKey(11), LAYERS)
+    xs, ys = make_data(seed=11)
+    finals = {}
+    for overlap in (False, True):
+        ddp = make_ddp(group, overlap, fuse=fuse, wire=wire)
+        state = run_steps(ddp, params, xs, ys)
+        assert ddp.plan.num_buckets > 1
+        assert ddp.overlap_enabled is overlap
+        finals[overlap] = ddp.params_unstacked(state)
+    tol = dict(rtol=1e-5, atol=1e-6) if wire is None else dict(rtol=1e-2, atol=1e-3)
+    for a, b in zip(jax.tree.leaves(finals[False]), jax.tree.leaves(finals[True])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), **tol)
+
+
+@pytest.mark.parametrize("fuse", ["tuple", "flat"])
+def test_census_one_allreduce_per_bucket(group, fuse):
+    """The overlap wire pattern: one collective per bucket, none merged into
+    a monolithic tail exchange (ci/perf_audit.py asserts the same on VGG16).
+    The flat fuse materializes each bucket buffer, so the count is exactly
+    ``len(plan.specs)`` on every backend.  The tuple fuse issues one
+    *variadic* psum per bucket; backends without variadic all-reduce
+    (XLA:CPU) legalize it to one all-reduce per operand — per-slot — so for
+    tuple we accept either form and additionally pin the overlap census to
+    the monolithic one (same wire ops, only their anchor moves)."""
+
+    def compiled_text(overlap):
+        ddp = make_ddp(group, overlap, fuse=fuse)
+        state = ddp.init(params)
+        fn = ddp._build_step(ddp.impl.step_variant(0))
+        lowered = fn.lower(state, (jnp.asarray(xs[0]), jnp.asarray(ys[0])))
+        return ddp.plan, lowered.compile().as_text()
+
+    params = init_mlp(jax.random.PRNGKey(12), LAYERS)
+    xs, ys = make_data(seed=12)
+    plan, text = compiled_text(True)
+    assert plan.num_buckets > 1
+    n = count_allreduces(text)
+    if fuse == "flat":
+        assert n == len(plan.specs)
+    else:
+        n_slots = sum(len(s.slots) for s in plan.specs)
+        assert n in (len(plan.specs), n_slots)
+    _, mono_text = compiled_text(False)
+    assert n == count_allreduces(mono_text)
+
+
+def test_backward_order_is_reverse_topological(group):
+    params = init_mlp(jax.random.PRNGKey(13), LAYERS)
+    plan = BucketPlan.from_tree(params, 1 << 9, align_elems=group.size)
+    assert plan.num_buckets > 1
+    order = plan.backward_order()
+    assert sorted(order) == list(range(plan.num_buckets))
+    # Leaf positions in treedef order; buckets must come out latest-first.
+    dummy = plan._treedef.unflatten(range(plan._treedef.num_leaves))
+    pos = {
+        jax.tree_util.keystr(p): i
+        for i, (p, _) in enumerate(jax.tree_util.tree_flatten_with_path(dummy)[0])
+    }
+    latest = [max(pos[s.name] for s in plan.specs[bi].slots) for bi in order]
+    assert latest == sorted(latest, reverse=True)
+
+
+def test_rebucket_rewraps_under_overlap(group):
+    """rebucket() under overlap mode must re-derive the custom_vjp wrappers
+    from the new plan: the recompiled step carries the new bucket count's
+    all-reduces and numerics still match the monolithic path."""
+    params = init_mlp(jax.random.PRNGKey(14), LAYERS)
+    xs, ys = make_data(seed=14)
+    ddp = make_ddp(group, True, fuse="flat")  # flat: exact per-bucket census
+    state = ddp.init(params)
+    state, _ = ddp.train_step(state, (jnp.asarray(xs[0]), jnp.asarray(ys[0])))
+    old_n = ddp.plan.num_buckets
+
+    new_plan = BucketPlan.from_tree(params, 1 << 20, align_elems=group.size)
+    ddp.rebucket(new_plan)
+    assert ddp.plan.num_buckets != old_n
+    fn = ddp._build_step(ddp.impl.step_variant(1))
+    text = fn.lower(state, (jnp.asarray(xs[1]), jnp.asarray(ys[1]))).compile().as_text()
+    assert count_allreduces(text) == len(new_plan.specs)
+
+    for i in range(1, N_STEPS):
+        state, _ = ddp.train_step(state, (jnp.asarray(xs[i]), jnp.asarray(ys[i])))
+
+    # Bucket layout never changes allreduce numerics, so the monolithic run
+    # without any rebucket is the oracle.
+    mono = make_ddp(group, False)
+    mono_state = run_steps(mono, params, xs, ys)
+    got, expect = ddp.params_unstacked(state), mono.params_unstacked(mono_state)
+    for a, b in zip(jax.tree.leaves(expect), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_overlap_rejected_without_support(group):
+    """Guard rails: explicit overlap=True needs overlap_exchange; per-bucket
+    state algorithms are rejected outright; 'auto' degrades to monolithic."""
+    with pytest.raises(ValueError, match="overlap_exchange"):
+        DistributedDataParallel(
+            mse_loss, optax.sgd(0.1), build_algorithm("decentralized"),
+            process_group=group, overlap=True,
+        )
+    with pytest.raises(ValueError):
+        DistributedDataParallel(
+            mse_loss, optax.sgd(0.1),
+            build_algorithm("low_precision_decentralized"),
+            process_group=group, overlap=True,
+        )
+    with pytest.raises(ValueError, match="overlap must be"):
+        make_ddp(group, "yes")
+
+    ddp = DistributedDataParallel(
+        mse_loss, optax.sgd(0.1), build_algorithm("decentralized"),
+        process_group=group, overlap="auto",
+    )
+    assert ddp.overlap_enabled is False
+    assert make_ddp(group, "auto").overlap_enabled is True
