@@ -4,6 +4,7 @@ from bagua_tpu.algorithms.base import (  # noqa: F401
     Algorithm,
     AlgorithmImpl,
     GlobalAlgorithmRegistry,
+    OverlapCapability,
     StepContext,
 )
 from bagua_tpu.algorithms.gradient_allreduce import (  # noqa: F401

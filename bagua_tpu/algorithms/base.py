@@ -57,6 +57,45 @@ class StepContext:
     extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
+@dataclasses.dataclass(frozen=True)
+class OverlapCapability:
+    """One algorithm's report on the backward-overlapped execution mode.
+
+    The engine's ``overlap="auto"`` and explicit ``overlap=True`` both
+    resolve against this — a per-algorithm capability report instead of the
+    blanket "supports_overlap and not holds_bucketized_state" heuristic,
+    so algorithms whose per-bucket state IS laid out on the bound plan
+    (low-precision decentralized) can opt in, and algorithms whose compiled
+    step changes shape across steps can opt out with a concrete reason.
+
+    ``mode`` tells the engine WHAT rides the backward pass:
+
+    * ``"gradient"`` — the exchange consumes each bucket's cotangents; the
+      engine wraps parameters in per-bucket ``custom_vjp`` identities and
+      the bwd rules call :meth:`AlgorithmImpl.overlap_exchange`.
+    * ``"weight"`` — the exchange moves *weights* (decentralized averaging);
+      weights don't data-depend on the backward, so the engine calls
+      :meth:`overlap_exchange` per bucket with both the bucket's gradients
+      (the anchor) and its parameter leaves after ``value_and_grad``.
+    * ``"post_step"`` — the exchange already runs per bucket after the
+      optimizer update (:meth:`on_step_end`); overlap only switches the
+      plan to multi-bucket granularity so each bucket's chain becomes
+      issuable as soon as its own update finishes.
+
+    ``auto`` gates the ``"auto"`` resolution separately from explicit
+    ``overlap=True``: auto must never change numerics, so algorithms whose
+    overlap output is not bitwise-identical to the monolithic path (chunk
+    boundaries move under a multi-bucket plan) set ``auto=False`` and stay
+    opt-in.  ``reason`` is the concrete rejection message (names the class
+    and the cause) surfaced by the engine when explicit ``overlap=True`` is
+    refused."""
+
+    supported: bool
+    mode: str = "gradient"
+    auto: bool = True
+    reason: str = ""
+
+
 class AlgorithmImpl:
     """A reified algorithm bound to a process group."""
 
@@ -108,26 +147,89 @@ class AlgorithmImpl:
     # -- overlap execution mode ---------------------------------------------
 
     #: Algorithms that implement :meth:`overlap_exchange` set this True; the
-    #: engine's ``overlap="auto"`` resolves on it.  Algorithms that leave it
-    #: False keep the monolithic :meth:`transform_gradients` path regardless
-    #: of the engine knob (explicit ``overlap=True`` is rejected at init).
+    #: engine resolves the mode through :meth:`overlap_capability`.
+    #: Algorithms that leave it False keep the monolithic
+    #: :meth:`transform_gradients` path regardless of the engine knob
+    #: (explicit ``overlap=True`` is rejected at init).
     supports_overlap = False
 
-    def overlap_exchange(self, bucket_idx: int, grads, ctx: StepContext):
-        """Exchange ONE bucket's gradients from inside the backward pass.
+    #: What the overlap mode exchanges per bucket — see
+    #: :class:`OverlapCapability` (``"gradient"`` | ``"weight"`` |
+    #: ``"post_step"``).
+    overlap_mode = "gradient"
 
-        Called by the per-bucket ``custom_vjp`` backward rule the engine
-        installs in overlap mode (:func:`bagua_tpu.bucket.wrap_params_for_overlap`):
-        ``grads`` is the list of this bucket's gradient leaves in slot order,
-        complete at this point of the backward computation; return them
-        exchanged (same structure/shapes/dtypes).  When overlap is on the
-        engine does NOT call :meth:`transform_gradients` — this hook subsumes
-        it bucket-by-bucket.  :meth:`transform_gradients` remains the
-        fallback whenever overlap is off or unsupported."""
-        raise NotImplementedError(
-            f"{type(self).__name__} does not implement overlap_exchange "
-            "(supports_overlap is False); run with overlap=False or 'auto'"
-        )
+    #: False for algorithms whose :meth:`step_variant` changes across steps:
+    #: the overlap wrappers are traced per variant, so a variant-switching
+    #: algorithm would re-anchor (and re-run) its exchange differently on
+    #: each recompile — ``overlap="auto"`` must never silently enable that.
+    stable_step_variant = True
+
+    def overlap_capability(self) -> OverlapCapability:
+        """The per-algorithm capability report the engine's ``overlap`` knob
+        resolves against (both ``"auto"`` and the explicit ``True``
+        validation).  The default covers the common cases with concrete,
+        class-naming reasons; algorithms with plan-dependent state that is
+        nonetheless per-bucket native (low-precision decentralized) override
+        it."""
+        name = type(self).__name__
+        if not getattr(self, "supports_overlap", False):
+            return OverlapCapability(
+                False,
+                reason=f"{name} does not implement overlap_exchange (no "
+                "per-bucket backward hook); pass overlap=False or 'auto'",
+            )
+        if not getattr(self, "stable_step_variant", True):
+            return OverlapCapability(
+                False,
+                reason=f"{name} switches its compiled step variant across "
+                "steps (step_variant); per-bucket backward anchors would be "
+                "re-traced inconsistently — pass overlap=False or 'auto'",
+            )
+        if getattr(self, "holds_bucketized_state", False):
+            return OverlapCapability(
+                False,
+                reason=f"{name} keeps per-bucket state; its exchange cannot "
+                "be split into independent backward-time bucket collectives "
+                "— pass overlap=False or 'auto'",
+            )
+        return OverlapCapability(True, mode=getattr(self, "overlap_mode", "gradient"))
+
+    def overlap_exchange(
+        self, bucket_idx: int, grads, ctx: StepContext, params_leaves=None
+    ):
+        """Exchange ONE bucket from inside (or anchored on) the backward pass.
+
+        ``"gradient"`` mode: called by the per-bucket ``custom_vjp`` backward
+        rule the engine installs
+        (:func:`bagua_tpu.bucket.wrap_params_for_overlap`): ``grads`` is the
+        list of this bucket's gradient leaves in slot order, complete at this
+        point of the backward computation; return them exchanged (same
+        structure/shapes/dtypes).  ``params_leaves`` is None.
+
+        ``"weight"`` mode: called by the engine after ``value_and_grad`` with
+        both the bucket's gradient leaves (the readiness anchor — tie the
+        collective to them with ``jax.lax.optimization_barrier`` so XLA
+        issues it as this bucket's cotangents arrive) and its parameter
+        leaves in ``params_leaves``; return the *exchanged parameter* leaves.
+
+        When overlap is on the engine does NOT call
+        :meth:`transform_gradients` — this hook (plus
+        :meth:`finalize_overlap`) subsumes it bucket-by-bucket.
+        :meth:`transform_gradients` remains the fallback whenever overlap is
+        off or unsupported."""
+        raise NotImplementedError(self.overlap_capability().reason or (
+            f"{type(self).__name__} does not implement overlap_exchange"
+        ))
+
+    def finalize_overlap(self, grads, params, state, ctx: StepContext):
+        """Post-backward stage of the overlap path: receives the per-bucket
+        exchanged values assembled back into the gradient tree (``"gradient"``
+        mode) or the untouched gradients (``"weight"``/``"post_step"``), and
+        may finish whatever whole-tree math :meth:`transform_gradients` runs
+        after its communication (QAdam's moment/bias-correction update).
+        Same signature/contract as :meth:`transform_gradients`; default is
+        the identity."""
+        return grads, params, state
 
     # -- host-side integration (non-traced) ----------------------------------
 

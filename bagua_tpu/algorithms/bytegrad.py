@@ -23,6 +23,7 @@ holds the same value.
 import jax.numpy as jnp
 
 from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
+from bagua_tpu.bucket import flatten_bucket_leaves, split_bucket_flat
 from bagua_tpu.communication import (
     INTER_AXIS,
     INTRA_AXIS,
@@ -32,17 +33,25 @@ from bagua_tpu.communication import (
     allgather_inplace,
     axis_size,
 )
-from bagua_tpu.kernels.minmax_uint8 import get_compressors
+from bagua_tpu.kernels.minmax_uint8 import get_compressors, get_fused_reducer
 
 
 def compressed_allreduce(
-    flat: jnp.ndarray, axes, average: bool = True, use_pallas=None
+    flat: jnp.ndarray, axes, average: bool = True, use_pallas=None,
+    compressors=None, fused_reducer=None,
 ) -> jnp.ndarray:
     """The scatter-gather compressed allreduce over ``axes`` (traced).
 
     ``use_pallas`` selects the quantizer implementation (None = auto: Pallas
-    kernels on TPU, jnp elsewhere — see ``kernels.get_compressors``)."""
-    compress_minmax_uint8, decompress_minmax_uint8 = get_compressors(use_pallas)
+    kernels on TPU, jnp elsewhere — see ``kernels.get_compressors``).
+    Callers on the hot path pass pre-resolved ``compressors`` /
+    ``fused_reducer`` (resolved once at algorithm construction) so the
+    evidence-file lookup never runs inside a trace."""
+    if compressors is None:
+        compressors = get_compressors(use_pallas)
+    if fused_reducer is None:
+        fused_reducer = get_fused_reducer(use_pallas)
+    compress_minmax_uint8, decompress_minmax_uint8 = compressors
     n = axis_size(axes)
     if n == 1:
         return flat
@@ -53,18 +62,18 @@ def compressed_allreduce(
     q_recv = alltoall_inplace(q, axis=axes)  # (n, chunk): everyone's chunk for me
     mm_recv = alltoall_inplace(mm, axis=axes)  # (n, 2)
 
-    x = decompress_minmax_uint8(q_recv, mm_recv)  # (n, chunk) float32
-    red = jnp.sum(x, axis=0, keepdims=True)
-    if average:
-        red = red / n
+    # Fused middle stages: decompress → float32 tree-sum → requantize, one
+    # kernel instead of three staged HBM passes (jnp composition elsewhere).
+    q2, mm2 = fused_reducer(q_recv, mm_recv, average=average)  # (1, chunk)
 
-    q2, mm2 = compress_minmax_uint8(red)  # (1, chunk)
     qg = allgather_inplace(q2, axis=axes, tiled=True)  # (n, chunk)
     mmg = allgather_inplace(mm2, axis=axes, tiled=True)  # (n, 2)
     return decompress_minmax_uint8(qg, mmg).reshape(-1).astype(flat.dtype)
 
 
 class ByteGradAlgorithmImpl(AlgorithmImpl):
+    supports_overlap = True
+
     def __init__(
         self, process_group, hierarchical: bool = True, average: bool = True,
         use_pallas=None,
@@ -72,33 +81,55 @@ class ByteGradAlgorithmImpl(AlgorithmImpl):
         super().__init__(process_group, hierarchical=hierarchical)
         self.average = average
         self.use_pallas = use_pallas
+        # Resolve the quantizer + fused-reducer implementations ONCE here:
+        # resolution reads the hardware evidence file, which must not run
+        # inside the per-bucket trace path on every compile.
+        self._compressors = get_compressors(use_pallas)
+        self._fused_reducer = get_fused_reducer(use_pallas)
+
+    def _exchange_flat(self, flat, spec):
+        """One bucket's exchange — the single wire program shared by the
+        monolithic and overlap paths (bitwise-identical outputs)."""
+        if spec.dtype not in ("f32", "f16", "bf16"):
+            # Non-float buckets fall back to plain allreduce, like the
+            # reference rejecting non-float tensors for compression.
+            op = ReduceOp.AVG if self.average else ReduceOp.SUM
+            return allreduce_inplace(flat, op=op)
+        if self.hierarchical and self.process_group.intra_size > 1:
+            intra = allreduce_inplace(flat, op=ReduceOp.SUM, axis=INTRA_AXIS)
+            red = compressed_allreduce(
+                intra, (INTER_AXIS,), average=False,
+                compressors=self._compressors, fused_reducer=self._fused_reducer,
+            )
+            if self.average:
+                red = red / self.process_group.size
+            return red.astype(flat.dtype)
+        return compressed_allreduce(
+            flat, (INTER_AXIS, INTRA_AXIS), self.average,
+            compressors=self._compressors, fused_reducer=self._fused_reducer,
+        )
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
         flats = ctx.plan.bucketize(grads)
-        out = []
-        for flat, spec in zip(flats, ctx.plan.specs):
-            if spec.dtype not in ("f32", "f16", "bf16"):
-                # Non-float buckets fall back to plain allreduce, like the
-                # reference rejecting non-float tensors for compression.
-                op = ReduceOp.AVG if self.average else ReduceOp.SUM
-                out.append(allreduce_inplace(flat, op=op))
-                continue
-            if self.hierarchical and self.process_group.intra_size > 1:
-                intra = allreduce_inplace(flat, op=ReduceOp.SUM, axis=INTRA_AXIS)
-                red = compressed_allreduce(
-                    intra, (INTER_AXIS,), average=False, use_pallas=self.use_pallas
-                )
-                if self.average:
-                    red = red / self.process_group.size
-                out.append(red.astype(flat.dtype))
-            else:
-                out.append(
-                    compressed_allreduce(
-                        flat, (INTER_AXIS, INTRA_AXIS), self.average,
-                        use_pallas=self.use_pallas,
-                    )
-                )
+        out = [
+            self._exchange_flat(flat, spec)
+            for flat, spec in zip(flats, ctx.plan.specs)
+        ]
         return ctx.plan.debucketize(out, grads), params, state
+
+    def overlap_exchange(
+        self, bucket_idx: int, grads, ctx: StepContext, params_leaves=None
+    ):
+        # One bucket's compressed pipeline, issued from this bucket's
+        # custom_vjp backward rule: both hierarchical legs (full-precision
+        # intra psum + compressed inter scatter-gather) anchor at the ops
+        # producing the bucket's cotangents, so XLA overlaps the wire with
+        # the rest of the backward.  Flattening here reproduces bucketize's
+        # padded layout exactly — same chunk boundaries, same quantizer
+        # inputs, bitwise-identical to the monolithic path.
+        spec = ctx.plan.specs[bucket_idx]
+        flat = flatten_bucket_leaves(grads, spec)
+        return split_bucket_flat(self._exchange_flat(flat, spec), spec)
 
 
 class ByteGradAlgorithm(Algorithm):

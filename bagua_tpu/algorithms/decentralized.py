@@ -36,7 +36,8 @@ from typing import List, Tuple
 import jax
 import jax.numpy as jnp
 
-from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
+from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, OverlapCapability, StepContext
+from bagua_tpu.bucket import flatten_bucket_leaves, split_bucket_flat
 from bagua_tpu.communication import (
     ALL_AXES,
     INTER_AXIS,
@@ -89,6 +90,11 @@ def _exchange(flat: jnp.ndarray, step, mode: str, axes) -> jnp.ndarray:
 
 
 class DecentralizedAlgorithmImpl(AlgorithmImpl):
+    supports_overlap = True
+    #: the exchange moves *weights*, which don't data-depend on the backward —
+    #: the engine anchors each bucket's collective on its cotangents instead
+    #: of wrapping params in a custom_vjp (see OverlapCapability).
+    overlap_mode = "weight"
 
     def __init__(
         self,
@@ -102,8 +108,49 @@ class DecentralizedAlgorithmImpl(AlgorithmImpl):
         self.communication_interval = communication_interval
 
     def tensors_to_buckets(self, tree, bucket_size_bytes=None, filter_fn=None):
-        # The reference puts ALL weights in one bucket (``decentralized.py:52-61``).
+        # The reference puts ALL weights in one bucket (``decentralized.py:
+        # 52-61``) — one giant collective minimizes launch overhead when
+        # nothing overlaps.  Under overlap the whole point is per-bucket
+        # granularity (each peer-weight ppermute issues as its bucket's
+        # cotangents arrive), so keep the default multi-bucket split then.
+        # All exchanges are elementwise, so the split never changes numerics.
+        if getattr(self, "overlap_hint", False):
+            return super().tensors_to_buckets(
+                tree, bucket_size_bytes=bucket_size_bytes, filter_fn=filter_fn
+            )
         return super().tensors_to_buckets(tree, bucket_size_bytes=1 << 62, filter_fn=filter_fn)
+
+    def _exchange_flat(self, flat, comm_round):
+        if self.hierarchical and self.process_group.intra_size > 1:
+            flat = allreduce_inplace(flat, op=ReduceOp.AVG, axis=INTRA_AXIS)
+            return _exchange(flat, comm_round, self.peer_selection_mode, (INTER_AXIS,))
+        return _exchange(flat, comm_round, self.peer_selection_mode, ALL_AXES)
+
+    def overlap_exchange(
+        self, bucket_idx: int, grads, ctx: StepContext, params_leaves=None
+    ):
+        # One bucket's peer-weight exchange, anchored on the bucket's
+        # cotangents: weights don't data-depend on the backward, so without
+        # the barrier XLA would hoist (or sink) the collective freely.  Tying
+        # the weight buffer to this bucket's gradients makes the ppermute /
+        # allreduce issuable exactly when the bucket's backward finishes —
+        # the early-issue the reference gets from starting the exchange at
+        # forward-pre and syncing post-backward.
+        spec = ctx.plan.specs[bucket_idx]
+        flat = flatten_bucket_leaves(params_leaves, spec)
+        flat = jax.lax.optimization_barrier((flat,) + tuple(grads))[0]
+        comm_round = ctx.step // self.communication_interval
+
+        if self.communication_interval > 1:
+            flat = jax.lax.cond(
+                ctx.step % self.communication_interval == 0,
+                lambda f: self._exchange_flat(f, comm_round),
+                lambda f: f,
+                flat,
+            )
+        else:
+            flat = self._exchange_flat(flat, comm_round)
+        return split_bucket_flat(flat, spec)
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
         # The reference op keeps its own counter incremented once per executed
@@ -114,15 +161,7 @@ class DecentralizedAlgorithmImpl(AlgorithmImpl):
 
         def communicate(params):
             flats = ctx.plan.bucketize(params)
-            out = []
-            for flat in flats:
-                if self.hierarchical and self.process_group.intra_size > 1:
-                    flat = allreduce_inplace(flat, op=ReduceOp.AVG, axis=INTRA_AXIS)
-                    out.append(
-                        _exchange(flat, comm_round, self.peer_selection_mode, (INTER_AXIS,))
-                    )
-                else:
-                    out.append(_exchange(flat, comm_round, self.peer_selection_mode, ALL_AXES))
+            out = [self._exchange_flat(flat, comm_round) for flat in flats]
             return ctx.plan.debucketize(out, params)
 
         if self.communication_interval > 1:
@@ -163,6 +202,8 @@ class LowPrecisionDecentralizedAlgorithmImpl(AlgorithmImpl):
     #: replicas in algo_state are laid out per-bucket; re-bucketing would
     #: desync them (DistributedDataParallel.rebucket refuses).
     holds_bucketized_state = True
+    supports_overlap = True
+    overlap_mode = "post_step"
 
     def __init__(
         self, process_group, hierarchical: bool = True,
@@ -171,8 +212,35 @@ class LowPrecisionDecentralizedAlgorithmImpl(AlgorithmImpl):
         super().__init__(process_group, hierarchical=hierarchical)
         self.communication_interval = communication_interval
         self.use_pallas = use_pallas  # compressor impl (kernels.get_compressors)
+        # resolved once; the evidence-file lookup must not run per trace
+        self._compressors = get_compressors(use_pallas)
+
+    def overlap_capability(self) -> OverlapCapability:
+        # ``holds_bucketized_state`` normally vetoes overlap (the base
+        # heuristic), but here the replicas are laid out ON the bound plan —
+        # per-bucket native — and the ring exchange already runs bucket by
+        # bucket in on_step_end.  Overlap therefore only switches the plan to
+        # multi-bucket granularity ("post_step" mode) so each bucket's
+        # compress→ppermute chain issues as soon as its own update finishes.
+        # auto=False: splitting the mega-bucket moves the quantizer's min/max
+        # granularity (per-bucket instead of whole-model), so results are NOT
+        # bitwise-identical to the monolithic row — auto must never change
+        # numerics, overlap stays explicit opt-in.
+        return OverlapCapability(
+            True, mode="post_step", auto=False,
+            reason="LowPrecisionDecentralizedAlgorithmImpl overlap changes "
+            "quantization granularity (per-bucket min/max); enable explicitly "
+            "with overlap=True",
+        )
 
     def tensors_to_buckets(self, tree, bucket_size_bytes=None, filter_fn=None):
+        # Mega-bucket by default (one ring exchange, whole-model min/max —
+        # the reference layout); multi-bucket under overlap so the per-bucket
+        # chains interleave with the optimizer update's tail.
+        if getattr(self, "overlap_hint", False):
+            return super().tensors_to_buckets(
+                tree, bucket_size_bytes=bucket_size_bytes, filter_fn=filter_fn
+            )
         return super().tensors_to_buckets(tree, bucket_size_bytes=1 << 62, filter_fn=filter_fn)
 
     def _axes(self):
@@ -196,7 +264,7 @@ class LowPrecisionDecentralizedAlgorithmImpl(AlgorithmImpl):
     def on_step_end(self, params, state, ctx: StepContext):
         axes = self._axes()
 
-        compress_minmax_uint8, decompress_minmax_uint8 = get_compressors(self.use_pallas)
+        compress_minmax_uint8, decompress_minmax_uint8 = self._compressors
 
         def communicate(operand):
             params, state = operand

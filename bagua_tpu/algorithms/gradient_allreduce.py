@@ -33,12 +33,12 @@ import jax
 import jax.numpy as jnp
 
 from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
+from bagua_tpu.bucket import flatten_bucket_leaves, split_bucket_flat
 from bagua_tpu.communication import (
     ReduceOp,
     allreduce_inplace,
     hierarchical_allreduce_inplace,
 )
-from bagua_tpu.utils import from_bagua_datatype
 
 
 class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
@@ -93,7 +93,9 @@ class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
         ]
         return ctx.plan.debucketize(out, grads), params, state
 
-    def overlap_exchange(self, bucket_idx: int, grads, ctx: StepContext):
+    def overlap_exchange(
+        self, bucket_idx: int, grads, ctx: StepContext, params_leaves=None
+    ):
         # One bucket's exchange, issued from inside the backward pass (the
         # engine's custom_vjp rule).  Same wire program per bucket as
         # transform_gradients — tuple fuse emits one variadic all-reduce over
@@ -106,17 +108,9 @@ class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
         if self.fuse == "tuple":
             grads = list(grads)
             return self._from_wire(reduce(self._to_wire(grads), op=op), grads)
-        parts = [g.reshape(-1) for g in grads]
-        used = sum(p.shape[0] for p in parts)
-        if used < spec.numel:
-            parts.append(
-                jnp.zeros((spec.numel - used,), from_bagua_datatype(spec.dtype))
-            )
-        flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        flat = flatten_bucket_leaves(grads, spec)
         out = self._from_wire(reduce(self._to_wire(flat), op=op), flat)
-        return [
-            out[s.offset : s.offset + s.numel].reshape(s.shape) for s in spec.slots
-        ]
+        return split_bucket_flat(out, spec)
 
 
 class GradientAllReduceAlgorithm(Algorithm):

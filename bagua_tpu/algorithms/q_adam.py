@@ -38,6 +38,7 @@ import optax
 
 from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
 from bagua_tpu.algorithms.bytegrad import compressed_allreduce
+from bagua_tpu.bucket import flatten_bucket_leaves, split_bucket_flat
 from bagua_tpu.communication import (
     ALL_AXES,
     INTER_AXIS,
@@ -45,6 +46,7 @@ from bagua_tpu.communication import (
     ReduceOp,
     allreduce_inplace,
 )
+from bagua_tpu.kernels.minmax_uint8 import get_compressors, get_fused_reducer
 
 
 @dataclasses.dataclass
@@ -78,28 +80,42 @@ class QAdamOptimizer:
 
 
 class QAdamAlgorithmImpl(AlgorithmImpl):
+    supports_overlap = True
+
     def __init__(self, process_group, q_adam_optimizer: QAdamOptimizer, hierarchical: bool = True):
         super().__init__(process_group, hierarchical=hierarchical)
         self.optimizer = q_adam_optimizer
         self.warmup_steps = q_adam_optimizer.warmup_steps
+        # Resolved once here so the evidence-file lookup stays off the traced
+        # per-bucket path (same hoist as ByteGrad).
+        self._compressors = get_compressors(None)
+        self._fused_reducer = get_fused_reducer(None)
 
     def init_state(self, params):
         zeros = jax.tree.map(jnp.zeros_like, params)
         return {"exp_avg": zeros, "exp_avg_sq": jax.tree.map(jnp.zeros_like, params)}
 
+    def _exchange_flat(self, flat, compressed: bool):
+        """One bucket's wire program, shared by the monolithic and overlap
+        paths (bitwise-identical outputs)."""
+        if compressed:
+            if self.hierarchical and self.process_group.intra_size > 1:
+                intra = allreduce_inplace(flat, op=ReduceOp.SUM, axis=INTRA_AXIS)
+                red = compressed_allreduce(
+                    intra, (INTER_AXIS,), average=False,
+                    compressors=self._compressors,
+                    fused_reducer=self._fused_reducer,
+                )
+                return red / self.process_group.size
+            return compressed_allreduce(
+                flat, ALL_AXES, average=True,
+                compressors=self._compressors, fused_reducer=self._fused_reducer,
+            )
+        return allreduce_inplace(flat, op=ReduceOp.AVG)
+
     def _allreduce_tree(self, tree, ctx, compressed: bool):
         flats = ctx.plan.bucketize(tree)
-        out = []
-        for flat in flats:
-            if compressed:
-                if self.hierarchical and self.process_group.intra_size > 1:
-                    intra = allreduce_inplace(flat, op=ReduceOp.SUM, axis=INTRA_AXIS)
-                    red = compressed_allreduce(intra, (INTER_AXIS,), average=False)
-                    out.append(red / self.process_group.size)
-                else:
-                    out.append(compressed_allreduce(flat, ALL_AXES, average=True))
-            else:
-                out.append(allreduce_inplace(flat, op=ReduceOp.AVG))
+        out = [self._exchange_flat(flat, compressed) for flat in flats]
         return ctx.plan.debucketize(out, tree)
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
@@ -130,6 +146,86 @@ class QAdamAlgorithmImpl(AlgorithmImpl):
             m = jax.tree.map(lambda mm, gg: b1 * mm + (1 - b1) * gg, m, grads)
             m = self._allreduce_tree(m, ctx, compressed=True)
             return m, v
+
+        m, v = jax.lax.cond(
+            ctx.step < self.warmup_steps, warmup, compression, (grads, params, m, v)
+        )
+
+        bc1 = 1.0 - jnp.power(b1, step_id)
+        bc2 = 1.0 - jnp.power(b2, step_id)
+        eps = self.optimizer.eps
+        direction = jax.tree.map(
+            lambda mm, vv: mm / (bc1 * (jnp.sqrt(vv) / jnp.sqrt(bc2) + eps)), m, v
+        )
+        return direction, params, {"exp_avg": m, "exp_avg_sq": v}
+
+    # -- overlap execution mode ---------------------------------------------
+
+    def overlap_exchange(
+        self, bucket_idx: int, grads, ctx: StepContext, params_leaves=None
+    ):
+        # One bucket's exchange from inside its custom_vjp backward rule.
+        # The warmup↔compression boundary is the SAME traced ``lax.cond``
+        # as the monolithic path — the phase switches per step without a
+        # retrace, so the anchored collective program is stable across the
+        # boundary.  Warmup leg: flat full-precision AVG of the bucket's
+        # gradients.  Compression leg: local momentum update from the raw
+        # cotangents, then the hierarchical/compressed pipeline over the
+        # momentum — chunk boundaries identical to bucketize's layout, so
+        # outputs are bitwise-identical to transform_gradients.
+        spec = ctx.plan.specs[bucket_idx]
+        b1 = self.optimizer.betas[0]
+        m_group = ctx.plan.group_leaves(ctx.extras["algo_state"]["exp_avg"])[bucket_idx]
+        m_leaves = [m_group[s.name] for s in spec.slots]
+
+        def warmup(operand):
+            g_leaves, _ = operand
+            flat = flatten_bucket_leaves(g_leaves, spec)
+            return split_bucket_flat(self._exchange_flat(flat, compressed=False), spec)
+
+        def compression(operand):
+            g_leaves, m_leaves = operand
+            m2 = [b1 * mm + (1 - b1) * gg for mm, gg in zip(m_leaves, g_leaves)]
+            flat = flatten_bucket_leaves(m2, spec)
+            return split_bucket_flat(self._exchange_flat(flat, compressed=True), spec)
+
+        return jax.lax.cond(
+            ctx.step < self.warmup_steps, warmup, compression, (list(grads), m_leaves)
+        )
+
+    def finalize_overlap(self, grads, params, state, ctx: StepContext):
+        # ``grads`` holds each bucket's per-bucket exchange output assembled
+        # back into the gradient tree: averaged gradients in warmup, the
+        # exchanged momentum in compression.  Leaves outside every bucket
+        # (dp_filter) carry their raw local gradients — exactly what the
+        # monolithic path's debucketize fallback leaves there in warmup; the
+        # compression branch recomputes the local momentum for those leaves.
+        b1, b2 = self.optimizer.betas
+        wd = self.optimizer.weight_decay
+        step_id = (ctx.step + 1).astype(jnp.float32)
+        m, v = state["exp_avg"], state["exp_avg_sq"]
+        covered = {s.name for spec in ctx.plan.specs for s in spec.slots}
+
+        def warmup(operand):
+            g, params, m, v = operand
+            if wd != 0.0:
+                g = jax.tree.map(lambda gg, p: gg + wd * p, g, params)
+            m2 = jax.tree.map(lambda mm, gg: b1 * mm + (1 - b1) * gg, m, g)
+            v2 = jax.tree.map(lambda vv, gg: b2 * vv + (1 - b2) * gg * gg, v, g)
+            moments_pred = ctx.step + 1 < self.warmup_steps
+            m2 = jax.tree.map(lambda a, b: jnp.where(moments_pred, a, b), m2, m)
+            v2 = jax.tree.map(lambda a, b: jnp.where(moments_pred, a, b), v2, v)
+            return m2, v2
+
+        def compression(operand):
+            exch, params, m, v = operand
+            m2 = jax.tree_util.tree_map_with_path(
+                lambda path, e, mm: e
+                if jax.tree_util.keystr(path) in covered
+                else b1 * mm + (1 - b1) * e,
+                exch, m,
+            )
+            return m2, v
 
         m, v = jax.lax.cond(
             ctx.step < self.warmup_steps, warmup, compression, (grads, params, m, v)

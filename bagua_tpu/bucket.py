@@ -272,6 +272,28 @@ class BucketPlan:
         return f"BucketPlan(buckets={[(len(s.slots), s.numel, s.dtype) for s in self.specs]})"
 
 
+def flatten_bucket_leaves(leaves: Sequence[jnp.ndarray], spec: BucketSpec) -> jnp.ndarray:
+    """Fuse ONE bucket's leaves (slot order) into its padded flat buffer.
+
+    The per-bucket sibling of :meth:`BucketPlan.bucketize`, shared by every
+    ``overlap_exchange`` implementation that operates on the fused bytes
+    (compression chunking is defined on the flat layout, so the overlap path
+    must build byte-identical buffers to the monolithic path)."""
+    parts = [l.reshape(-1) for l in leaves]
+    used = sum(p.shape[0] for p in parts)
+    if used < spec.numel:
+        parts.append(jnp.zeros((spec.numel - used,), from_bagua_datatype(spec.dtype)))
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def split_bucket_flat(flat: jnp.ndarray, spec: BucketSpec) -> List[jnp.ndarray]:
+    """Re-slice one bucket's flat buffer into its leaves (slot order); the
+    inverse of :func:`flatten_bucket_leaves` (padding dropped)."""
+    return [
+        flat[s.offset : s.offset + s.numel].reshape(s.shape) for s in spec.slots
+    ]
+
+
 def _make_overlap_identity(bucket_idx: int, exchange_fn):
     """A variadic identity whose backward rule runs one bucket's exchange.
 

@@ -33,7 +33,9 @@ def _build_library() -> str:
     if not os.path.exists(so_path):
         tmp = so_path + f".tmp{os.getpid()}"
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp, "-lpthread"],
+            # -lrt: shm_open/shm_unlink live in librt before glibc 2.34
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp,
+             "-lpthread", "-lrt"],
             check=True,
             capture_output=True,
         )

@@ -84,8 +84,10 @@ class DistributedDataParallel:
             so bucket k's all-reduce overlaps with the still-running backward
             of earlier layers — BAGUA's bucketed-overlap relaxation, realized
             through XLA's latency-hiding scheduler rather than a scheduler
-            thread.  Requires ``impl.supports_overlap``.  ``"auto"``
-            (default) enables it exactly when the algorithm supports it.
+            thread.  Validated against the algorithm's capability report
+            (``impl.overlap_capability()``); ``"auto"`` (default) enables it
+            exactly when the report marks overlap supported AND
+            numerics-preserving (``cap.auto``).
     """
 
     def __init__(
@@ -119,18 +121,15 @@ class DistributedDataParallel:
         if overlap not in (True, False, "auto"):
             raise ValueError(f"overlap must be True, False or 'auto', got {overlap!r}")
         if overlap is True:
-            if not getattr(self.impl, "supports_overlap", False):
-                raise ValueError(
-                    f"{type(self.impl).__name__} does not implement "
-                    "overlap_exchange; pass overlap=False or 'auto'"
-                )
-            if getattr(self.impl, "holds_bucketized_state", False):
-                raise ValueError(
-                    f"{type(self.impl).__name__} keeps per-bucket state; its "
-                    "exchange cannot be split into independent backward-time "
-                    "bucket collectives — pass overlap=False or 'auto'"
-                )
+            cap = self.impl.overlap_capability()
+            if not cap.supported:
+                raise ValueError(cap.reason)
         self.overlap = overlap
+        # Algorithms that shape their bucket plan by execution mode (the
+        # decentralized family uses the reference's single mega-bucket
+        # monolithically, per-size buckets under overlap) read this hint in
+        # tensors_to_buckets; init() refreshes it before computing the plan.
+        self.impl.overlap_hint = self.overlap_enabled
         self.plan: Optional[BucketPlan] = None
         self._step_fns = {}
         self._host_step: Optional[int] = None  # seeded from state on first step
@@ -169,6 +168,7 @@ class DistributedDataParallel:
             template = params
         # Bucket plan is computed from the (unstacked) communicated tree;
         # algorithms holding per-bucket state read it during init_state.
+        self.impl.overlap_hint = self.overlap_enabled
         self.plan = self.impl.tensors_to_buckets(
             template, self.bucket_size_bytes, filter_fn=self.dp_filter
         )
@@ -212,13 +212,14 @@ class DistributedDataParallel:
     @property
     def overlap_enabled(self) -> bool:
         """The resolved execution mode for the next compiled step.  ``"auto"``
-        resolves to True exactly when the algorithm can run its exchange
-        per-bucket inside backward (and holds no per-bucket state whose
-        chunk semantics a split exchange would break)."""
+        consults the algorithm's capability report
+        (:meth:`~bagua_tpu.algorithms.base.AlgorithmImpl.overlap_capability`)
+        and additionally requires ``cap.auto`` — auto must never change
+        numerics, so algorithms whose overlap output is only equal to the
+        monolithic path within quantization granularity stay opt-in."""
         if self.overlap == "auto":
-            return bool(getattr(self.impl, "supports_overlap", False)) and not (
-                getattr(self.impl, "holds_bucketized_state", False)
-            )
+            cap = self.impl.overlap_capability()
+            return cap.supported and cap.auto
         return bool(self.overlap)
 
     # -- re-bucketing (autotune) -------------------------------------------
@@ -256,19 +257,52 @@ class DistributedDataParallel:
 
             params, algo_state = impl.on_step_start(params, algo_state, ctx)
             if overlap:
-                # Per-bucket exchange rides the backward pass: each bucket's
-                # collective hangs off the custom_vjp that receives its
-                # cotangents, so it issues the moment those gradients are
-                # complete — while earlier layers' backward is still running.
-                # overlap_exchange subsumes transform_gradients here.
-                def overlapped_loss(p, b):
-                    wrapped = wrap_params_for_overlap(
-                        plan, p,
-                        lambda bi, leaves: impl.overlap_exchange(bi, leaves, ctx),
-                    )
-                    return self.loss_fn(wrapped, b)
+                # Per-bucket exchange rides the backward pass.  What rides it
+                # depends on the algorithm's overlap mode (see
+                # OverlapCapability): gradient-mode collectives hang off the
+                # custom_vjp that receives each bucket's cotangents; weight-
+                # mode collectives are anchored on them with an
+                # optimization_barrier; post_step algorithms keep their
+                # on_step_end exchange and only gain multi-bucket
+                # granularity.  overlap_exchange (+ finalize_overlap)
+                # subsumes transform_gradients here.
+                mode = getattr(impl, "overlap_mode", "gradient")
+                # algorithms whose per-bucket exchange reads their own state
+                # (QAdam momentum) reach it through the step context
+                ctx.extras["algo_state"] = algo_state
+                if mode == "gradient":
+                    def overlapped_loss(p, b):
+                        wrapped = wrap_params_for_overlap(
+                            plan, p,
+                            lambda bi, leaves: impl.overlap_exchange(bi, leaves, ctx),
+                        )
+                        return self.loss_fn(wrapped, b)
 
-                loss, grads = jax.value_and_grad(overlapped_loss)(params, batch)
+                    loss, grads = jax.value_and_grad(overlapped_loss)(params, batch)
+                elif mode == "weight":
+                    loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
+                    grad_groups = plan.group_leaves(grads)
+                    param_groups = plan.group_leaves(params)
+                    new_groups = []
+                    for bi in plan.backward_order():
+                        spec = plan.specs[bi]
+                        g_leaves = [grad_groups[bi][s.name] for s in spec.slots]
+                        p_leaves = [param_groups[bi][s.name] for s in spec.slots]
+                        exchanged = impl.overlap_exchange(
+                            bi, g_leaves, ctx, params_leaves=p_leaves
+                        )
+                        new_groups.append(
+                            {s.name: l for s, l in zip(spec.slots, exchanged)}
+                        )
+                    params = plan.ungroup_leaves(new_groups, params)
+                else:  # "post_step": monolithic step structure, overlap plan
+                    loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
+                    grads, params, algo_state = impl.transform_gradients(
+                        grads, params, algo_state, ctx
+                    )
+                grads, params, algo_state = impl.finalize_overlap(
+                    grads, params, algo_state, ctx
+                )
             else:
                 loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
                 grads, params, algo_state = impl.transform_gradients(
@@ -553,13 +587,16 @@ class AutotuneSession:
             if want != self.ddp.impl.wire_dtype:
                 self.ddp.impl.wire_dtype = want
                 self.ddp._step_fns = {}
-        # Execution-mode knob, same tri-state contract as wire_bf16: only
-        # algorithms that can run their exchange per-bucket inside backward
-        # participate; ``hp.overlap is None`` = dimension not tuned, leave a
-        # user-configured mode untouched.
-        if hp.overlap is not None and getattr(
-            self.ddp.impl, "supports_overlap", False
-        ):
+        # Execution-mode knob, same tri-state contract as wire_bf16: the
+        # capability report decides which algorithms accept it.  Restricted
+        # to gradient-mode algorithms: weight/post_step algorithms shape
+        # their bucket *plan* by execution mode (mega-bucket vs per-size),
+        # so flipping them mid-training would need a re-plan — out of the
+        # tuner's cheap-knob contract.  ``hp.overlap is None`` = dimension
+        # not tuned, leave a user-configured mode untouched.
+        cap = self.ddp.impl.overlap_capability()
+        if hp.overlap is not None and cap.supported and cap.mode == "gradient":
             if bool(hp.overlap) != self.ddp.overlap_enabled:
                 self.ddp.overlap = bool(hp.overlap)
+                self.ddp.impl.overlap_hint = self.ddp.overlap_enabled
                 self.ddp._step_fns = {}

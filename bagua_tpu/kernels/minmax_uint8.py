@@ -219,6 +219,114 @@ def _decompress_pallas_jit(q, minmax, interpret: bool, bc: int):
     return out.reshape(nchunks, chunk)
 
 
+# ---------------------------------------------------------------------------
+# Fused dequantize → reduce → requantize (ByteGrad's middle three stages)
+# ---------------------------------------------------------------------------
+
+
+def decompress_reduce_requantize(
+    q: jnp.ndarray, minmax: jnp.ndarray, average: bool = True
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fuse ByteGrad's middle stages: everyone's received chunk in, the
+    reduced + requantized own chunk out.
+
+    ``q`` is uint8 of shape ``(n, chunk)`` (one received chunk per peer),
+    ``minmax`` float32 ``(n, 2)``.  Returns ``(q2, mm2)`` with ``q2`` uint8
+    ``(1, chunk)`` and ``mm2`` float32 ``(1, 2)`` — exactly
+    ``compress(sum(decompress(q, minmax), axis=0[, /n]))``.  This jnp
+    composition is the semantic oracle for the Pallas kernel below."""
+    x = decompress_minmax_uint8(q, minmax)
+    red = jnp.sum(x, axis=0, keepdims=True)
+    if average:
+        red = red / q.shape[0]
+    return compress_minmax_uint8(red)
+
+
+def _fused_reduce_kernel(q_ref, mm_ref, qo_ref, mmo_ref, *, n, average):
+    # dequantize every peer's chunk in place: (n, rows, 128)
+    mm = mm_ref[...]                     # (n, 1, 2)
+    mn = mm[:, :, 0:1]                   # (n, 1, 1)
+    mx = mm[:, :, 1:2]
+    scale = LEVELS / (mx - mn + EPS)
+    upper = jnp.round(mx * scale)
+    lower = upper - LEVELS
+    q = q_ref[...].astype(jnp.int32).astype(jnp.float32)
+    x = (q + lower) / scale
+    # float32 tree-sum over peers, then requantize the reduced chunk — one
+    # VMEM round-trip where the staged path pays three HBM passes.
+    red = jnp.sum(x, axis=0)             # (rows, 128)
+    if average:
+        red = red / n                    # division, matching the jnp oracle
+    mn2 = jnp.min(red)
+    mx2 = jnp.max(red)
+    scale2 = LEVELS / (mx2 - mn2 + EPS)
+    upper2 = jnp.round(mx2 * scale2)
+    lower2 = upper2 - LEVELS
+    level = jnp.minimum(jnp.round(red * scale2), upper2)
+    qo_ref[...] = (level - lower2).astype(jnp.int32).astype(jnp.uint8)[None]
+    mmo_ref[...] = jnp.stack([mn2, mx2]).reshape(1, 1, 2)
+
+
+def decompress_reduce_requantize_pallas(
+    q: jnp.ndarray, minmax: jnp.ndarray, average: bool = True,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pallas version of :func:`decompress_reduce_requantize`: the whole
+    ``(n, chunk)`` block resident in VMEM for one grid step (the requantize
+    needs the reduced chunk's global min/max, so the chunk can't be tiled
+    across steps without a cross-step reduction).  Falls back to the jnp
+    composition when the chunk doesn't satisfy TPU tiling or the block would
+    blow the VMEM budget — semantics identical either way."""
+    n, chunk = q.shape
+    # resident bytes: u8 in (n*chunk) + f32 dequant (4*n*chunk) + f32 reduced
+    # + u8 out (~5*chunk); stay within the double-buffered arena budget
+    if not pallas_chunk_supported(chunk) or (n + 1) * chunk * 5 > 2 * _VMEM_BLOCK_BYTES:
+        return decompress_reduce_requantize(q, minmax, average=average)
+    return _fused_reduce_pallas_jit(q, minmax, bool(average), interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("average", "interpret"))
+def _fused_reduce_pallas_jit(q, minmax, average: bool, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, chunk = q.shape
+    rows = chunk // _LANE
+    q2, mm2 = pl.pallas_call(
+        functools.partial(_fused_reduce_kernel, n=n, average=average),
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((n, rows, _LANE), lambda i: (0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, 1, 2), lambda i: (0, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, rows, _LANE), lambda i: (0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 2), lambda i: (0, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, rows, _LANE), jnp.uint8),
+            jax.ShapeDtypeStruct((1, 1, 2), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q.reshape(n, rows, _LANE), minmax.reshape(n, 1, 2))
+    return q2.reshape(1, chunk), mm2.reshape(1, 2)
+
+
+def get_fused_reducer(use_pallas=None):
+    """Pick the ``decompress_reduce_requantize`` implementation for the
+    compressed-allreduce hot loop, under the same evidence-gated policy as
+    :func:`get_compressors`: explicit argument > ``BAGUA_PALLAS_FUSED_REDUCE``
+    env pin > PALLAS_TPU.json hardware record (jnp otherwise, and always on
+    CPU backends).  The Pallas entry point still falls back to jnp per call
+    when a chunk doesn't satisfy TPU tiling or VMEM bounds."""
+    from bagua_tpu.kernels._config import resolve_use_pallas
+
+    if resolve_use_pallas(use_pallas, "BAGUA_PALLAS_FUSED_REDUCE",
+                          kernel="decompress_reduce_requantize"):
+        return decompress_reduce_requantize_pallas
+    return decompress_reduce_requantize
+
+
 def get_compressors(use_pallas=None):
     """Pick the (compress, decompress) pair for the bytegrad/low-precision
     hot paths.

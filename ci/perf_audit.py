@@ -85,7 +85,12 @@ _OPCALL = re.compile(
 
 
 def census(hlo_text: str):
-    """Collective (and copy) instructions: count, result MB, element types."""
+    """Collective (and copy) instructions: count, result MB, element types.
+
+    ``by_dtype`` keeps exact per-element-type byte totals (integers, not
+    rounded MB) so the compressed-overlap gate can assert *bitwise* wire-byte
+    parity between execution modes — the u8 payload of a small CI-lane model
+    is far below the 0.01 MB rounding granularity of the ``mb`` field."""
     counts = {}
     for line in hlo_text.splitlines():
         if "=" not in line:
@@ -95,7 +100,7 @@ def census(hlo_text: str):
             continue
         op = m.group(1)
         lhs = line[: m.start()].split("=", 1)[-1]
-        total, dtypes = 0, set()
+        line_bytes = {}
         for sm in _SHAPE.finditer(lhs):
             dt, dims = sm.group(1), sm.group(2)
             if dt not in _DTYPE_BYTES:
@@ -104,12 +109,18 @@ def census(hlo_text: str):
             for d in dims.split(","):
                 if d:
                     n *= int(d)
-            total += n * _DTYPE_BYTES[dt]
-            dtypes.add(dt)
-        e = counts.setdefault(op, {"count": 0, "mb": 0.0, "dtypes": []})
+            line_bytes[dt] = line_bytes.get(dt, 0) + n * _DTYPE_BYTES[dt]
+        total = sum(line_bytes.values())
+        e = counts.setdefault(
+            op, {"count": 0, "mb": 0.0, "dtypes": [], "by_dtype": {}}
+        )
         e["count"] += 1
         e["mb"] = round(e["mb"] + total / 2**20, 2)
-        e["dtypes"] = sorted(set(e["dtypes"]) | dtypes)
+        e["dtypes"] = sorted(set(e["dtypes"]) | set(line_bytes))
+        for dt, b in line_bytes.items():
+            d = e["by_dtype"].setdefault(dt, {"count": 0, "bytes": 0})
+            d["count"] += 1
+            d["bytes"] += b
     return counts
 
 
@@ -152,7 +163,27 @@ VARIANTS = {
     # "[overlap*]" anchor each bucket's collective inside the backward pass.
     "gradient_allreduce[overlap]": ({}, {"overlap": True}),
     "gradient_allreduce[overlap,flat]": ({"fuse": "flat"}, {"overlap": True}),
+    # The compressed / decentralized families now report overlap capability,
+    # so their monolithic baselines must pin overlap=False explicitly (the
+    # "auto" default would silently flip bytegrad/qadam/decentralized on).
+    "bytegrad": ({}, {"overlap": False}),
+    "bytegrad[overlap]": ({}, {"overlap": True}),
+    "qadam": ({}, {"overlap": False}),
+    "qadam[overlap]": ({}, {"overlap": True}),
+    "decentralized": ({}, {"overlap": False}),
+    "decentralized[overlap]": ({}, {"overlap": True}),
+    "low_precision_decentralized": ({}, {"overlap": False}),
+    "low_precision_decentralized[overlap]": ({}, {"overlap": True}),
 }
+
+# Compressed/decentralized overlap rows paired with their monolithic
+# baselines for the wire-pattern + byte-parity gate below.
+COMPRESSED_OVERLAP_PAIRS = (
+    ("bytegrad[overlap]", "bytegrad"),
+    ("qadam[overlap]", "qadam"),
+    ("decentralized[overlap]", "decentralized"),
+    ("low_precision_decentralized[overlap]", "low_precision_decentralized"),
+)
 
 
 def audit_ddp(algorithms, model="vgg16"):
@@ -261,6 +292,118 @@ def assert_overlap_census(ddp_results):
     print("[audit] overlap wire-pattern assertion passed", file=sys.stderr)
 
 
+def _op_bytes(row, op):
+    return sum(
+        d["bytes"] for d in row["census"].get(op, {}).get("by_dtype", {}).values()
+    )
+
+
+def assert_compressed_overlap_census(ddp_results):
+    """The compressed/decentralized overlap gate (pairwise vs monolithic).
+
+    For every pair present: the overlap row must run a multi-bucket plan and
+    move the same wire bytes per collective op as its monolithic baseline
+    (exact byte totals from the census ``by_dtype`` breakdown; tolerance only
+    for per-bucket minmax headers, a handful of f32 pairs).  Per family:
+
+    * bytegrad / qadam — the compressed leg must emit exactly one u8
+      ``all-to-all`` and one u8 ``all-gather`` per bucket (plus the paired
+      f32 minmax transfers), with u8 payload bytes EQUAL to the monolithic
+      row (same plan, same chunk boundaries — the bitwise-parity claim made
+      wire-visible);
+    * decentralized — per-bucket weight all-reduces: count scales by the
+      bucket count vs the mono mega-bucket row, bytes identical (elementwise
+      exchange, equal total padding);
+    * low_precision_decentralized — the ring's 4 ``collective-permute``s per
+      bucket (q/mm × left/right), u8 payload bytes equal to the mono row.
+    """
+    failures = []
+    checked = []
+    for ov_name, mono_name in COMPRESSED_OVERLAP_PAIRS:
+        if ov_name not in ddp_results or mono_name not in ddp_results:
+            continue
+        checked.append(ov_name)
+        ov, mono = ddp_results[ov_name], ddp_results[mono_name]
+        buckets = ov["buckets"]
+        if not ov["overlap"] or mono["overlap"]:
+            failures.append(
+                f"{ov_name}/{mono_name}: execution modes not (overlap, monolithic)"
+            )
+            continue
+        if buckets <= 1:
+            failures.append(
+                f"{ov_name}: single-bucket plan — overlap granularity untestable"
+            )
+            continue
+        algo = ov_name.split("[")[0]
+        if algo in ("bytegrad", "qadam"):
+            for op in ("all-to-all", "all-gather"):
+                u8 = ov["census"].get(op, {}).get("by_dtype", {}).get(
+                    "u8", {"count": 0, "bytes": 0}
+                )
+                if u8["count"] != buckets:
+                    failures.append(
+                        f"{ov_name}: {u8['count']} u8 {op}s, expected exactly "
+                        f"one per bucket ({buckets})"
+                    )
+                mono_u8 = mono["census"].get(op, {}).get("by_dtype", {}).get(
+                    "u8", {"count": 0, "bytes": 0}
+                )
+                if u8["bytes"] != mono_u8["bytes"]:
+                    failures.append(
+                        f"{ov_name}: u8 {op} payload {u8['bytes']} B != "
+                        f"monolithic {mono_u8['bytes']} B"
+                    )
+        if algo == "decentralized":
+            ar = ov["census"].get("all-reduce", {"count": 0})
+            mono_ar = mono["census"].get("all-reduce", {"count": 0})
+            if ar["count"] != buckets * max(1, mono_ar["count"]) // max(
+                1, mono["buckets"]
+            ):
+                failures.append(
+                    f"{ov_name}: {ar['count']} all-reduces for {buckets} "
+                    f"buckets, monolithic row has {mono_ar['count']} for "
+                    f"{mono['buckets']}"
+                )
+        if algo == "low_precision_decentralized":
+            cp = ov["census"].get("collective-permute", {}).get(
+                "by_dtype", {}
+            ).get("u8", {"count": 0, "bytes": 0})
+            mono_cp = mono["census"].get("collective-permute", {}).get(
+                "by_dtype", {}
+            ).get("u8", {"count": 0, "bytes": 0})
+            if cp["count"] != buckets * mono_cp["count"]:
+                failures.append(
+                    f"{ov_name}: {cp['count']} u8 collective-permutes, "
+                    f"expected {mono_cp['count']} per bucket × {buckets}"
+                )
+            if cp["bytes"] != mono_cp["bytes"]:
+                failures.append(
+                    f"{ov_name}: u8 ring payload {cp['bytes']} B != "
+                    f"monolithic {mono_cp['bytes']} B"
+                )
+        # Per-op total byte parity (all ops, all dtypes): the minmax headers
+        # scale with the bucket count, so allow a small absolute slack.
+        for op in COLLECTIVES:
+            b_ov, b_mono = _op_bytes(ov, op), _op_bytes(mono, op)
+            if abs(b_ov - b_mono) > max(4096, 0.005 * b_mono):
+                failures.append(
+                    f"{ov_name}: {op} total {b_ov} B != monolithic "
+                    f"{mono_name}'s {b_mono} B"
+                )
+    if failures:
+        raise SystemExit(
+            "compressed overlap wire-pattern assertion FAILED:\n  "
+            + "\n  ".join(failures)
+        )
+    if checked:
+        print(
+            f"[audit] compressed overlap wire-pattern assertion passed "
+            f"({', '.join(checked)})",
+            file=sys.stderr,
+        )
+
+
 def audit_fsdp():
     import bagua_tpu
     from bagua_tpu.parallel.fsdp import FSDP, scan_layers
@@ -316,9 +459,22 @@ EXPECTED = {
     "gradient_allreduce[overlap,flat]": "overlap mode over materialized bucket "
     "buffers: exactly one all-reduce per bucket on every backend",
     "bytegrad": "u8 all-to-all scatter + all-gather (compressed hierarchical allreduce)",
+    "bytegrad[overlap]": "backward-overlapped compressed exchange: both "
+    "hierarchical legs (f32 intra psum + u8 inter scatter-gather) per bucket, "
+    "anchored at the bucket's cotangents — exactly one u8 all-to-all + one u8 "
+    "all-gather per bucket, wire bytes equal to the monolithic row",
     "qadam": "warmup all-reduce + compressed exchange under lax.cond (both branches in HLO)",
+    "qadam[overlap]": "both phases ride the per-bucket backward anchor: the "
+    "warmup/compression lax.cond switches the traced exchange per step without "
+    "a retrace; finalize_overlap completes the moment/bias-correction math",
     "decentralized": "collective-permute peer weight exchange",
+    "decentralized[overlap]": "peer-weight exchange issued per bucket as its "
+    "cotangents arrive (optimization_barrier anchor; multi-bucket plan instead "
+    "of the reference mega-bucket)",
     "low_precision_decentralized": "collective-permute ring diff exchange (u8 wire)",
+    "low_precision_decentralized[overlap]": "per-bucket ring diff chains after "
+    "the optimizer update (post_step granularity switch; explicit opt-in — "
+    "per-bucket min/max changes quantization granularity)",
     "async": "warmup all-reduce in-step; averaging rides the background thread's own jit",
 }
 
@@ -342,6 +498,9 @@ def load_trace_overlap():
         "full_step_ms": tr.get("full_step_ms"),
         "full_step_overlap_ms": tr.get("full_step_overlap_ms"),
         "overlap_gain_ms": tr.get("derived", {}).get("overlap_gain_ms"),
+        # per-algorithm monolithic/overlap full-step timings for the
+        # compressed + decentralized families (absent in older artifacts)
+        "algo_overlap_ms": tr.get("algo_overlap_ms"),
     }
 
 
@@ -450,6 +609,14 @@ def render_md(ddp_results, fsdp_result, n, trace=None, model="vgg16"):
             f"{trace.get('overlap_gain_ms')} ms/step.",
             "",
         ]
+        for algo, t in (trace.get("algo_overlap_ms") or {}).items():
+            lines.append(
+                f"- `{algo}`: {t.get('full_step_ms')} ms monolithic vs "
+                f"{t.get('full_step_overlap_ms')} ms overlapped "
+                f"(gain {t.get('overlap_gain_ms')} ms/step)"
+            )
+        if trace.get("algo_overlap_ms"):
+            lines.append("")
     lines += [
         "## Roofline projection (v5e, VGG16 bs32/chip)",
         "",
@@ -488,6 +655,11 @@ def main():
         "--ddp-only", action="store_true",
         help="skip the FSDP audit (CI lane: only the DDP census is asserted)",
     )
+    ap.add_argument(
+        "--algo", default=None,
+        help="audit ONE algorithm plus its [overlap] variant (tier-1 lane: "
+        "--quick --algo=bytegrad exercises the compressed census gate)",
+    )
     ap.add_argument("--out", default=os.path.join(REPO, "PERF_AUDIT"))
     args = ap.parse_args()
 
@@ -495,18 +667,23 @@ def main():
         "gradient_allreduce", "gradient_allreduce[flat]",
         "gradient_allreduce[overlap]", "gradient_allreduce[overlap,flat]",
     ]
-    algos = (
-        gar_variants
-        if args.quick
-        else gar_variants + [
-            "bytegrad", "qadam",
-            "decentralized", "low_precision_decentralized", "async",
+    if args.algo:
+        algos = [args.algo, f"{args.algo}[overlap]"]
+    elif args.quick:
+        algos = gar_variants
+    else:
+        algos = gar_variants + [
+            "bytegrad", "bytegrad[overlap]",
+            "qadam", "qadam[overlap]",
+            "decentralized", "decentralized[overlap]",
+            "low_precision_decentralized", "low_precision_decentralized[overlap]",
+            "async",
         ]
-    )
     ddp_results, n = audit_ddp(algos, model=args.model)
-    # The overlap wire-pattern gate runs on EVERY invocation (incl. --quick,
+    # The overlap wire-pattern gates run on EVERY invocation (incl. --quick,
     # which tests/test_ci_lane.py drives in the tier-1 lane).
     assert_overlap_census(ddp_results)
+    assert_compressed_overlap_census(ddp_results)
     fsdp_result = None if args.ddp_only else audit_fsdp()[0]
 
     trace = load_trace_overlap()

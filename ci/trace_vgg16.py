@@ -261,6 +261,37 @@ def main():
     result["full_step_overlap_ms"] = round((time.perf_counter() - t0) / 5 * 1e3, 3)
     ddp_ov.shutdown()
 
+    # Per-algorithm overlap timings for the families that joined the overlap
+    # engine (bytegrad/qadam/decentralized): monolithic vs overlapped full
+    # step, so ci/perf_audit.py's trace section can report the compressed
+    # pipelines' scheduler-visible gain, not only gradient_allreduce's.
+    def timed_steps(algo_name, overlap, steps=5):
+        ddp_a = DistributedDataParallel(
+            loss_fn, optax.sgd(0.01, momentum=0.9),
+            build_algorithm(algo_name, lr=0.01), process_group=group,
+            overlap=overlap,
+        )
+        st = ddp_a.init(params)
+        for _ in range(2):
+            st, ls = ddp_a.train_step(st, (x, y))
+            jax.block_until_ready(ls)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            st, ls = ddp_a.train_step(st, (x, y))
+        jax.block_until_ready(ls)
+        ddp_a.shutdown()
+        return round((time.perf_counter() - t0) / steps * 1e3, 3)
+
+    result["algo_overlap_ms"] = {}
+    for algo_name in ("bytegrad", "qadam", "decentralized"):
+        mono_ms = timed_steps(algo_name, overlap=False)
+        ov_ms = timed_steps(algo_name, overlap=True)
+        result["algo_overlap_ms"][algo_name] = {
+            "full_step_ms": mono_ms,
+            "full_step_overlap_ms": ov_ms,
+            "overlap_gain_ms": round(mono_ms - ov_ms, 3),
+        }
+
     result["derived"] = {
         "backward_ms": round(result["fwd_bwd_ms"] - result["forward_ms"], 3),
         "opt_restack_dispatch_ms": round(

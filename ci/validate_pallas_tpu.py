@@ -155,6 +155,61 @@ def validate_minmax(interpret, report):
     report.append(entry)
 
 
+def validate_fused_reduce(interpret, report):
+    """The fused dequantize→reduce→requantize kernel (ByteGrad's middle
+    three stages in one VMEM round-trip).  Bitwise parity with the staged
+    jnp composition is the contract: every rank requantizes the same reduced
+    chunk, so a single differing byte desyncs the all-gather.  Its record
+    gates ``BAGUA_PALLAS_FUSED_REDUCE`` auto-ON via
+    ``validated_on_hardware``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bagua_tpu.kernels.minmax_uint8 import (
+        compress_minmax_uint8,
+        decompress_reduce_requantize,
+        decompress_reduce_requantize_pallas,
+    )
+
+    entry = {"kernel": "decompress_reduce_requantize"}
+    try:
+        # n peers' received chunks for one bucket — the inter-axis fan-in of
+        # the hierarchical compressed allreduce (inter=8 on a 4x8 pod shape).
+        n, chunk = (4, 8192) if INTERPRET_SMOKE else (8, 262144)
+        x = jnp.asarray(
+            np.random.RandomState(4).randn(n, chunk).astype(np.float32)
+        )
+        q, mm = compress_minmax_uint8(x)
+        jax.block_until_ready((q, mm))
+        q_p, mm_p = decompress_reduce_requantize_pallas(
+            q, mm, average=True, interpret=interpret
+        )
+        q_j, mm_j = decompress_reduce_requantize(q, mm, average=True)
+        jax.block_until_ready((q_p, q_j))
+        entry["requant_bitwise_equal"] = bool(jnp.array_equal(q_p, q_j))
+        entry["minmax_max_abs_diff"] = float(jnp.max(jnp.abs(mm_p - mm_j)))
+        s_p = decompress_reduce_requantize_pallas(
+            q, mm, average=False, interpret=interpret
+        )[0]
+        s_j = decompress_reduce_requantize(q, mm, average=False)[0]
+        entry["sum_variant_bitwise_equal"] = bool(jnp.array_equal(s_p, s_j))
+        entry["pallas_ms"] = round(bench(
+            lambda: decompress_reduce_requantize_pallas(
+                q, mm, average=True, interpret=interpret)), 3)
+        entry["jnp_ms"] = round(bench(
+            lambda: decompress_reduce_requantize(q, mm, average=True)), 3)
+        entry["ok"] = (
+            entry["requant_bitwise_equal"]
+            and entry["sum_variant_bitwise_equal"]
+            and entry["minmax_max_abs_diff"] < 1e-5
+        )
+    except Exception as e:  # noqa: BLE001 — Mosaic rejection is a finding, not a crash
+        entry["ok"] = False
+        entry["error"] = f"{type(e).__name__}: {e}"[:800]
+    report.append(entry)
+
+
 def validate_flash(interpret, report):
     import jax
     import jax.numpy as jnp
@@ -357,6 +412,7 @@ def main():
 
     report = []
     validate_minmax(args.interpret, report)
+    validate_fused_reduce(args.interpret, report)
     validate_flash(args.interpret, report)
 
     result = {

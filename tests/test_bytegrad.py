@@ -21,6 +21,8 @@ from bagua_tpu.kernels.minmax_uint8 import (
     decompress_minmax_uint8,
     compress_minmax_uint8_pallas,
     decompress_minmax_uint8_pallas,
+    decompress_reduce_requantize,
+    decompress_reduce_requantize_pallas,
 )
 from bagua_tpu.models.mlp import init_mlp, mse_loss
 from jax.sharding import PartitionSpec as P
@@ -59,6 +61,92 @@ def test_pallas_matches_xla_interpret():
     x_ref = decompress_minmax_uint8(q_ref, mm_ref)
     x = decompress_minmax_uint8_pallas(q, mm, interpret=True)
     np.testing.assert_allclose(np.asarray(x), np.asarray(x_ref), rtol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "shape", [(3, 100), (2, 4095), (1, 7), (5, 129)],
+    ids=["3x100", "2x4095", "1x7", "5x129"],
+)
+def test_pallas_parity_unaligned_chunks(shape):
+    """Chunk sizes that are NOT multiples of the Pallas row alignment (128
+    lanes × 32 rows) must still agree bitwise with the jnp compressor: the
+    Pallas wrappers fall back to the jnp path for unsupported shapes, and
+    that fallback must be invisible at the byte level."""
+    rng = np.random.RandomState(5)
+    chunks = (rng.randn(*shape).astype(np.float32) * 3.0)
+    q_ref, mm_ref = compress_minmax_uint8(jnp.asarray(chunks))
+    q, mm = compress_minmax_uint8_pallas(jnp.asarray(chunks), interpret=True)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(q_ref))
+    np.testing.assert_allclose(np.asarray(mm), np.asarray(mm_ref), rtol=1e-6)
+    x_ref = np.asarray(decompress_minmax_uint8(q_ref, mm_ref))
+    x = np.asarray(decompress_minmax_uint8_pallas(q, mm, interpret=True))
+    assert not np.isnan(x).any()
+    np.testing.assert_allclose(x, x_ref, rtol=1e-6)
+
+
+@pytest.mark.parametrize("value", [0.0, 2.5, -7.0], ids=["zero", "pos", "neg"])
+def test_constant_chunk_roundtrip(value):
+    """A constant chunk hits the mn == mx degenerate branch: the EPS guard
+    keeps the scale finite, both backends emit identical uint8, and the
+    round-trip reproduces the constant without NaNs."""
+    chunks = np.full((2, 4096), value, np.float32)
+    q_ref, mm_ref = compress_minmax_uint8(jnp.asarray(chunks))
+    q, mm = compress_minmax_uint8_pallas(jnp.asarray(chunks), interpret=True)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(q_ref))
+    np.testing.assert_allclose(np.asarray(mm), np.asarray(mm_ref), rtol=1e-6)
+    x = np.asarray(decompress_minmax_uint8(q_ref, mm_ref))
+    assert not np.isnan(x).any()
+    np.testing.assert_allclose(x, chunks, atol=1e-4)
+
+
+@pytest.mark.parametrize("average", [True, False], ids=["avg", "sum"])
+def test_fused_reducer_matches_staged_composition(average):
+    """``decompress_reduce_requantize`` fuses ByteGrad's middle three stages.
+    Its jnp oracle IS the staged composition (same ops, same order), and the
+    Pallas kernel must match it bitwise on the requantized payload — a
+    single differing byte would desync the subsequent all-gather."""
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(4, 4096).astype(np.float32))
+    q, mm = compress_minmax_uint8(x)
+    # staged: decompress → tree-sum → (÷n) → compress
+    dec = decompress_minmax_uint8(q, mm)
+    red = jnp.sum(dec, axis=0, keepdims=True)
+    if average:
+        red = red / q.shape[0]
+    q_staged, mm_staged = compress_minmax_uint8(red)
+    q_fused, mm_fused = decompress_reduce_requantize(q, mm, average=average)
+    np.testing.assert_array_equal(np.asarray(q_fused), np.asarray(q_staged))
+    np.testing.assert_allclose(
+        np.asarray(mm_fused), np.asarray(mm_staged), rtol=1e-6
+    )
+    q_pl, mm_pl = decompress_reduce_requantize_pallas(
+        q, mm, average=average, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(q_pl), np.asarray(q_staged))
+    np.testing.assert_allclose(
+        np.asarray(mm_pl), np.asarray(mm_staged), rtol=1e-6
+    )
+
+
+def test_fused_reducer_unaligned_and_constant():
+    """Fallback + degenerate coverage for the fused reducer: unaligned chunk
+    sizes route to the jnp path bitwise-transparently, and all-constant
+    inputs (mn == mx after reduction) survive requantization without NaNs."""
+    rng = np.random.RandomState(7)
+    q, mm = compress_minmax_uint8(jnp.asarray(rng.randn(3, 100), jnp.float32))
+    q_j, mm_j = decompress_reduce_requantize(q, mm, average=True)
+    q_p, mm_p = decompress_reduce_requantize_pallas(q, mm, average=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(q_p), np.asarray(q_j))
+    np.testing.assert_allclose(np.asarray(mm_p), np.asarray(mm_j), rtol=1e-6)
+
+    const = jnp.full((4, 4096), 1.5, jnp.float32)
+    qc, mmc = compress_minmax_uint8(const)
+    q2, mm2 = decompress_reduce_requantize_pallas(
+        qc, mmc, average=True, interpret=True
+    )
+    out = np.asarray(decompress_minmax_uint8(q2, mm2))
+    assert not np.isnan(out).any()
+    np.testing.assert_allclose(out, 1.5, atol=1e-2)
 
 
 def oracle_compressed_allreduce(per_rank: np.ndarray, average=True):

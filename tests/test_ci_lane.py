@@ -48,3 +48,40 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert ov_flat["census"]["all-reduce"]["count"] == ov_flat["buckets"]
     assert ov_flat["buckets"] < ov_flat["slots"]  # multi-slot plan: the
     # per-bucket count is genuinely distinguishable from per-leaf
+
+
+def test_perf_audit_quick_bytegrad_compressed_census(tmp_path):
+    """Satellite lane: ``--quick --algo=bytegrad`` audits the compressed
+    overlap pipeline — per-bucket uint8 all-to-all/all-gather counts and
+    exact wire-byte parity against the monolithic row — at mlp scale."""
+    out = tmp_path / "audit_bytegrad"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "ci", "perf_audit.py"),
+            "--quick", "--algo=bytegrad", "--model=mlp", "--ddp-only",
+            "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (
+        f"perf_audit --quick --algo=bytegrad failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    assert "compressed overlap wire-pattern assertion passed" in proc.stderr
+
+    with open(str(out) + ".json") as f:
+        audit = json.load(f)
+    rows = audit["ddp"]
+    assert "bytegrad" in rows and "bytegrad[overlap]" in rows
+    mono, ov = rows["bytegrad"], rows["bytegrad[overlap]"]
+    assert ov["overlap"] is True and mono["overlap"] is False
+    assert ov["buckets"] > 1
+    for op in ("all-to-all", "all-gather"):
+        # one u8 payload collective per bucket, byte-identical to monolithic
+        assert ov["census"][op]["by_dtype"]["u8"]["count"] == ov["buckets"]
+        assert (
+            ov["census"][op]["by_dtype"]["u8"]["bytes"]
+            == mono["census"][op]["by_dtype"]["u8"]["bytes"]
+        )

@@ -165,25 +165,68 @@ def test_rebucket_rewraps_under_overlap(group):
 
 
 def test_overlap_rejected_without_support(group):
-    """Guard rails: explicit overlap=True needs overlap_exchange; per-bucket
-    state algorithms are rejected outright; 'auto' degrades to monolithic."""
-    with pytest.raises(ValueError, match="overlap_exchange"):
+    """Guard rails: explicit overlap=True needs overlap_exchange, and the
+    rejection names the algorithm class and the concrete reason; 'auto'
+    degrades to monolithic for unsupported or non-numerics-preserving
+    algorithms."""
+    # No overlap_exchange hook at all → named rejection.
+    with pytest.raises(ValueError, match="AlgorithmImpl.*overlap_exchange"):
         DistributedDataParallel(
-            mse_loss, optax.sgd(0.1), build_algorithm("decentralized"),
-            process_group=group, overlap=True,
-        )
-    with pytest.raises(ValueError):
-        DistributedDataParallel(
-            mse_loss, optax.sgd(0.1),
-            build_algorithm("low_precision_decentralized"),
+            mse_loss, optax.sgd(0.1), build_algorithm("none"),
             process_group=group, overlap=True,
         )
     with pytest.raises(ValueError, match="overlap must be"):
         make_ddp(group, "yes")
 
+    # Decentralized now reports weight-mode overlap: explicit True accepted,
+    # auto on (elementwise exchange — bucket split never changes numerics).
     ddp = DistributedDataParallel(
         mse_loss, optax.sgd(0.1), build_algorithm("decentralized"),
         process_group=group, overlap="auto",
     )
-    assert ddp.overlap_enabled is False
+    assert ddp.overlap_enabled is True
+    assert ddp.impl.overlap_capability().mode == "weight"
+
+    # Low-precision decentralized: supported (post_step granularity switch)
+    # but NOT numerics-preserving — auto must stay monolithic.
+    lp = DistributedDataParallel(
+        mse_loss, optax.sgd(0.1),
+        build_algorithm("low_precision_decentralized"),
+        process_group=group, overlap="auto",
+    )
+    assert lp.overlap_enabled is False
+    cap = lp.impl.overlap_capability()
+    assert cap.supported and not cap.auto and cap.mode == "post_step"
+
     assert make_ddp(group, "auto").overlap_enabled is True
+
+
+def test_auto_never_enables_overlap_for_unstable_step_variant(group):
+    """Regression (satellite): an algorithm whose compiled step variant
+    changes across steps must never get overlap from 'auto', and explicit
+    overlap=True must be rejected with a reason naming the class and the
+    step_variant cause — per-bucket backward anchors would be re-traced
+    inconsistently across variants."""
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithmImpl
+
+    class VariantSwitching(GradientAllReduceAlgorithmImpl):
+        stable_step_variant = False
+
+        def step_variant(self, step):
+            return "even" if step % 2 == 0 else "odd"
+
+    impl = VariantSwitching(group)
+    cap = impl.overlap_capability()
+    assert not cap.supported
+    assert "VariantSwitching" in cap.reason and "step variant" in cap.reason
+
+    ddp = DistributedDataParallel(
+        mse_loss, optax.sgd(0.1), impl, process_group=group, overlap="auto",
+    )
+    assert ddp.overlap_enabled is False
+
+    with pytest.raises(ValueError, match="VariantSwitching.*step variant"):
+        DistributedDataParallel(
+            mse_loss, optax.sgd(0.1), VariantSwitching(group),
+            process_group=group, overlap=True,
+        )
