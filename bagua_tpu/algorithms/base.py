@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from bagua_tpu.bucket import BucketPlan
 from bagua_tpu.communication import BaguaProcessGroup
 from bagua_tpu.env import get_default_bucket_size
+from bagua_tpu.observability.annotations import bucket_scope
 
 
 @dataclasses.dataclass
@@ -99,9 +100,21 @@ class OverlapCapability:
 class AlgorithmImpl:
     """A reified algorithm bound to a process group."""
 
+    #: registry-style short name carried in in-graph trace annotations
+    #: (:func:`bagua_tpu.observability.annotations.bucket_scope`); subclasses
+    #: set it to their registered name so device-trace attribution matches
+    #: the user-facing algorithm string.
+    algo_name = ""
+
     def __init__(self, process_group: BaguaProcessGroup, hierarchical: bool = False):
         self.process_group = process_group
         self.hierarchical = hierarchical
+
+    def annotate(self, bucket_idx, phase: str):
+        """Named scope labeling one bucket's exchange ops in the device trace
+        (``bagua_ex/algo=<name>/bucket=<i>/phase=<phase>``).  Pure metadata —
+        wrapping traced code in it never changes the computation."""
+        return bucket_scope(self.algo_name or type(self).__name__, bucket_idx, phase)
 
     # -- structure ----------------------------------------------------------
 

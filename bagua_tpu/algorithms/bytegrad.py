@@ -73,6 +73,7 @@ def compressed_allreduce(
 
 class ByteGradAlgorithmImpl(AlgorithmImpl):
     supports_overlap = True
+    algo_name = "bytegrad"
 
     def __init__(
         self, process_group, hierarchical: bool = True, average: bool = True,
@@ -111,10 +112,10 @@ class ByteGradAlgorithmImpl(AlgorithmImpl):
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
         flats = ctx.plan.bucketize(grads)
-        out = [
-            self._exchange_flat(flat, spec)
-            for flat, spec in zip(flats, ctx.plan.specs)
-        ]
+        out = []
+        for i, (flat, spec) in enumerate(zip(flats, ctx.plan.specs)):
+            with self.annotate(i, "mono"):
+                out.append(self._exchange_flat(flat, spec))
         return ctx.plan.debucketize(out, grads), params, state
 
     def overlap_exchange(
@@ -128,8 +129,9 @@ class ByteGradAlgorithmImpl(AlgorithmImpl):
         # padded layout exactly — same chunk boundaries, same quantizer
         # inputs, bitwise-identical to the monolithic path.
         spec = ctx.plan.specs[bucket_idx]
-        flat = flatten_bucket_leaves(grads, spec)
-        return split_bucket_flat(self._exchange_flat(flat, spec), spec)
+        with self.annotate(bucket_idx, "overlap"):
+            flat = flatten_bucket_leaves(grads, spec)
+            return split_bucket_flat(self._exchange_flat(flat, spec), spec)
 
 
 class ByteGradAlgorithm(Algorithm):

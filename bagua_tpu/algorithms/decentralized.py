@@ -91,6 +91,7 @@ def _exchange(flat: jnp.ndarray, step, mode: str, axes) -> jnp.ndarray:
 
 class DecentralizedAlgorithmImpl(AlgorithmImpl):
     supports_overlap = True
+    algo_name = "decentralized"
     #: the exchange moves *weights*, which don't data-depend on the backward —
     #: the engine anchors each bucket's collective on its cotangents instead
     #: of wrapping params in a custom_vjp (see OverlapCapability).
@@ -137,20 +138,21 @@ class DecentralizedAlgorithmImpl(AlgorithmImpl):
         # the early-issue the reference gets from starting the exchange at
         # forward-pre and syncing post-backward.
         spec = ctx.plan.specs[bucket_idx]
-        flat = flatten_bucket_leaves(params_leaves, spec)
-        flat = jax.lax.optimization_barrier((flat,) + tuple(grads))[0]
-        comm_round = ctx.step // self.communication_interval
+        with self.annotate(bucket_idx, "overlap"):
+            flat = flatten_bucket_leaves(params_leaves, spec)
+            flat = jax.lax.optimization_barrier((flat,) + tuple(grads))[0]
+            comm_round = ctx.step // self.communication_interval
 
-        if self.communication_interval > 1:
-            flat = jax.lax.cond(
-                ctx.step % self.communication_interval == 0,
-                lambda f: self._exchange_flat(f, comm_round),
-                lambda f: f,
-                flat,
-            )
-        else:
-            flat = self._exchange_flat(flat, comm_round)
-        return split_bucket_flat(flat, spec)
+            if self.communication_interval > 1:
+                flat = jax.lax.cond(
+                    ctx.step % self.communication_interval == 0,
+                    lambda f: self._exchange_flat(f, comm_round),
+                    lambda f: f,
+                    flat,
+                )
+            else:
+                flat = self._exchange_flat(flat, comm_round)
+            return split_bucket_flat(flat, spec)
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
         # The reference op keeps its own counter incremented once per executed
@@ -161,7 +163,10 @@ class DecentralizedAlgorithmImpl(AlgorithmImpl):
 
         def communicate(params):
             flats = ctx.plan.bucketize(params)
-            out = [self._exchange_flat(flat, comm_round) for flat in flats]
+            out = []
+            for i, flat in enumerate(flats):
+                with self.annotate(i, "mono"):
+                    out.append(self._exchange_flat(flat, comm_round))
             return ctx.plan.debucketize(out, params)
 
         if self.communication_interval > 1:
@@ -204,6 +209,7 @@ class LowPrecisionDecentralizedAlgorithmImpl(AlgorithmImpl):
     holds_bucketized_state = True
     supports_overlap = True
     overlap_mode = "post_step"
+    algo_name = "low_precision_decentralized"
 
     def __init__(
         self, process_group, hierarchical: bool = True,
@@ -274,26 +280,29 @@ class LowPrecisionDecentralizedAlgorithmImpl(AlgorithmImpl):
                     allreduce_inplace(f, op=ReduceOp.AVG, axis=INTRA_AXIS) for f in flats
                 ]
             new_flats, new_w, new_l, new_r = [], [], [], []
-            for t, w, left, right in zip(
+            for i, (t, w, left, right) in enumerate(zip(
                 flats, state["weight"], state["left"], state["right"]
-            ):
-                # diff = t + L/3 + R/3 - 5w/3, the reference's addmul sequence
-                diff = t + left / 3.0 + right / 3.0 - w * (5.0 / 3.0)
-                q, mm = compress_minmax_uint8(diff[None])
-                # ring exchange both directions: send to left & right, recv
-                # from left & right (shift +1 receives from the left peer)
-                lq = ppermute_shift(q, 1, axes)
-                lmm = ppermute_shift(mm, 1, axes)
-                rq = ppermute_shift(q, -1, axes)
-                rmm = ppermute_shift(mm, -1, axes)
-                left = left + decompress_minmax_uint8(lq, lmm)[0]
-                right = right + decompress_minmax_uint8(rq, rmm)[0]
-                own = decompress_minmax_uint8(q, mm)[0]
-                t_new = own + w
-                new_flats.append(t_new.astype(t.dtype))
-                new_w.append(t_new.astype(t.dtype))
-                new_l.append(left.astype(t.dtype))
-                new_r.append(right.astype(t.dtype))
+            )):
+                with self.annotate(i, "post_step"):
+                    # diff = t + L/3 + R/3 - 5w/3, the reference's addmul
+                    # sequence
+                    diff = t + left / 3.0 + right / 3.0 - w * (5.0 / 3.0)
+                    q, mm = compress_minmax_uint8(diff[None])
+                    # ring exchange both directions: send to left & right,
+                    # recv from left & right (shift +1 receives from the left
+                    # peer)
+                    lq = ppermute_shift(q, 1, axes)
+                    lmm = ppermute_shift(mm, 1, axes)
+                    rq = ppermute_shift(q, -1, axes)
+                    rmm = ppermute_shift(mm, -1, axes)
+                    left = left + decompress_minmax_uint8(lq, lmm)[0]
+                    right = right + decompress_minmax_uint8(rq, rmm)[0]
+                    own = decompress_minmax_uint8(q, mm)[0]
+                    t_new = own + w
+                    new_flats.append(t_new.astype(t.dtype))
+                    new_w.append(t_new.astype(t.dtype))
+                    new_l.append(left.astype(t.dtype))
+                    new_r.append(right.astype(t.dtype))
             params = ctx.plan.debucketize(new_flats, params)
             return params, {"weight": new_w, "left": new_l, "right": new_r}
 

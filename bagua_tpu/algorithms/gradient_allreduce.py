@@ -43,6 +43,7 @@ from bagua_tpu.communication import (
 
 class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
     supports_overlap = True
+    algo_name = "gradient_allreduce"
 
     def __init__(
         self,
@@ -83,14 +84,16 @@ class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
             # elementwise, so the result is bitwise-identical to the flat
             # path (alignment padding reduces to zeros either way).
             groups = ctx.plan.group_leaves(grads)
-            reduced = [
-                self._from_wire(reduce(self._to_wire(g), op=op), g) for g in groups
-            ]
+            reduced = []
+            for i, g in enumerate(groups):
+                with self.annotate(i, "mono"):
+                    reduced.append(self._from_wire(reduce(self._to_wire(g), op=op), g))
             return ctx.plan.ungroup_leaves(reduced, grads), params, state
         flats = ctx.plan.bucketize(grads)
-        out = [
-            self._from_wire(reduce(self._to_wire(flat), op=op), flat) for flat in flats
-        ]
+        out = []
+        for i, flat in enumerate(flats):
+            with self.annotate(i, "mono"):
+                out.append(self._from_wire(reduce(self._to_wire(flat), op=op), flat))
         return ctx.plan.debucketize(out, grads), params, state
 
     def overlap_exchange(
@@ -105,12 +108,13 @@ class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
         spec = ctx.plan.specs[bucket_idx]
         op = ReduceOp.AVG if self.average else ReduceOp.SUM
         reduce = hierarchical_allreduce_inplace if self.hierarchical else allreduce_inplace
-        if self.fuse == "tuple":
-            grads = list(grads)
-            return self._from_wire(reduce(self._to_wire(grads), op=op), grads)
-        flat = flatten_bucket_leaves(grads, spec)
-        out = self._from_wire(reduce(self._to_wire(flat), op=op), flat)
-        return split_bucket_flat(out, spec)
+        with self.annotate(bucket_idx, "overlap"):
+            if self.fuse == "tuple":
+                grads = list(grads)
+                return self._from_wire(reduce(self._to_wire(grads), op=op), grads)
+            flat = flatten_bucket_leaves(grads, spec)
+            out = self._from_wire(reduce(self._to_wire(flat), op=op), flat)
+            return split_bucket_flat(out, spec)
 
 
 class GradientAllReduceAlgorithm(Algorithm):

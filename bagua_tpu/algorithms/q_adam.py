@@ -81,6 +81,7 @@ class QAdamOptimizer:
 
 class QAdamAlgorithmImpl(AlgorithmImpl):
     supports_overlap = True
+    algo_name = "q_adam"
 
     def __init__(self, process_group, q_adam_optimizer: QAdamOptimizer, hierarchical: bool = True):
         super().__init__(process_group, hierarchical=hierarchical)
@@ -115,7 +116,10 @@ class QAdamAlgorithmImpl(AlgorithmImpl):
 
     def _allreduce_tree(self, tree, ctx, compressed: bool):
         flats = ctx.plan.bucketize(tree)
-        out = [self._exchange_flat(flat, compressed) for flat in flats]
+        out = []
+        for i, flat in enumerate(flats):
+            with self.annotate(i, "mono"):
+                out.append(self._exchange_flat(flat, compressed))
         return ctx.plan.debucketize(out, tree)
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
@@ -189,9 +193,10 @@ class QAdamAlgorithmImpl(AlgorithmImpl):
             flat = flatten_bucket_leaves(m2, spec)
             return split_bucket_flat(self._exchange_flat(flat, compressed=True), spec)
 
-        return jax.lax.cond(
-            ctx.step < self.warmup_steps, warmup, compression, (list(grads), m_leaves)
-        )
+        with self.annotate(bucket_idx, "overlap"):
+            return jax.lax.cond(
+                ctx.step < self.warmup_steps, warmup, compression, (list(grads), m_leaves)
+            )
 
     def finalize_overlap(self, grads, params, state, ctx: StepContext):
         # ``grads`` holds each bucket's per-bucket exchange output assembled

@@ -314,7 +314,11 @@ def _make_overlap_identity(bucket_idx: int, exchange_fn):
         return leaves, None
 
     def bwd(_, cts):
-        return tuple(exchange_fn(bucket_idx, list(cts)))
+        # Label the anchor point itself (the algorithm's overlap_exchange adds
+        # its own algo/bucket/phase scope inside) so even exchanges that skip
+        # the algorithm hook stay attributable in the device trace.
+        with jax.named_scope(f"bagua_overlap_bwd/bucket={int(bucket_idx)}"):
+            return tuple(exchange_fn(bucket_idx, list(cts)))
 
     ident.defvjp(fwd, bwd)
     return ident

@@ -35,6 +35,8 @@ from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
 from bagua_tpu.bucket import BucketPlan, wrap_params_for_overlap
 from bagua_tpu.communication import ALL_AXES, BaguaProcessGroup, get_default_group
 from bagua_tpu.env import get_default_bucket_size
+from bagua_tpu.observability.annotations import step_scope
+from bagua_tpu.observability.core import StepTimer
 from bagua_tpu.utils import SpeedMeter
 
 
@@ -88,6 +90,13 @@ class DistributedDataParallel:
             (``impl.overlap_capability()``); ``"auto"`` (default) enables it
             exactly when the report marks overlap supported AND
             numerics-preserving (``cap.auto``).
+        telemetry: an optional
+            :class:`~bagua_tpu.observability.telemetry.Telemetry` hub.  When
+            attached the engine reports every jit-cache miss (the recompile
+            detector), tags the host's position in the step (watchdog
+            phase heartbeats) and feeds per-step wall time, samples/s, wire
+            bytes and host overhead into the metrics pipeline.  Host-side
+            only; the traced step function is identical with or without it.
     """
 
     def __init__(
@@ -99,6 +108,7 @@ class DistributedDataParallel:
         bucket_size_bytes: Optional[int] = None,
         dp_filter: Optional[Callable[[str], bool]] = None,
         overlap="auto",
+        telemetry=None,
     ):
         self.loss_fn = loss_fn
         self.group = process_group or get_default_group()
@@ -142,6 +152,10 @@ class DistributedDataParallel:
         #: step; read/reset via host_overhead_snapshot().
         self.host_overhead = {"pre": 0.0, "lock_wait": 0.0, "dispatch": 0.0,
                               "post": 0.0, "steps": 0}
+        self.telemetry = telemetry
+        #: host-observed full train_step wall times (ring-buffered) —
+        #: host_overhead_snapshot surfaces its p50/p95/p99 tail
+        self.step_timer = StepTimer()
 
     # -- initialization -----------------------------------------------------
 
@@ -255,7 +269,11 @@ class DistributedDataParallel:
             )
             ctx = StepContext(group=group, step=step, plan=plan, extras={"variant": variant})
 
-            params, algo_state = impl.on_step_start(params, algo_state, ctx)
+            # step_scope frames are pure HLO metadata (device-trace phase
+            # attribution, see observability.annotations) — they never change
+            # the traced computation.
+            with step_scope("algo_start"):
+                params, algo_state = impl.on_step_start(params, algo_state, ctx)
             if overlap:
                 # Per-bucket exchange rides the backward pass.  What rides it
                 # depends on the algorithm's overlap mode (see
@@ -278,9 +296,11 @@ class DistributedDataParallel:
                         )
                         return self.loss_fn(wrapped, b)
 
-                    loss, grads = jax.value_and_grad(overlapped_loss)(params, batch)
+                    with step_scope("fwd_bwd"):
+                        loss, grads = jax.value_and_grad(overlapped_loss)(params, batch)
                 elif mode == "weight":
-                    loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
+                    with step_scope("fwd_bwd"):
+                        loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
                     grad_groups = plan.group_leaves(grads)
                     param_groups = plan.group_leaves(params)
                     new_groups = []
@@ -296,18 +316,23 @@ class DistributedDataParallel:
                         )
                     params = plan.ungroup_leaves(new_groups, params)
                 else:  # "post_step": monolithic step structure, overlap plan
+                    with step_scope("fwd_bwd"):
+                        loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
+                    with step_scope("transform"):
+                        grads, params, algo_state = impl.transform_gradients(
+                            grads, params, algo_state, ctx
+                        )
+                with step_scope("finalize"):
+                    grads, params, algo_state = impl.finalize_overlap(
+                        grads, params, algo_state, ctx
+                    )
+            else:
+                with step_scope("fwd_bwd"):
                     loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
+                with step_scope("transform"):
                     grads, params, algo_state = impl.transform_gradients(
                         grads, params, algo_state, ctx
                     )
-                grads, params, algo_state = impl.finalize_overlap(
-                    grads, params, algo_state, ctx
-                )
-            else:
-                loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
-                grads, params, algo_state = impl.transform_gradients(
-                    grads, params, algo_state, ctx
-                )
             if getattr(impl, "skips_optimizer_update", False):
                 # Accumulating algorithms (no_sync analog) apply the optimizer
                 # only on their boundary steps — a zero-grad update would
@@ -319,16 +344,19 @@ class DistributedDataParallel:
                     )
                     return optax.apply_updates(params, updates), opt_state
 
-                params, opt_state = jax.lax.cond(
-                    impl.is_update_step(step),
-                    apply_update,
-                    lambda operand: (operand[1], operand[2]),
-                    (grads, params, opt_state),
-                )
+                with step_scope("optimizer"):
+                    params, opt_state = jax.lax.cond(
+                        impl.is_update_step(step),
+                        apply_update,
+                        lambda operand: (operand[1], operand[2]),
+                        (grads, params, opt_state),
+                    )
             else:
-                updates, opt_state = self.optimizer.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-            params, algo_state = impl.on_step_end(params, algo_state, ctx)
+                with step_scope("optimizer"):
+                    updates, opt_state = self.optimizer.update(grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+            with step_scope("algo_end"):
+                params, algo_state = impl.on_step_end(params, algo_state, ctx)
 
             new_state = TrainState(
                 params=_restack(params),
@@ -364,22 +392,34 @@ class DistributedDataParallel:
         if self.impl.need_reset(self._host_step):
             self._step_fns = {}
         variant = self.impl.step_variant(self._host_step)
+        tel = self.telemetry
         fn = self._step_fns.get(variant)
         if fn is None:
+            # A jit-cache miss IS the compile event the recompile detector
+            # counts — report it before building so a hang inside tracing
+            # still shows the miss in the telemetry snapshot.
+            if tel is not None:
+                tel.on_compile(variant, self._host_step)
             fn = self._step_fns[variant] = self._build_step(variant)
         self._host_step += 1
         ov = self.host_overhead
+        step_ov = {}
         t0 = time.perf_counter()
         state = self.impl.host_pre_dispatch(state)
         t1 = time.perf_counter()
         ov["pre"] += t1 - t0
+        step_ov["pre"] = t1 - t0
+        if tel is not None:
+            tel.enter_phase("dispatch")
         lock = self.impl.host_dispatch_lock
         if lock is None:
             new_state, losses = fn(state, batch)
             t2 = time.perf_counter()
             ov["dispatch"] += t2 - t1
+            step_ov["dispatch"] = t2 - t1
             self.impl.host_post_dispatch(new_state, self._host_step)
-            ov["post"] += time.perf_counter() - t2
+            step_ov["post"] = time.perf_counter() - t2
+            ov["post"] += step_ov["post"]
         else:
             # Serialize dispatch with the algorithm's background thread: the
             # step donates ``state``, so sampling threads must never race the
@@ -387,12 +427,29 @@ class DistributedDataParallel:
             with lock:
                 t2 = time.perf_counter()
                 ov["lock_wait"] += t2 - t1
+                step_ov["lock_wait"] = t2 - t1
                 new_state, losses = fn(state, batch)
                 t3 = time.perf_counter()
                 ov["dispatch"] += t3 - t2
+                step_ov["dispatch"] = t3 - t2
                 self.impl.host_post_dispatch(new_state, self._host_step)
-                ov["post"] += time.perf_counter() - t3
+                step_ov["post"] = time.perf_counter() - t3
+                ov["post"] += step_ov["post"]
         ov["steps"] += 1
+        wall = time.perf_counter() - t0
+        self.step_timer.tick(wall)
+        if tel is not None:
+            tel.enter_phase("wait")
+            leaves = jax.tree_util.tree_leaves(batch)
+            n_samples = int(leaves[0].shape[0]) if leaves and leaves[0].ndim else 0
+            tel.on_step(
+                step=self._host_step - 1,
+                wall_s=wall,
+                n_samples=n_samples,
+                wire_bytes=self.plan.total_bytes() if self.plan else 0,
+                variant=variant,
+                host_overhead=step_ov,
+            )
         return new_state, losses
 
     def host_overhead_snapshot(self, reset: bool = False) -> dict:
@@ -401,6 +458,9 @@ class DistributedDataParallel:
         n = max(1, ov.pop("steps"))
         out = {f"{k}_ms_per_step": round(v * 1e3 / n, 3) for k, v in ov.items()}
         out["steps"] = n
+        out["step_wall_ms"] = {
+            k: round(v * 1e3, 3) for k, v in self.step_timer.percentiles().items()
+        }
         if reset:
             for k in self.host_overhead:
                 self.host_overhead[k] = 0.0 if k != "steps" else 0

@@ -1,0 +1,75 @@
+"""Observability: spans, step timing, hang watchdog, in-graph bucket
+tracing, device-trace overlap analysis, and structured metrics export.
+
+The package splits by layer — :mod:`~bagua_tpu.observability.core` is the
+host-side primitives (spans/timer/watchdog/profiler),
+:mod:`~bagua_tpu.observability.annotations` the in-graph labels,
+:mod:`~bagua_tpu.observability.trace_analysis` the offline trace parser,
+:mod:`~bagua_tpu.observability.metrics` the registry/JSONL/Prometheus
+plumbing, and :mod:`~bagua_tpu.observability.telemetry` the hub tying them
+to the engine — but the public names all live here.
+"""
+
+from bagua_tpu.observability.core import (
+    ProfilerSession,
+    SpanRecorder,
+    StepTimer,
+    Watchdog,
+)
+from bagua_tpu.observability.annotations import (
+    EXCHANGE_PREFIX,
+    STEP_PREFIX,
+    bucket_scope,
+    parse_exchange_label,
+    parse_step_phase,
+    step_scope,
+)
+from bagua_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    JsonlSink,
+    MetricsRegistry,
+    validate_metrics_event,
+    validate_metrics_file,
+)
+from bagua_tpu.observability.telemetry import RecompileDetector, Telemetry
+from bagua_tpu.observability.trace_analysis import (
+    COLLECTIVE_OPS,
+    analyze_trace,
+    find_trace_file,
+    hlo_op_labels,
+    load_trace_events,
+)
+
+__all__ = [
+    # core
+    "ProfilerSession",
+    "SpanRecorder",
+    "StepTimer",
+    "Watchdog",
+    # annotations
+    "EXCHANGE_PREFIX",
+    "STEP_PREFIX",
+    "bucket_scope",
+    "step_scope",
+    "parse_exchange_label",
+    "parse_step_phase",
+    # metrics
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlSink",
+    "MetricsRegistry",
+    "validate_metrics_event",
+    "validate_metrics_file",
+    # telemetry
+    "RecompileDetector",
+    "Telemetry",
+    # trace analysis
+    "COLLECTIVE_OPS",
+    "analyze_trace",
+    "find_trace_file",
+    "hlo_op_labels",
+    "load_trace_events",
+]

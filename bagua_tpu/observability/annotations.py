@@ -1,0 +1,70 @@
+"""In-graph labeling: attribute device-trace ops to buckets and step phases.
+
+The overlap relaxations only pay off if each bucket's collective really
+rides the backward pass — and the only ground truth is the device trace.
+XLA carries a per-instruction ``op_name`` metadata string assembled from
+``jax.named_scope`` frames, and the profiler's trace events can be joined
+back to it through the instruction name (``args.hlo_op`` in
+``trace.json.gz``).  These helpers emit a *parseable* scope grammar so
+:mod:`bagua_tpu.observability.trace_analysis` can attribute every
+collective span to its ``algo``/``bucket``/``phase`` (the transparent
+fine-grained tracking of T3, arXiv:2401.16677; the reference shipped the
+host-side analog as OTel spans in ``bagua-opentelemetry``):
+
+    bagua_ex/algo=gradient_allreduce/bucket=3/phase=overlap   (exchanges)
+    bagua_step/phase=optimizer                                 (step phases)
+
+``named_scope`` only decorates metadata — it never changes the traced
+computation, so annotated and unannotated steps are bitwise-identical and
+the scopes stay on unconditionally.
+
+Field separators are ``/`` (the scope-nesting separator, which XLA joins
+verbatim into ``op_name``) and ``=``; characters like ``@`` are truncated
+by the MLIR location plumbing and must not appear in scope names.
+"""
+
+import re
+from typing import Dict, Optional
+
+import jax
+
+#: scope-name prefixes (kept short: every annotated HLO op carries them)
+EXCHANGE_PREFIX = "bagua_ex"
+STEP_PREFIX = "bagua_step"
+
+_EXCHANGE_RE = re.compile(
+    EXCHANGE_PREFIX + r"/algo=(?P<algo>[^/]+)/bucket=(?P<bucket>\d+)/phase=(?P<phase>[^/\"]+)"
+)
+_STEP_RE = re.compile(STEP_PREFIX + r"/phase=(?P<phase>[^/\"]+)")
+
+
+def bucket_scope(algo: str, bucket_idx, phase: str):
+    """Named scope labeling one bucket's exchange ops.
+
+    ``algo`` is the algorithm's registry-style name, ``phase`` distinguishes
+    the monolithic tail exchange (``mono``) from the backward-anchored one
+    (``overlap``).  Use as a context manager around the traced exchange."""
+    return jax.named_scope(f"{EXCHANGE_PREFIX}/algo={algo}/bucket={int(bucket_idx)}/phase={phase}")
+
+
+def step_scope(phase: str):
+    """Named scope labeling one engine phase of the train step
+    (``fwd_bwd``, ``optimizer``, ``algo_start``, ``algo_end``,
+    ``finalize``...)."""
+    return jax.named_scope(f"{STEP_PREFIX}/phase={phase}")
+
+
+def parse_exchange_label(op_name: str) -> Optional[Dict]:
+    """Extract ``{algo, bucket, phase}`` from an HLO ``op_name`` metadata
+    string (or any string containing a :func:`bucket_scope` frame); None
+    when the op is not part of a labeled bucket exchange."""
+    m = _EXCHANGE_RE.search(op_name or "")
+    if not m:
+        return None
+    return {"algo": m.group("algo"), "bucket": int(m.group("bucket")), "phase": m.group("phase")}
+
+
+def parse_step_phase(op_name: str) -> Optional[str]:
+    """The engine step phase an op was traced under, if labeled."""
+    m = _STEP_RE.search(op_name or "")
+    return m.group("phase") if m else None

@@ -73,14 +73,22 @@ class StepTimer:
     """Times jitted steps; feeds a SpeedMeter and keeps simple aggregates.
 
     Use ``with timer.step(n_samples): ...`` around dispatch+wait, or call
-    ``tick`` manually.
+    ``tick`` manually.  ``tick`` is thread-safe (the async averager's
+    background thread and the fit loop may both time work), and the last
+    ``window`` step times are kept in a ring buffer so
+    :meth:`percentiles` can report p50/p95/p99 tail latency — the number
+    that catches a stalling input pipeline or a periodic retrace long
+    before the mean moves.
     """
 
-    def __init__(self, speed_meter=None):
+    def __init__(self, speed_meter=None, window: int = 1024):
         self.speed_meter = speed_meter
         self.n_steps = 0
         self.total_time = 0.0
         self.last_step_time = 0.0
+        self._lock = threading.Lock()
+        self._ring = [0.0] * max(1, window)
+        self._ring_n = 0  # total ticks ever; ring holds the last len(_ring)
 
     class _Ctx:
         def __init__(self, timer, n_samples):
@@ -99,15 +107,30 @@ class StepTimer:
         return StepTimer._Ctx(self, n_samples)
 
     def tick(self, elapsed: float, n_samples: int = 0) -> None:
-        self.n_steps += 1
-        self.total_time += elapsed
-        self.last_step_time = elapsed
+        with self._lock:
+            self.n_steps += 1
+            self.total_time += elapsed
+            self.last_step_time = elapsed
+            self._ring[self._ring_n % len(self._ring)] = elapsed
+            self._ring_n += 1
         if self.speed_meter is not None and n_samples:
             self.speed_meter.record(n_samples)
 
     @property
     def mean_step_time(self) -> float:
         return self.total_time / self.n_steps if self.n_steps else 0.0
+
+    def percentiles(self) -> Dict[str, float]:
+        """p50/p95/p99 over the ring-buffered recent step times (seconds);
+        empty dict until the first tick."""
+        with self._lock:
+            n = min(self._ring_n, len(self._ring))
+            recent = sorted(self._ring[:n]) if n else []
+        if not recent:
+            return {}
+        def q(p):
+            return recent[min(len(recent) - 1, int(p * len(recent)))]
+        return {"p50": q(0.50), "p95": q(0.95), "p99": q(0.99)}
 
 
 class Watchdog:
@@ -118,12 +141,31 @@ class Watchdog:
     deadlocked host thread — the watchdog dumps every thread's stack and
     kills the process (exit code 42), letting the launcher's restart logic
     take over.  ``on_timeout`` can override the kill for tests.
+
+    ``BAGUA_WATCHDOG_TIMEOUT_S`` in the environment overrides ``timeout_s``
+    (an operator knob for gang-scheduled jobs whose launch script can't be
+    edited).  ``beat(phase=...)`` tags each heartbeat with the step phase
+    the host was in (``dispatch``/``wait``/``data``), and
+    ``snapshot_provider`` — a zero-arg callable returning a dict, normally
+    :meth:`Telemetry.snapshot <bagua_tpu.observability.telemetry.Telemetry.snapshot>`
+    — is queried at timeout so the dump says *where* the step was stuck
+    (step number, phase, bucket), not just that it stopped.
     """
 
-    def __init__(self, timeout_s: float = 300.0, check_interval_s: Optional[float] = None, on_timeout=None):
+    def __init__(self, timeout_s: float = 300.0, check_interval_s: Optional[float] = None,
+                 on_timeout=None, snapshot_provider=None):
+        env = os.environ.get("BAGUA_WATCHDOG_TIMEOUT_S")
+        if env:
+            try:
+                timeout_s = float(env)
+                logger.info("watchdog timeout overridden by BAGUA_WATCHDOG_TIMEOUT_S=%s", env)
+            except ValueError:
+                logger.warning("ignoring non-numeric BAGUA_WATCHDOG_TIMEOUT_S=%r", env)
         self.timeout_s = timeout_s
         self.check_interval_s = check_interval_s or min(10.0, timeout_s / 3)
         self.on_timeout = on_timeout
+        self.snapshot_provider = snapshot_provider
+        self.last_phase: Optional[str] = None
         self._last_beat = time.monotonic()
         self._armed = False
         self._stopped = threading.Event()
@@ -135,12 +177,24 @@ class Watchdog:
             self._thread.start()
         return self
 
-    def beat(self) -> None:
+    def beat(self, phase: Optional[str] = None) -> None:
+        if phase is not None:
+            self.last_phase = phase
         self._last_beat = time.monotonic()
         self._armed = True
 
     def stop(self) -> None:
         self._stopped.set()
+
+    def _timeout_context(self) -> Dict:
+        """What the host was doing when the heartbeat stopped."""
+        ctx: Dict = {"last_phase": self.last_phase}
+        if self.snapshot_provider is not None:
+            try:
+                ctx["telemetry"] = self.snapshot_provider()
+            except Exception as e:  # the dump must never be lost to a bad hook
+                ctx["telemetry_error"] = f"{type(e).__name__}: {e}"
+        return ctx
 
     def _run(self) -> None:
         while not self._stopped.wait(self.check_interval_s):
@@ -148,15 +202,19 @@ class Watchdog:
                 continue
             silent = time.monotonic() - self._last_beat
             if silent > self.timeout_s:
+                ctx = self._timeout_context()
                 logger.error(
-                    "watchdog: no heartbeat for %.1fs (timeout %.1fs); dumping threads",
+                    "watchdog: no heartbeat for %.1fs (timeout %.1fs); last known "
+                    "position: %s; dumping threads",
                     silent,
                     self.timeout_s,
+                    ctx,
                 )
                 if self.on_timeout is not None:
                     self.on_timeout(silent)
                     self._armed = False
                     continue
+                print(f"bagua watchdog timeout context: {ctx}", file=sys.stderr)
                 faulthandler.dump_traceback(file=sys.stderr)
                 sys.stderr.flush()
                 os._exit(42)

@@ -1,0 +1,275 @@
+"""Structured metrics: counters/gauges/histograms, JSONL events, Prometheus.
+
+The reference fed its autotuner from an OTel span pipeline
+(``bagua-opentelemetry``) and logged speed through ``StatisticalAverage``;
+production TPU jobs additionally need *exportable* per-step evidence — a
+metrics registry a dashboard can scrape and an append-only event stream a
+post-mortem can replay.  Everything here is host-side, stdlib-only and
+thread-safe; nothing touches the traced step.
+
+* :class:`MetricsRegistry` — named counters, gauges and ring-buffer
+  histograms (p50/p95/p99), exportable as a plain dict snapshot or in the
+  Prometheus text exposition format (the *textfile-collector* pattern:
+  write a ``.prom`` file, let node_exporter scrape it — no HTTP server in
+  the training process).
+* :class:`JsonlSink` — one JSON object per line, schema-checked by
+  :func:`validate_metrics_event` (the CI lane validates every emitted
+  event, see ``ci/perf_audit.py --quick``).
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Dict, IO, List, Optional, Union
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlSink",
+    "MetricsRegistry",
+    "validate_metrics_event",
+    "EVENT_REQUIRED_FIELDS",
+]
+
+
+class Counter:
+    """Monotonic counter."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name, self.help = name, help
+        self._lock = threading.Lock()
+        self.value: Union[int, float] = 0
+
+    def inc(self, amount: Union[int, float] = 1) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (inc {amount})")
+        with self._lock:
+            self.value += amount
+
+
+class Gauge:
+    """Last-write-wins scalar."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name, self.help = name, help
+        self.value: float = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+
+class Histogram:
+    """Ring-buffer histogram: O(1) observe, percentiles over the last
+    ``window`` observations (recent-tail semantics — a 10-hour job's p99
+    should reflect the last minutes, not hour one)."""
+
+    def __init__(self, name: str, help: str = "", window: int = 1024):
+        self.name, self.help = name, help
+        self._lock = threading.Lock()
+        self._ring: List[float] = [0.0] * max(1, window)
+        self._n = 0
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._ring[self._n % len(self._ring)] = float(value)
+            self._n += 1
+            self.count += 1
+            self.sum += float(value)
+
+    def percentiles(self) -> Dict[str, float]:
+        with self._lock:
+            n = min(self._n, len(self._ring))
+            recent = sorted(self._ring[:n]) if n else []
+        if not recent:
+            return {}
+        def q(p):
+            return recent[min(len(recent) - 1, int(p * len(recent)))]
+        return {"p50": q(0.50), "p95": q(0.95), "p99": q(0.99)}
+
+
+def _prom_name(name: str) -> str:
+    """Prometheus metric names: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    out = "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+    return out if out and not out[0].isdigit() else "_" + out
+
+
+class MetricsRegistry:
+    """Create-on-first-use registry of named metrics.
+
+    ``registry.counter("steps_total").inc()`` — the same name always
+    returns the same instrument; mixing kinds under one name raises.
+    """
+
+    def __init__(self, prefix: str = "bagua"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Union[Counter, Gauge, Histogram]] = {}
+
+    def _get(self, name: str, kind, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = kind(name, **kwargs)
+            elif not isinstance(m, kind):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(m).__name__}, "
+                    f"requested {kind.__name__}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, Counter, help=help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, Gauge, help=help)
+
+    def histogram(self, name: str, help: str = "", window: int = 1024) -> Histogram:
+        return self._get(name, Histogram, help=help, window=window)
+
+    def snapshot(self) -> Dict:
+        """Plain-dict view: counters/gauges as scalars, histograms as
+        ``{count, sum, p50, p95, p99}``."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        out: Dict = {}
+        for name, m in sorted(metrics.items()):
+            if isinstance(m, Histogram):
+                out[name] = {"count": m.count, "sum": round(m.sum, 6), **m.percentiles()}
+            else:
+                out[name] = m.value
+        return out
+
+    # -- Prometheus text exposition ------------------------------------------
+
+    def to_prometheus(self) -> str:
+        """The text exposition format (one family per metric; histograms as
+        ``_count``/``_sum`` plus pXX gauges — quantile summaries without the
+        streaming-quantile machinery)."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        lines = []
+        for name, m in sorted(metrics.items()):
+            full = _prom_name(f"{self.prefix}_{name}")
+            if m.help:
+                lines.append(f"# HELP {full} {m.help}")
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {full} counter")
+                lines.append(f"{full} {m.value}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {full} gauge")
+                lines.append(f"{full} {m.value}")
+            else:
+                lines.append(f"# TYPE {full} summary")
+                lines.append(f"{full}_count {m.count}")
+                lines.append(f"{full}_sum {m.sum}")
+                for p, v in m.percentiles().items():
+                    lines.append(f'{full}{{quantile="0.{p[1:]}"}} {v}')
+        return "\n".join(lines) + "\n"
+
+    def write_prometheus(self, path: str) -> None:
+        """Atomic textfile export (write-then-rename so a scraper never
+        reads a torn file — the node_exporter textfile-collector contract)."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(self.to_prometheus())
+        os.replace(tmp, path)
+
+
+#: every JSONL event must carry these (the CI schema gate)
+EVENT_REQUIRED_FIELDS = {"ts": (int, float), "event": str, "step": int}
+
+#: per-event-type required payload fields
+EVENT_PAYLOAD_FIELDS = {
+    "step": {
+        "wall_ms": (int, float),
+        "samples_per_s": (int, float),
+        "wire_bytes": int,
+        "variant": str,
+    },
+    "compile": {"variant": str, "retrace": bool},
+    "retrace_alert": {"retraces": int, "window": int},
+}
+
+
+def validate_metrics_event(event: Dict) -> List[str]:
+    """Schema-check one JSONL event; returns a list of problems (empty =
+    valid).  Unknown event types only need the required envelope."""
+    problems = []
+    if not isinstance(event, dict):
+        return [f"event is {type(event).__name__}, not an object"]
+    for field, types in EVENT_REQUIRED_FIELDS.items():
+        if field not in event:
+            problems.append(f"missing required field {field!r}")
+        elif not isinstance(event[field], types):
+            problems.append(
+                f"field {field!r} is {type(event[field]).__name__}, expected {types}"
+            )
+    for field, types in EVENT_PAYLOAD_FIELDS.get(event.get("event", ""), {}).items():
+        if field not in event:
+            problems.append(f"{event.get('event')} event missing field {field!r}")
+        elif not isinstance(event[field], types):
+            problems.append(
+                f"field {field!r} is {type(event[field]).__name__}, expected {types}"
+            )
+    return problems
+
+
+class JsonlSink:
+    """Append-only JSONL event stream (one flat JSON object per line).
+
+    Events are validated on emit; an invalid event raises immediately —
+    a malformed stream is a bug at the emit site, not something a reader
+    should have to defend against."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._f: Optional[IO] = open(path, "a")
+
+    def emit(self, event: Dict) -> None:
+        event.setdefault("ts", time.time())
+        problems = validate_metrics_event(event)
+        if problems:
+            raise ValueError(f"invalid metrics event {event!r}: {problems}")
+        line = json.dumps(event, sort_keys=True)
+        with self._lock:
+            if self._f is None:
+                raise ValueError(f"JsonlSink({self.path}) is closed")
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    def __enter__(self) -> "JsonlSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def validate_metrics_file(path: str) -> List[str]:
+    """Validate every line of a JSONL metrics file; returns problems with
+    line numbers (empty = the whole stream is schema-clean)."""
+    problems = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+            except json.JSONDecodeError as e:
+                problems.append(f"line {i}: not JSON ({e})")
+                continue
+            problems += [f"line {i}: {p}" for p in validate_metrics_event(event)]
+    return problems

@@ -1,0 +1,247 @@
+"""The telemetry hub: one object threading metrics, events, heartbeats and
+recompile detection through the training stack.
+
+Attach a :class:`Telemetry` to the engine
+(``DistributedDataParallel(..., telemetry=...)`` or
+``Trainer(..., telemetry=...)``) and every step feeds it:
+
+* step wall time, samples/s, wire bytes (from the bucket plan) into the
+  :class:`~bagua_tpu.observability.metrics.MetricsRegistry` and the JSONL
+  event stream;
+* a **recompile detector** counting the engine's jit-cache misses per step
+  variant — a silent retrace (batch-shape drift, a weak-typed scalar, an
+  accidental plan change) is the top real-world TPU perf bug and is
+  otherwise invisible: the step just gets 1000x slower for one iteration,
+  every few iterations;
+* phase-tagged :class:`~bagua_tpu.observability.core.Watchdog` heartbeats
+  (``dispatch``/``wait``/``data``) plus a :meth:`snapshot` the watchdog
+  embeds in its hang dump, so a timeout says *where* the step was stuck.
+
+Everything is host-side and optional — an unattached engine pays nothing,
+an attached one ~a few µs of clock reads and dict updates per step.
+"""
+
+import logging
+import time
+from typing import Dict, Optional
+
+from bagua_tpu.observability.core import StepTimer, Watchdog
+from bagua_tpu.observability.metrics import JsonlSink, MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["RecompileDetector", "Telemetry"]
+
+
+class RecompileDetector:
+    """Counts jit-cache misses per step variant and alerts on retrace churn.
+
+    The engine reports every compile through :meth:`record_compile` and
+    every dispatched step through :meth:`record_step`.  The *first* compile
+    of the training run is the expected warmup; every later compile — a
+    re-build of a variant that was already compiled (cache cleared by
+    ``need_reset``/``rebucket``/shape drift) or a brand-new variant
+    appearing mid-run — counts as a **retrace**.  More than
+    ``max_retraces_per_window`` retraces inside any ``window``-step window
+    raises a rate alert (once per quiet period): steady-state training must
+    compile zero times.
+    """
+
+    def __init__(self, window: int = 100, max_retraces_per_window: int = 2):
+        self.window = window
+        self.max_retraces_per_window = max_retraces_per_window
+        self.compiles_by_variant: Dict[str, int] = {}
+        self.steps = 0
+        self.retraces = 0
+        self.alerts = 0
+        self._retrace_steps = []  # step index of each retrace (rate window)
+        self._alerted = False
+
+    def record_compile(self, variant: str, on_alert=None) -> bool:
+        """Register one jit-cache miss; returns True when it counts as a
+        retrace (anything beyond the run's first compile).  ``on_alert``
+        is called with a message when the retrace rate trips the alarm."""
+        first_ever = not self.compiles_by_variant
+        self.compiles_by_variant[variant] = self.compiles_by_variant.get(variant, 0) + 1
+        if first_ever:
+            return False
+        self.retraces += 1
+        self._retrace_steps.append(self.steps)
+        logger.warning(
+            "recompile detector: retrace #%d at step %d (variant %r, compile #%d "
+            "of this variant)",
+            self.retraces, self.steps, variant, self.compiles_by_variant[variant],
+        )
+        recent = [s for s in self._retrace_steps if s > self.steps - self.window]
+        if len(recent) > self.max_retraces_per_window and not self._alerted:
+            self._alerted = True
+            self.alerts += 1
+            msg = (
+                f"recompile detector ALERT: {len(recent)} retraces in the last "
+                f"{self.window} steps (> {self.max_retraces_per_window}); the "
+                "step function is churning — look for batch-shape drift, "
+                "weak-typed scalars or plan changes"
+            )
+            logger.error(msg)
+            if on_alert is not None:
+                on_alert(msg, len(recent))
+        return True
+
+    def record_step(self) -> None:
+        self.steps += 1
+        if self._alerted and all(
+            s <= self.steps - self.window for s in self._retrace_steps
+        ):
+            self._alerted = False  # quiet for a full window: re-arm the alarm
+
+    def report(self) -> Dict:
+        return {
+            "steps": self.steps,
+            "retraces": self.retraces,
+            "alerts": self.alerts,
+            "compiles_by_variant": dict(self.compiles_by_variant),
+        }
+
+
+class Telemetry:
+    """Per-process telemetry hub.
+
+    Args:
+        metrics_jsonl: path for the JSONL event stream (None = no stream).
+        registry: an existing :class:`MetricsRegistry` to feed (default: a
+            fresh one, exposed as ``.registry``).
+        watchdog: a :class:`Watchdog` to heartbeat from the step path; its
+            ``snapshot_provider`` is pointed at :meth:`snapshot` so hang
+            dumps carry the last known (step, phase, bucket, variant).
+        retrace_window / max_retraces_per_window: recompile alert rate knobs.
+    """
+
+    def __init__(
+        self,
+        metrics_jsonl: Optional[str] = None,
+        registry: Optional[MetricsRegistry] = None,
+        watchdog: Optional[Watchdog] = None,
+        retrace_window: int = 100,
+        max_retraces_per_window: int = 2,
+    ):
+        self.registry = registry or MetricsRegistry()
+        self.jsonl = JsonlSink(metrics_jsonl) if metrics_jsonl else None
+        self.recompile = RecompileDetector(
+            window=retrace_window, max_retraces_per_window=max_retraces_per_window
+        )
+        self.step_timer = StepTimer()
+        self.watchdog = watchdog
+        if watchdog is not None and watchdog.snapshot_provider is None:
+            watchdog.snapshot_provider = self.snapshot
+        # last known host position — what the watchdog dump reports
+        self.current_phase: str = "init"
+        self.current_step: int = -1
+        self.current_variant: str = ""
+        self._t_start = time.time()
+
+    # -- host position (phases, watchdog) ------------------------------------
+
+    def enter_phase(self, phase: str) -> None:
+        """Mark the host's position in the step (``data`` → ``dispatch`` →
+        ``wait`` → ...) and heartbeat the watchdog with the tag."""
+        self.current_phase = phase
+        if self.watchdog is not None:
+            self.watchdog.beat(phase=phase)
+
+    def snapshot(self) -> Dict:
+        """The last known position + registry snapshot — embedded in the
+        watchdog's timeout dump and exposed for debugging."""
+        return {
+            "step": self.current_step,
+            "phase": self.current_phase,
+            "variant": self.current_variant,
+            "uptime_s": round(time.time() - self._t_start, 1),
+            "recompile": self.recompile.report(),
+            "metrics": self.registry.snapshot(),
+        }
+
+    # -- engine feed ---------------------------------------------------------
+
+    def on_compile(self, variant: str, step: int) -> None:
+        """The engine's jit cache missed: ``variant`` is being (re)built."""
+        self.current_variant = variant
+        retrace = self.recompile.record_compile(variant, on_alert=self._emit_alert)
+        self.registry.counter(
+            "compiles_total", help="step-function compiles (jit cache misses)"
+        ).inc()
+        if retrace:
+            self.registry.counter(
+                "retraces_total", help="compiles beyond the warmup compile"
+            ).inc()
+        if self.jsonl:
+            self.jsonl.emit(
+                {"event": "compile", "step": int(step), "variant": variant,
+                 "retrace": bool(retrace)}
+            )
+
+    def on_step(
+        self,
+        step: int,
+        wall_s: float,
+        n_samples: int,
+        wire_bytes: int,
+        variant: str = "default",
+        host_overhead: Optional[Dict] = None,
+    ) -> None:
+        """One dispatched training step's host-side evidence."""
+        self.current_step = int(step)
+        self.current_variant = variant
+        self.recompile.record_step()
+        self.step_timer.tick(wall_s, n_samples)
+        r = self.registry
+        r.counter("steps_total", help="training steps dispatched").inc()
+        r.counter("samples_total", help="samples processed").inc(max(0, int(n_samples)))
+        r.counter(
+            "wire_bytes_total",
+            help="bytes communicated per rank (bucket-plan census)",
+        ).inc(max(0, int(wire_bytes)))
+        r.histogram("step_wall_ms", help="host-observed step wall time").observe(
+            wall_s * 1e3
+        )
+        sps = (n_samples / wall_s) if wall_s > 0 else 0.0
+        r.gauge("samples_per_s", help="instantaneous throughput").set(round(sps, 3))
+        if self.jsonl:
+            event = {
+                "event": "step", "step": int(step),
+                "wall_ms": round(wall_s * 1e3, 3),
+                "samples_per_s": round(sps, 3),
+                "wire_bytes": int(wire_bytes),
+                "variant": variant,
+            }
+            if host_overhead:
+                event["host_overhead_ms"] = {
+                    k: round(v * 1e3, 4) for k, v in host_overhead.items()
+                }
+            self.jsonl.emit(event)
+
+    def _emit_alert(self, msg: str, retraces_in_window: int) -> None:
+        self.registry.counter(
+            "retrace_alerts_total", help="recompile-rate alarms raised"
+        ).inc()
+        if self.jsonl:
+            self.jsonl.emit(
+                {"event": "retrace_alert", "step": int(self.current_step),
+                 "retraces": int(retraces_in_window),
+                 "window": self.recompile.window, "message": msg}
+            )
+
+    # -- export / teardown ---------------------------------------------------
+
+    def export_prometheus(self, path: str) -> None:
+        """Write the registry as a Prometheus textfile (atomic)."""
+        self.registry.write_prometheus(path)
+
+    def close(self) -> None:
+        if self.jsonl:
+            self.jsonl.close()
+
+    def __enter__(self) -> "Telemetry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -27,12 +27,21 @@ class Trainer:
             auto-resumes from the latest checkpoint on startup.
         autotune_model_name: if set (and the autotune service is reachable),
             runs the report/ask/re-bucket cycle.
-        watchdog_timeout_s: hang detector (0 disables).
+        watchdog_timeout_s: hang detector (0 disables;
+            ``BAGUA_WATCHDOG_TIMEOUT_S`` in the environment overrides a
+            non-zero value).
         profile_dir: if set, captures ONE xprof trace of fit-loop iterations
             ``[profile_steps[0], profile_steps[1])`` (half-open; default
             iterations 10-12, past compilation) into this directory.  One
             capture per Trainer, even across multiple ``fit()`` calls; a
             window cut short by the end of an epoch is closed and kept.
+        telemetry: opt-in
+            :class:`~bagua_tpu.observability.telemetry.Telemetry` hub, passed
+            through to the DDP engine.  The trainer additionally tags the
+            watchdog's heartbeats with the fit loop's phase (``data`` while
+            pulling the next batch) and points the watchdog's hang dump at
+            the hub's snapshot, so a timeout names the step/phase/variant the
+            job died in.
     """
 
     def __init__(
@@ -48,6 +57,7 @@ class Trainer:
         dp_filter=None,
         profile_dir: Optional[str] = None,
         profile_steps: Tuple[int, int] = (10, 13),
+        telemetry=None,
     ):
         # Env-gated persistent compile cache (BAGUA_COMPILE_CACHE_DIR): a
         # restarted trainer deserializes the step executable instead of
@@ -58,8 +68,10 @@ class Trainer:
         cache_dir = setup_compile_cache()
         if cache_dir:
             logger.info("persistent compilation cache at %s", cache_dir)
+        self.telemetry = telemetry
         self.ddp = DistributedDataParallel(
-            loss_fn, optimizer, algorithm, process_group=process_group, dp_filter=dp_filter
+            loss_fn, optimizer, algorithm, process_group=process_group,
+            dp_filter=dp_filter, telemetry=telemetry,
         )
         self.ckpt_dir = ckpt_dir
         self.ckpt_interval = ckpt_interval
@@ -68,6 +80,13 @@ class Trainer:
         self.watchdog = (
             Watchdog(watchdog_timeout_s).start() if watchdog_timeout_s > 0 else None
         )
+        if self.watchdog is not None and telemetry is not None:
+            # hub heartbeats carry the step phase; hang dumps carry the hub's
+            # snapshot (step, phase, variant, recompile report)
+            if telemetry.watchdog is None:
+                telemetry.watchdog = self.watchdog
+            if self.watchdog.snapshot_provider is None:
+                self.watchdog.snapshot_provider = telemetry.snapshot
         self._session: Optional[AutotuneSession] = None
         # xprof capture of steps [a, b) once compilation has settled
         # (docs/performance.md "profile -> fix -> repeat").
@@ -142,6 +161,10 @@ class Trainer:
                     float(losses.mean()),
                     self.ddp.speed_meter.speed(30.0),
                 )
+            if self.telemetry is not None:
+                # about to pull the next batch — a hang here is the input
+                # pipeline's, not the device's
+                self.telemetry.enter_phase("data")
         if losses is not None:
             jax.block_until_ready(losses)
         if self._profiler is not None:

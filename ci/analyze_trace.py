@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""Measured overlap efficiency from an XLA profiler capture.
+
+CLI/driver face of :mod:`bagua_tpu.observability.trace_analysis`: point it
+at a profiler log dir (``jax.profiler.trace`` /
+``bagua_tpu.observability.ProfilerSession`` output) and it reports, per
+labeled ``(algo, bucket)``, how much of each collective span ran hidden
+under concurrent compute — the device's own verdict on the overlap
+relaxations that PERF_AUDIT only asserts structurally.
+
+Bucket attribution needs the compiled HLO of the captured step (the join is
+instruction name → ``op_name`` metadata → bucket label); pass it with
+``--hlo``.  Without it only the aggregate ``measured_overlap_frac`` is
+reported and every span lands in ``unattributed``.
+
+Usage::
+
+    # from a Trainer(profile_dir=...) / ProfilerSession capture:
+    python ci/analyze_trace.py /tmp/bagua_trace --hlo step.hlo.txt
+
+    # aggregate only (no HLO at hand):
+    python ci/analyze_trace.py /tmp/bagua_trace
+
+``ci/trace_vgg16.py`` drives :func:`analyze` in-process to record
+``measured_overlap_frac`` in ``TRACE_VGG16.json``.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable from any cwd without an editable install
+    sys.path.insert(0, REPO)
+
+from bagua_tpu.observability.trace_analysis import analyze_trace
+
+
+def analyze(log_dir, hlo_text=None, module=None):
+    """In-process entry point (what ``ci/trace_vgg16.py`` calls)."""
+    return analyze_trace(log_dir, hlo_text=hlo_text, module=module)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace_dir", help="profiler log dir or .trace.json.gz path")
+    ap.add_argument(
+        "--hlo", default=None,
+        help="compiled HLO text file of the captured step (enables per-bucket "
+        "attribution)",
+    )
+    ap.add_argument(
+        "--module", default=None,
+        help="restrict to events of this hlo_module (default: the module "
+        "named in --hlo, or all modules)",
+    )
+    ap.add_argument("--out", default=None, help="also write the report as JSON")
+    args = ap.parse_args()
+
+    hlo_text = None
+    if args.hlo:
+        with open(args.hlo) as f:
+            hlo_text = f.read()
+    report = analyze(args.trace_dir, hlo_text=hlo_text, module=args.module)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    print(json.dumps(report, indent=1))
+    print(
+        f"\nmeasured_overlap_frac = {report['measured_overlap_frac']} over "
+        f"{report['collective_spans']} collective spans "
+        f"({report['collective_ms']} ms on the wire, "
+        f"{report['hidden_ms']} ms hidden under compute)",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

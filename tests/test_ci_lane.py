@@ -4,6 +4,8 @@ Drives ``ci/perf_audit.py --quick --model=mlp --ddp-only`` as a subprocess —
 the same entry point CI uses — so a regression in the overlap census (bucket
 collectives merged back into a monolithic tail, or wire bytes drifting from
 the monolithic path) fails the ``not slow`` suite, not just a nightly.  The
+same invocation runs the telemetry smoke (a short instrumented lane whose
+JSONL metrics stream is schema-validated and must be retrace-free).  The
 mlp model keeps this at seconds scale; the VGG16 audit stays in the full
 ``ci/perf_audit.py`` run.
 """
@@ -34,6 +36,25 @@ def test_perf_audit_quick_overlap_census(tmp_path):
         f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
     )
     assert "overlap wire-pattern assertion passed" in proc.stderr
+    assert "telemetry metrics schema check passed" in proc.stderr
+
+    # The telemetry smoke emits a JSONL metrics stream next to --out; hold it
+    # to the event schema here too (belt and braces: the subprocess already
+    # validated it, this catches a validator that silently stopped running).
+    from bagua_tpu.observability import validate_metrics_file
+
+    metrics_path = str(out) + "_metrics.jsonl"
+    assert os.path.exists(metrics_path), "telemetry smoke did not emit metrics"
+    assert validate_metrics_file(metrics_path) == []
+    with open(metrics_path) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    kinds = [e["event"] for e in events]
+    assert kinds.count("compile") == 1, kinds  # warmup only — no retraces
+    assert kinds.count("step") >= 5  # steady-state steps
+    assert all(not e.get("retrace") for e in events if e["event"] == "compile")
+    # Prometheus textfile exported alongside, with the core families present
+    prom = open(str(out) + "_metrics.prom").read()
+    assert "bagua_steps_total" in prom and "bagua_step_wall_ms_count" in prom
 
     with open(str(out) + ".json") as f:
         audit = json.load(f)

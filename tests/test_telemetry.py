@@ -1,0 +1,395 @@
+"""Telemetry hub: in-graph labels, trace analyzer, recompile detector, metrics.
+
+Pins the observability contract end-to-end on the 8-device CPU sim:
+
+* every bucket exchange in the compiled step carries a parseable
+  ``bagua_ex/algo=<a>/bucket=<i>/phase=<p>`` scope (and the engine phases a
+  ``bagua_step/phase=<p>`` scope) — for both the overlap and monolithic paths;
+* the device-trace analyzer attributes the captured collective spans back to
+  the bucket plan: one ``per_bucket`` row per plan bucket, labels matching;
+* the recompile detector reports zero retraces across steady-state steps and
+  at least one (plus a rate alert) when the jit cache churns;
+* the metrics layer (registry, JSONL sink, Prometheus text export) and the
+  StepTimer/Watchdog satellites behave as documented.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.models.mlp import init_mlp, mse_loss
+from bagua_tpu.observability import (
+    Counter,
+    Histogram,
+    JsonlSink,
+    MetricsRegistry,
+    ProfilerSession,
+    RecompileDetector,
+    StepTimer,
+    Telemetry,
+    Watchdog,
+    analyze_trace,
+    parse_exchange_label,
+    parse_step_phase,
+    validate_metrics_event,
+    validate_metrics_file,
+)
+
+GLOBAL_BATCH = 32
+LAYERS = [12, 16, 16, 4]
+
+
+def make_batch(seed=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(GLOBAL_BATCH, LAYERS[0]).astype(np.float32))
+    y = jnp.asarray(rng.randn(GLOBAL_BATCH, LAYERS[-1]).astype(np.float32))
+    return x, y
+
+
+def make_ddp(group, overlap, telemetry=None, bucket_size=1 << 9):
+    return DistributedDataParallel(
+        mse_loss,
+        optax.sgd(0.1),
+        GradientAllReduceAlgorithm(),
+        process_group=group,
+        bucket_size_bytes=bucket_size,  # small: forces several buckets
+        overlap=overlap,
+        telemetry=telemetry,
+    )
+
+
+def compiled_hlo(ddp, state, batch):
+    """Compiled HLO text of the (single) cached step variant."""
+    assert len(ddp._step_fns) == 1, ddp._step_fns.keys()
+    (fn,) = ddp._step_fns.values()
+    return fn.lower(state, batch).compile().as_text()
+
+
+def op_name_labels(hlo):
+    return re.findall(r'op_name="([^"]*)"', hlo)
+
+
+# -- scope grammar round-trips ------------------------------------------------
+
+
+def test_parse_exchange_label_roundtrip():
+    lab = parse_exchange_label(
+        "jit(step)/bagua_ex/algo=bytegrad/bucket=12/phase=mono/convert"
+    )
+    assert lab == {"algo": "bytegrad", "bucket": 12, "phase": "mono"}
+    assert parse_exchange_label("jit(step)/transpose/all-reduce") is None
+    assert parse_exchange_label("") is None and parse_exchange_label(None) is None
+
+
+def test_parse_step_phase():
+    assert parse_step_phase("jit(step)/bagua_step/phase=fwd_bwd/dot") == "fwd_bwd"
+    assert parse_step_phase("jit(step)/dot") is None
+
+
+# -- in-graph annotations in the compiled step --------------------------------
+
+
+def test_overlap_step_hlo_carries_bucket_labels(group):
+    """Every plan bucket's exchange is labeled phase=overlap in the compiled
+    overlap step, and the engine phases are labeled too."""
+    ddp = make_ddp(group, overlap=True)
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    batch = make_batch()
+    state, _ = ddp.train_step(state, batch)
+    assert ddp.plan.num_buckets > 1  # multi-bucket: labels are per-bucket facts
+
+    labels = op_name_labels(compiled_hlo(ddp, state, batch))
+    ex = [lab for lab in map(parse_exchange_label, labels) if lab]
+    assert ex, "no bucket-exchange labels in compiled HLO"
+    assert {e["algo"] for e in ex} == {"gradient_allreduce"}
+    assert {e["phase"] for e in ex} == {"overlap"}
+    assert {e["bucket"] for e in ex} == set(range(ddp.plan.num_buckets))
+
+    phases = {p for p in map(parse_step_phase, labels) if p}
+    assert "fwd_bwd" in phases and "optimizer" in phases
+
+
+def test_monolithic_step_hlo_carries_mono_labels(group):
+    ddp = make_ddp(group, overlap=False)
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    batch = make_batch()
+    state, _ = ddp.train_step(state, batch)
+
+    labels = op_name_labels(compiled_hlo(ddp, state, batch))
+    ex = [lab for lab in map(parse_exchange_label, labels) if lab]
+    assert {e["phase"] for e in ex} == {"mono"}
+    assert {e["bucket"] for e in ex} == set(range(ddp.plan.num_buckets))
+
+
+# -- trace analyzer on a CPU-captured profiler session ------------------------
+
+
+def test_trace_analyzer_attributes_plan_buckets(group, tmp_path):
+    """Acceptance: the analyzer's per-bucket collective spans match the
+    bucket plan (count and labels) on a CPU ProfilerSession capture."""
+    ddp = make_ddp(group, overlap=True)
+    state = ddp.init(init_mlp(jax.random.PRNGKey(1), LAYERS))
+    batch = make_batch(seed=1)
+    state, _ = ddp.train_step(state, batch)  # warmup compile outside capture
+    hlo = compiled_hlo(ddp, state, batch)
+
+    prof_dir = str(tmp_path / "trace")
+    prof = ProfilerSession(prof_dir)
+    state, _ = prof.trace_steps(ddp.train_step, state, [batch, batch])
+
+    report = analyze_trace(prof_dir, hlo_text=hlo)
+    assert report["collective_spans"] > 0
+    assert 0.0 <= report["measured_overlap_frac"] <= 1.0
+
+    rows = report["per_bucket"]
+    assert len(rows) == ddp.plan.num_buckets  # one row per plan bucket
+    assert [r["bucket"] for r in rows] == list(range(ddp.plan.num_buckets))
+    for r in rows:
+        assert r["algo"] == "gradient_allreduce"
+        assert r["phases"] == ["overlap"]
+        assert r["spans"] > 0
+        assert all(op.startswith("all-reduce") for op in r["hlo_ops"])
+    # the step's only collectives are the labeled bucket exchanges
+    assert report["unattributed"] is None
+    ddp.shutdown()
+
+
+def test_trace_analyzer_without_hlo_is_aggregate_only(group, tmp_path):
+    ddp = make_ddp(group, overlap=True)
+    state = ddp.init(init_mlp(jax.random.PRNGKey(2), LAYERS))
+    batch = make_batch(seed=2)
+    state, _ = ddp.train_step(state, batch)
+
+    prof_dir = str(tmp_path / "trace")
+    state, _ = ProfilerSession(prof_dir).trace_steps(ddp.train_step, state, [batch])
+
+    report = analyze_trace(prof_dir)  # no hlo_text: no join table
+    assert report["collective_spans"] > 0
+    assert report["per_bucket"] == []
+    assert report["unattributed"]["spans"] == report["collective_spans"]
+    ddp.shutdown()
+
+
+# -- recompile detector -------------------------------------------------------
+
+
+def test_recompile_detector_steady_state_is_quiet():
+    det = RecompileDetector()
+    assert det.record_compile("default") is False  # warmup, not a retrace
+    for _ in range(5):
+        det.record_step()
+    rep = det.report()
+    assert rep == {
+        "steps": 5, "retraces": 0, "alerts": 0,
+        "compiles_by_variant": {"default": 1},
+    }
+
+
+def test_recompile_detector_counts_retraces_and_alerts():
+    alerts = []
+    on_alert = lambda msg, n: alerts.append((msg, n))  # noqa: E731
+    det = RecompileDetector(window=10, max_retraces_per_window=1)
+    det.record_compile("a", on_alert=on_alert)  # warmup
+    assert det.record_compile("b", on_alert=on_alert) is True  # new variant = retrace
+    assert det.record_compile("a", on_alert=on_alert) is True  # re-build = retrace
+    det.record_compile("a", on_alert=on_alert)
+    rep = det.report()
+    assert rep["retraces"] == 3
+    assert rep["alerts"] == 1 and len(alerts) == 1  # latched: one alarm
+    assert "retraces in the last 10 steps" in alerts[0][0]
+
+
+def test_recompile_detector_rearms_after_quiet_window():
+    det = RecompileDetector(window=3, max_retraces_per_window=0)
+    det.record_compile("v")
+    det.record_compile("v")  # retrace -> alert #1
+    assert det.report()["alerts"] == 1
+    for _ in range(3):  # a full quiet window re-arms the alarm
+        det.record_step()
+    det.record_compile("v")  # retrace -> alert #2
+    assert det.report() == {
+        "steps": 3, "retraces": 2, "alerts": 2,
+        "compiles_by_variant": {"v": 3},
+    }
+
+
+def test_ddp_telemetry_steady_state_then_forced_retrace(group, tmp_path):
+    """Acceptance: 0 retraces across 5 steady-state MLP steps; clearing the
+    jit cache (what need_reset/rebucket do) makes the next step a retrace."""
+    jsonl = str(tmp_path / "metrics.jsonl")
+    tel = Telemetry(metrics_jsonl=jsonl, max_retraces_per_window=0)
+    ddp = make_ddp(group, overlap=True, telemetry=tel)
+    state = ddp.init(init_mlp(jax.random.PRNGKey(3), LAYERS))
+    batch = make_batch(seed=3)
+    for _ in range(5):
+        state, _ = ddp.train_step(state, batch)
+    rep = tel.recompile.report()
+    assert rep["steps"] == 5 and rep["retraces"] == 0 and rep["alerts"] == 0
+
+    ddp._step_fns = {}  # forced cache churn: the step variant must rebuild
+    state, _ = ddp.train_step(state, batch)
+    rep = tel.recompile.report()
+    assert rep["retraces"] == 1 and rep["alerts"] == 1
+
+    snap = tel.snapshot()
+    assert snap["phase"] == "wait" and snap["step"] == 5
+    assert snap["metrics"]["steps_total"] == 6
+    assert snap["metrics"]["retrace_alerts_total"] == 1
+    assert snap["metrics"]["step_wall_ms"]["count"] == 6
+    # engine satellite: step-wall percentiles surfaced host-side
+    assert set(ddp.host_overhead_snapshot()["step_wall_ms"]) == {"p50", "p95", "p99"}
+
+    tel.close()
+    assert validate_metrics_file(jsonl) == []
+    with open(jsonl) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    kinds = [e["event"] for e in events]
+    assert kinds.count("step") == 6
+    assert kinds.count("compile") == 2  # warmup + forced retrace
+    assert kinds.count("retrace_alert") == 1
+    retraced = [e["retrace"] for e in events if e["event"] == "compile"]
+    assert retraced == [False, True]
+    step_ev = next(e for e in events if e["event"] == "step")
+    assert step_ev["wire_bytes"] == ddp.plan.total_bytes()
+    assert "host_overhead_ms" in step_ev
+
+    prom_path = str(tmp_path / "metrics.prom")
+    tel.export_prometheus(prom_path)
+    prom = open(prom_path).read()
+    assert "bagua_steps_total 6" in prom
+    assert "bagua_retraces_total 1" in prom
+    assert "bagua_step_wall_ms_count 6" in prom
+    ddp.shutdown()
+
+
+# -- metrics layer ------------------------------------------------------------
+
+
+def test_metrics_registry_instruments():
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(2)
+    with pytest.raises(ValueError):
+        reg.counter("c").inc(-1)  # counters are monotonic
+    with pytest.raises(TypeError):
+        reg.gauge("c")  # kind mismatch under one name
+    reg.gauge("g").set(1.5)
+    for v in range(1, 101):
+        reg.histogram("h").observe(float(v))
+    snap = reg.snapshot()
+    assert snap["c"] == 3 and snap["g"] == 1.5
+    assert snap["h"]["count"] == 100 and snap["h"]["p50"] == 51.0
+
+    prom = reg.to_prometheus()
+    assert "# TYPE bagua_c counter" in prom and "bagua_c 3" in prom
+    assert "# TYPE bagua_g gauge" in prom
+    assert "bagua_h_count 100" in prom and 'bagua_h{quantile="0.50"}' in prom
+
+
+def test_histogram_window_is_recent_tail():
+    h = Histogram("h", window=100)
+    for v in range(1, 2001):
+        h.observe(float(v))
+    # percentiles over the last 100 observations (1901..2000), not the run
+    assert h.percentiles()["p50"] == 1951.0
+    assert h.count == 2000 and h.sum == sum(range(1, 2001))
+
+
+def test_event_schema_validation(tmp_path):
+    ok = {"ts": 1.0, "event": "step", "step": 3, "wall_ms": 1.0,
+          "samples_per_s": 2.0, "wire_bytes": 8, "variant": "default"}
+    assert validate_metrics_event(ok) == []
+    assert validate_metrics_event({"event": "step"})  # missing envelope+payload
+    assert validate_metrics_event({"ts": "now", "event": "x", "step": 0})
+
+    path = str(tmp_path / "ev.jsonl")
+    with JsonlSink(path) as sink:
+        sink.emit(dict(ok))
+        sink.emit({"event": "custom", "step": 0})  # unknown type: envelope only
+        with pytest.raises(ValueError):
+            sink.emit({"event": "compile", "step": 1})  # missing payload fields
+    assert validate_metrics_file(path) == []
+    with open(path, "a") as f:
+        f.write("not json\n")
+        f.write(json.dumps({"event": "step", "step": "three", "ts": 0}) + "\n")
+    problems = validate_metrics_file(path)
+    assert any("not JSON" in p for p in problems)
+    assert any("'step'" in p for p in problems)
+
+
+# -- StepTimer and Watchdog satellites ----------------------------------------
+
+
+def test_step_timer_percentiles_and_thread_safety():
+    timer = StepTimer(window=64)
+    assert timer.percentiles() == {}
+
+    def worker():
+        for _ in range(100):
+            timer.tick(0.01)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert timer.n_steps == 400
+    p = timer.percentiles()
+    assert p["p50"] == p["p95"] == p["p99"] == 0.01
+
+
+def test_watchdog_env_override(monkeypatch):
+    monkeypatch.setenv("BAGUA_WATCHDOG_TIMEOUT_S", "7.5")
+    assert Watchdog(timeout_s=300.0).timeout_s == 7.5
+    monkeypatch.setenv("BAGUA_WATCHDOG_TIMEOUT_S", "not-a-number")
+    assert Watchdog(timeout_s=300.0).timeout_s == 300.0  # ignored, not fatal
+    monkeypatch.delenv("BAGUA_WATCHDOG_TIMEOUT_S")
+    assert Watchdog(timeout_s=120.0).timeout_s == 120.0
+
+
+def test_watchdog_timeout_context_carries_telemetry():
+    tel = Telemetry()
+    tel.current_step, tel.current_phase = 7, "dispatch"
+    wd = Watchdog(timeout_s=60.0, snapshot_provider=tel.snapshot)
+    wd.beat(phase="dispatch")
+    ctx = wd._timeout_context()
+    assert ctx["last_phase"] == "dispatch"
+    assert ctx["telemetry"]["step"] == 7 and ctx["telemetry"]["phase"] == "dispatch"
+
+    def bad():
+        raise RuntimeError("boom")
+
+    wd.snapshot_provider = bad
+    ctx = wd._timeout_context()  # a broken hook must not lose the dump
+    assert "telemetry" not in ctx and "boom" in ctx["telemetry_error"]
+
+
+def test_watchdog_fires_with_phase_tag():
+    fired = []
+    wd = Watchdog(
+        timeout_s=0.15, check_interval_s=0.05, on_timeout=lambda s: fired.append(s)
+    ).start()
+    wd.beat(phase="wait")
+    deadline = time.time() + 3.0
+    while not fired and time.time() < deadline:
+        time.sleep(0.05)
+    wd.stop()
+    assert fired and wd.last_phase == "wait"
+
+
+def test_telemetry_wires_watchdog_snapshot():
+    wd = Watchdog(timeout_s=60.0)
+    tel = Telemetry(watchdog=wd)
+    assert wd.snapshot_provider == tel.snapshot  # bound to this hub
+    tel.enter_phase("data")
+    assert wd.last_phase == "data" and tel.current_phase == "data"
