@@ -141,6 +141,10 @@ class DistributedDataParallel:
         # tensors_to_buckets; init() refreshes it before computing the plan.
         self.impl.overlap_hint = self.overlap_enabled
         self.plan: Optional[BucketPlan] = None
+        #: monotonic bucket-plan version: 0 = the init() plan, +1 per
+        #: rebucket() — exported as the telemetry ``plan_version`` gauge so a
+        #: dashboard can line up throughput shifts with plan swaps
+        self.plan_version = 0
         self._step_fns = {}
         self._host_step: Optional[int] = None  # seeded from state on first step
         self.speed_meter = SpeedMeter()
@@ -238,12 +242,17 @@ class DistributedDataParallel:
 
     # -- re-bucketing (autotune) -------------------------------------------
 
-    def rebucket(self, plan: BucketPlan) -> None:
+    def rebucket(self, plan: BucketPlan, predicted_exposed_ms: Optional[float] = None) -> None:
         """Adopt a new bucket plan; next step re-jits (reference
         ``_reset_buckets``).  Under overlap mode the per-bucket ``custom_vjp``
         wrappers are re-derived from the new plan at the next ``_build_step``
         (wrapping happens inside the step trace), so re-bucketing re-wraps
-        correctly with no extra bookkeeping."""
+        correctly with no extra bookkeeping.
+
+        ``predicted_exposed_ms`` — the trace-driven planner's predicted
+        exposed-communication time for this plan (when it proposed it) —
+        rides into the telemetry ``rebucket`` record so post-run analysis can
+        compare prediction against the next trace's measurement."""
         if getattr(self.impl, "holds_bucketized_state", False):
             raise ValueError(
                 f"{type(self.impl).__name__} keeps per-bucket state; "
@@ -253,6 +262,14 @@ class DistributedDataParallel:
         self.plan = plan
         self.impl.bind_plan(plan)
         self._step_fns = {}
+        self.plan_version += 1
+        if self.telemetry is not None:
+            self.telemetry.on_rebucket(
+                plan_version=self.plan_version,
+                n_buckets=plan.num_buckets,
+                step=self._host_step if self._host_step is not None else 0,
+                predicted_exposed_ms=predicted_exposed_ms,
+            )
 
     # -- the step -----------------------------------------------------------
 
@@ -483,25 +500,133 @@ class DistributedDataParallel:
 
     # -- convenience --------------------------------------------------------
 
-    def profile_bucket_order(self, state: TrainState, batch):
-        """Measure each bucket's gradient-readiness cost (seconds) with real
-        compiled executions — the TPU analog of the reference learning tensor
-        order from measured backward-hook spans (``autotune_service.py:274-294``)
-        rather than assuming the declaration order.
+    def profile_bucket_order(
+        self,
+        state: TrainState,
+        batch,
+        return_capture: bool = False,
+        method: str = "auto",
+    ):
+        """Measure each bucket's cotangent-arrival time (seconds) — the TPU
+        analog of the reference learning tensor order from measured
+        backward-hook spans (``autotune_service.py:274-294``) rather than
+        assuming the declaration order.
 
-        For every bucket a pruned step is jitted that computes *only* that
-        bucket's gradients (XLA dead-code-eliminates the rest of the backward
-        pass), and its wall time is measured after a compile warmup.  A bucket
-        whose tensors sit late in the backward pass (early in the forward)
-        costs more, so sorting buckets by this cost recovers the true
-        readiness order.  Returns ``times`` aligned with ``plan.specs``.
+        Two measurement methods:
 
-        This is a profiling pass (one extra compile per bucket); run it once
-        at session start, like the reference's autotune warmup phase.
+        * ``"single_probe"`` — ONE compiled probe computes the full backward
+          pass and, per bucket, a scalar consumption of that bucket's
+          gradient leaves under a ``bagua_probe/bucket=<i>`` named scope.
+          One AOT compile, one traced execution under the XLA profiler; each
+          bucket's arrival is the start of its earliest labeled device op,
+          relative to the capture's first device op.  This reads the *actual
+          schedule* — meaningful under TPU's latency-hiding scheduler, which
+          places each gradient fusion as early as its data allows.  The XLA
+          CPU scheduler instead places weight-gradient fusions arbitrarily
+          (nothing else consumes them), so on hosts the timestamps reflect
+          scheduling accidents, not readiness.
+        * ``"pruned"`` — one pruned jit per bucket computing *only* that
+          bucket's gradients (the rest of the backward dead-code-eliminated);
+          wall time after warmup approximates the backward depth needed for
+          the bucket's cotangents.  One compile per bucket, but backend
+          agnostic.
+        * ``"auto"`` (default) — ``single_probe`` on TPU, ``pruned``
+          elsewhere.
+
+        A bucket whose tensors sit late in the backward pass (early in the
+        forward) arrives later, so sorting buckets by this time recovers the
+        true readiness order — and the same numbers feed the trace-driven
+        planner's arrival timeline.  Returns ``times`` aligned with
+        ``plan.specs`` (with ``return_capture=True``, ``(times, capture)``
+        where ``capture`` holds the probe's HLO text and trace directory for
+        further analysis).
+
+        This is a profiling pass; run it once at session start, like the
+        reference's autotune warmup phase.  When the single-probe capture
+        yields no labeled events (label lost to fusion, profiler
+        unavailable), it falls back to the pruned probe.
         """
-        import time
+        import math
+        import re as _re
+        import shutil
+        import tempfile
 
         assert self.plan is not None, "call init() first"
+        if method == "auto":
+            method = "single_probe" if jax.default_backend() == "tpu" else "pruned"
+        if method == "pruned":
+            times = self._profile_bucket_order_pruned(state, batch)
+            capture = {"method": "pruned_per_bucket"}
+            return (times, capture) if return_capture else times
+        plan = self.plan
+
+        def local_probe(state, batch):
+            params = _local(state.params)
+            grads = jax.grad(self.loss_fn)(params, batch)
+            groups = plan.group_leaves(grads)
+            probes = []
+            for bi, spec in enumerate(plan.specs):
+                with jax.named_scope(f"bagua_probe/bucket={bi}"):
+                    acc = jnp.zeros((), jnp.float32)
+                    for s in spec.slots:
+                        acc = acc + jnp.sum(groups[bi][s.name].astype(jnp.float32))
+                    probes.append(acc[None])
+            return probes
+
+        from bagua_tpu.observability.core import ProfilerSession
+        from bagua_tpu.observability.trace_analysis import hlo_op_labels, load_trace_events
+
+        times = capture = None
+        log_dir = tempfile.mkdtemp(prefix="bagua_probe_")
+        try:
+            compiled = jax.jit(
+                self.group.shard_map(
+                    local_probe,
+                    in_specs=(P(ALL_AXES), P(ALL_AXES)),
+                    out_specs=P(ALL_AXES),
+                )
+            ).lower(state, batch).compile()  # the one extra compile
+            jax.block_until_ready(compiled(state, batch))  # settle (warmup run)
+            with ProfilerSession(log_dir):
+                jax.block_until_ready(compiled(state, batch))
+            hlo_text = compiled.as_text()
+            module, labels = hlo_op_labels(hlo_text)
+            events = load_trace_events(log_dir)
+            scoped = [e for e in events if e["hlo_module"] == module] or events
+            probe_re = _re.compile(r"bagua_probe/bucket=(\d+)")
+            arrivals = {}
+            for e in scoped:
+                m = probe_re.search(labels.get(e["hlo_op"], ""))
+                if m:
+                    bi = int(m.group(1))
+                    arrivals[bi] = min(arrivals.get(bi, math.inf), e["ts"])
+            if len(arrivals) == plan.num_buckets:
+                t0 = min(e["ts"] for e in scoped)
+                times = [(arrivals[bi] - t0) / 1e6 for bi in range(plan.num_buckets)]
+                capture = {
+                    "method": "single_probe",
+                    "hlo_text": hlo_text,
+                    "module": module,
+                    "log_dir": log_dir,
+                    "labeled_buckets": len(arrivals),
+                }
+        except Exception:  # profiler unavailable / trace shape drift
+            times = None
+        finally:
+            if not (return_capture and times is not None):
+                shutil.rmtree(log_dir, ignore_errors=True)
+        if times is None:
+            times = self._profile_bucket_order_pruned(state, batch)
+            capture = {"method": "pruned_per_bucket"}
+        return (times, capture) if return_capture else times
+
+    def _profile_bucket_order_pruned(self, state: TrainState, batch):
+        """Fallback order probe: for every bucket a pruned step is jitted
+        that computes *only* that bucket's gradients (XLA dead-code-eliminates
+        the rest of the backward pass) and its wall time is measured after a
+        compile warmup — one extra compile per bucket, no profiler needed."""
+        import time
+
         times = []
         for spec in self.plan.specs:
             nameset = frozenset(slot.name for slot in spec.slots)
@@ -600,6 +725,22 @@ class AutotuneSession:
         self.spans.report_to_autotune(self.client, self.model_name)
         self.profiled = True
 
+    def report_wire_timings(self, analysis, hierarchical: Optional[bool] = None) -> None:
+        """Ship a device-trace analysis
+        (:func:`~bagua_tpu.observability.trace_analysis.analyze_trace`) to
+        the service as per-bucket ``bucket_wire`` spans — the measured wire
+        timings the service-side planner fits its α–β cost model on.  Call
+        after a profiled window of real training steps; each call refines
+        the model with the live plan's operating point."""
+        if hierarchical is None:
+            hierarchical = bool(getattr(self.ddp.impl, "hierarchical", False))
+        self.spans.record_wire_timings(
+            self.ddp.plan, analysis,
+            intra_size=self.ddp.group.intra_size,
+            hierarchical=hierarchical,
+        )
+        self.spans.report_to_autotune(self.client, self.model_name)
+
     def tick(self, n_samples: int) -> None:
         """Call once per training step with the number of samples processed."""
         self.ddp.record_speed(n_samples)
@@ -633,7 +774,10 @@ class AutotuneSession:
             plan = BucketPlan.from_declarations(
                 proposed, self.ddp._tree_template, align_elems=self.ddp.group.size
             )
-            self.ddp.rebucket(plan)
+            self.ddp.rebucket(
+                plan,
+                predicted_exposed_ms=getattr(hp, "predicted_exposed_ms", None),
+            )
         if changed_hier:
             self.ddp.impl.hierarchical = hp.is_hierarchical_reduce
             self.ddp._step_fns = {}

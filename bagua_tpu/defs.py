@@ -76,6 +76,12 @@ class BaguaHyperparameter(BaseModel):
     #: exchange after it.  Same tri-state contract as ``wire_bf16``: ``None``
     #: means the service is not tuning this dimension.
     overlap: Optional[bool] = None
+    #: the trace-driven planner's predicted exposed (un-hidden) communication
+    #: time for this bucket assignment, in milliseconds — ``None`` when no
+    #: measured spans were reported (pure-BO proposals).  Informational:
+    #: clients thread it into the telemetry hub's re-bucket record so
+    #: predicted-vs-measured drift is auditable per plan swap.
+    predicted_exposed_ms: Optional[float] = None
 
     def update(self, param_dict: Dict) -> "BaguaHyperparameter":
         tmp = self.model_dump()

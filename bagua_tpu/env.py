@@ -70,6 +70,26 @@ def get_autotune_level() -> int:
     return int(os.environ.get("BAGUA_AUTOTUNE", 0))
 
 
+def get_autotune_planner_mode() -> str:
+    """``BAGUA_AUTOTUNE_PLANNER``: how the trace-driven bucket planner
+    participates in autotune (see ``bagua_tpu/service/planner.py``).
+
+    * ``"warmstart"`` (default) — once measured spans arrive, the Bayesian
+      optimizer's initial points are the planner's top-k ranked proposals
+      instead of a cold grid walk; bucket assignment stays the greedy split.
+    * ``"on"`` — warm-start **plus** each proposal's bucket assignment is the
+      planner's DP-optimal contiguous partition (capped at the proposed
+      bucket size) rather than the greedy byte-threshold split.
+    * ``"off"`` — pure Bayesian optimization, no planner (seed behavior).
+
+    Falls back to ``"warmstart"`` (with no error) on unknown values; with no
+    spans reported the planner never activates, so every mode degrades to
+    pure BO.
+    """
+    mode = os.environ.get("BAGUA_AUTOTUNE_PLANNER", "warmstart").strip().lower()
+    return mode if mode in ("on", "off", "warmstart") else "warmstart"
+
+
 def get_autotune_max_samples() -> int:
     return int(os.environ.get("BAGUA_AUTOTUNE_MAX_SAMPLES", 60))
 

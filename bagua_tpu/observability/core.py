@@ -58,6 +58,38 @@ class SpanRecorder:
                 start = cost + j * 1e-9
                 self.record("tensor_ready", slot.name, start, start + 1e-9)
 
+    def record_wire_timings(
+        self, plan, analysis: Dict, intra_size: int = 1, hierarchical: bool = False
+    ) -> None:
+        """Convert a device-trace analysis
+        (:func:`~bagua_tpu.observability.trace_analysis.analyze_trace`) into
+        ``bucket_wire`` spans — the planner's α–β cost-model input.  Each
+        attributed per-bucket row becomes one sample carrying the bucket's
+        wire bytes (from the plan), measured collective seconds and hidden
+        fraction; hierarchical captures tag the leg so intra/inter paths are
+        fitted separately."""
+        for row in analysis.get("per_bucket", []):
+            bi = row.get("bucket")
+            if bi is None or bi >= len(plan.specs):
+                continue
+            seconds = float(row.get("collective_ms", 0.0)) / 1e3
+            if seconds <= 0.0:
+                continue
+            with self._lock:
+                self.spans.append(
+                    {
+                        "action": "bucket_wire",
+                        "tensor_name": f"bucket{bi}",
+                        "start_time": 0.0,
+                        "end_time": seconds,
+                        "nbytes": int(plan.specs[bi].nbytes),
+                        "seconds": seconds,
+                        "leg": "intra" if hierarchical else "flat",
+                        "hidden_frac": float(row.get("overlap_frac", 0.0)),
+                        "intra_size": int(intra_size),
+                    }
+                )
+
     def drain(self) -> List[Dict]:
         with self._lock:
             out, self.spans = self.spans, []

@@ -192,6 +192,9 @@ EVENT_PAYLOAD_FIELDS = {
     },
     "compile": {"variant": str, "retrace": bool},
     "retrace_alert": {"retraces": int, "window": int},
+    # one bucket-plan swap adopted by the engine (autotune re-bucket);
+    # predicted/measured exposed-comm ms ride as optional fields
+    "rebucket": {"plan_version": int, "n_buckets": int},
 }
 
 

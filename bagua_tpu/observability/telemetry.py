@@ -219,6 +219,45 @@ class Telemetry:
                 }
             self.jsonl.emit(event)
 
+    def on_rebucket(
+        self,
+        plan_version: int,
+        n_buckets: int,
+        step: int = 0,
+        predicted_exposed_ms: Optional[float] = None,
+        measured_exposed_ms: Optional[float] = None,
+    ) -> None:
+        """The engine adopted a new bucket plan (autotune re-bucket).
+
+        Exported as the ``plan_version`` gauge + ``rebucket_total`` counter so
+        a Prometheus scrape shows when the plan changed, and as a ``rebucket``
+        JSONL event carrying the planner's predicted exposed-communication
+        time for the new plan next to the measured value (when a device-trace
+        analysis supplied one) — the predicted-vs-measured drift record."""
+        r = self.registry
+        r.counter("rebucket_total", help="bucket-plan swaps adopted by the engine").inc()
+        r.gauge("plan_version", help="monotonic bucket-plan version").set(plan_version)
+        if predicted_exposed_ms is not None:
+            r.gauge(
+                "predicted_exposed_comm_ms",
+                help="planner-predicted exposed communication for the live plan",
+            ).set(round(float(predicted_exposed_ms), 4))
+        if measured_exposed_ms is not None:
+            r.gauge(
+                "measured_exposed_comm_ms",
+                help="trace-measured exposed communication for the live plan",
+            ).set(round(float(measured_exposed_ms), 4))
+        if self.jsonl:
+            event = {
+                "event": "rebucket", "step": int(step),
+                "plan_version": int(plan_version), "n_buckets": int(n_buckets),
+            }
+            if predicted_exposed_ms is not None:
+                event["predicted_exposed_ms"] = round(float(predicted_exposed_ms), 4)
+            if measured_exposed_ms is not None:
+                event["measured_exposed_ms"] = round(float(measured_exposed_ms), 4)
+            self.jsonl.emit(event)
+
     def _emit_alert(self, msg: str, retraces_in_window: int) -> None:
         self.registry.counter(
             "retrace_alerts_total", help="recompile-rate alarms raised"

@@ -23,3 +23,11 @@ from bagua_tpu.service.bayesian_optimizer import (  # noqa: F401
     BoolParam,
     BayesianOptimizer,
 )
+from bagua_tpu.service.planner import (  # noqa: F401
+    AlphaBeta,
+    BucketPlanner,
+    CostModel,
+    PlanResult,
+    WireSample,
+    fit_alpha_beta,
+)

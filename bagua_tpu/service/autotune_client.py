@@ -93,6 +93,12 @@ class AutotuneClient:
             {"model_name": model_name, "spans": spans},
         )
 
+    def get_planner_trail(self, model_name: str) -> Dict:
+        """The service-side trace-driven planner's decision record (mode,
+        cost model, ranked candidates, warm-start points, chosen plan)."""
+        resp = self._post("/api/v1/planner_trail", {"model_name": model_name})
+        return resp.get("trail", {})
+
 
 def get_hyperparameters_service_client() -> AutotuneClient:
     """Build a client pointing at the job's autotune service.

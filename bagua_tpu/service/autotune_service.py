@@ -68,6 +68,13 @@ class AutotuneService:
         # far ahead a fast host loop runs.
         self._rank_latest_ask: Dict[str, Dict[int, int]] = {}
         self._hp_effective: Dict[str, list] = {}  # [(effective_from, hp, final)]
+        # The hp each gang is *currently running*: workers adopt the hp
+        # returned by their latest ask, so the next reported speed was
+        # measured under the last *answer*, not the newest proposal (which
+        # only becomes effective — and adopted — one ask-round later).
+        # Scores must be credited to this, or every sample shifts onto the
+        # next point and the optimizer converges beside the optimum.
+        self._measured_hp: Dict[str, object] = {}
 
     def _manager(self, model_name: str) -> AutotuneTaskManager:
         if model_name not in self._managers:
@@ -125,6 +132,7 @@ class AutotuneService:
             self._hp_effective[model_name] = [
                 (0, mgr.hyperparameter, mgr.sampling_counter >= self.max_samples)
             ]
+            self._measured_hp[model_name] = mgr.hyperparameter
             return {"recommended_hyperparameters": mgr.hyperparameter.model_dump()}
 
     def report_metrics(self, payload: Dict) -> Dict:
@@ -177,7 +185,13 @@ class AutotuneService:
                     and len(latest) >= self.world_size
                 ):
                     score = sum(speeds.values()) / len(speeds)
-                    mgr.tell_and_ask(score, train_iter)
+                    mgr.tell_and_ask(
+                        score,
+                        train_iter,
+                        measured_hp=self._measured_hp.get(
+                            model_name, mgr.hyperparameter
+                        ),
+                    )
                     self._last_sample_time[model_name] = now
                     self._speeds[model_name] = {}
                     final = mgr.sampling_counter >= self.max_samples
@@ -185,6 +199,9 @@ class AutotuneService:
                     self._hp_effective[model_name].append(
                         (max(latest.values()) + 1, new_hp, final)
                     )
+            # whatever we answer is what this gang runs until its next ask —
+            # the configuration the next reported speed is measured under
+            self._measured_hp[model_name] = hp
             return {
                 "recommended_hyperparameters": hp.model_dump(),
                 "is_autotune_completed": is_final,
@@ -195,6 +212,15 @@ class AutotuneService:
         with self._lock:
             self._manager(model_name).report_spans(payload.get("spans", []))
         return {"status": "ok"}
+
+    def planner_trail(self, payload: Dict) -> Dict:
+        """The trace-driven planner's decision record for one model: mode,
+        fitted cost model, ranked candidates, warm-start points, DP-vs-greedy
+        predicted costs and the chosen proposal (see
+        ``AutotuneTaskManager.decision_trail``)."""
+        model_name = payload["model_name"]
+        with self._lock:
+            return {"trail": self._manager(model_name).decision_trail}
 
     # -- HTTP plumbing ---------------------------------------------------------
 
@@ -231,6 +257,7 @@ class AutotuneService:
                     "/api/v1/report_metrics": service.report_metrics,
                     "/api/v1/ask_hyperparameters": service.ask_hyperparameters,
                     "/api/v1/report_tensor_execution_order": service.report_tensor_execution_order,
+                    "/api/v1/planner_trail": service.planner_trail,
                 }
                 fn = routes.get(self.path)
                 if fn is None:

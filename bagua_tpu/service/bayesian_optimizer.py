@@ -48,14 +48,61 @@ class BayesianOptimizer:
         self._scales[self._scales == 0] = 1.0
         self.xs: List[np.ndarray] = []
         self.ys: List[float] = []
+        # Initial sampling walks the seeded draw sequence *deduplicated*
+        # (first-appearance order of with-replacement draws, then whatever
+        # the draws missed): deterministic under ``seed`` and free of
+        # duplicate proposals — each re-proposed point wastes a recompile on
+        # the client, which re-jits for a plan it already measured.
+        self._initial_order = self._dedup_draw_order()
+        self._initial_idx = 0
+        # Warm-start queue: externally ranked proposals (the trace-driven
+        # planner's top-k) served before the cold permutation walk.
+        self._pending: List[np.ndarray] = []
+
+    def _dedup_draw_order(self) -> np.ndarray:
+        n = len(self._grid)
+        draws = self.rng.randint(n, size=4 * n)  # coupon-collector headroom
+        seen = set()
+        order = []
+        for i in draws:
+            if i not in seen:
+                seen.add(int(i))
+                order.append(int(i))
+        order.extend(i for i in range(n) if i not in seen)
+        return np.array(order)
 
     # -- API ------------------------------------------------------------
 
+    def warm_start(self, param_dicts: Sequence[Dict[str, int]]) -> None:
+        """Queue proposals for ``ask`` to serve first, in order — already-told
+        points are skipped at ask time, so telling between asks stays safe."""
+        for d in param_dicts:
+            self._pending.append(
+                np.array([float(d.get(p.name, 0)) for p in self.params])
+            )
+
+    def _explored(self):
+        return {tuple(x) for x in self.xs}
+
     def ask(self) -> Dict[str, int]:
-        if len(self.xs) < self.n_initial_points:
-            x = self._grid[self.rng.randint(len(self._grid))]
-        else:
-            x = self._ask_ei()
+        explored = self._explored()
+        x = None
+        while self._pending:
+            cand = self._pending.pop(0)
+            if tuple(cand) not in explored:
+                x = cand
+                break
+        if x is None and len(self.xs) < self.n_initial_points:
+            while self._initial_idx < len(self._initial_order):
+                cand = self._grid[self._initial_order[self._initial_idx]]
+                self._initial_idx += 1
+                if tuple(cand) not in explored:
+                    x = cand
+                    break
+        if x is None:
+            # EI needs at least one observation; before any tell, fall back
+            # to the head of the deterministic permutation.
+            x = self._ask_ei() if self.xs else self._grid[self._initial_order[0]]
         return {p.name: int(v) for p, v in zip(self.params, x)}
 
     def tell(self, param_dict: Dict[str, int], score: float) -> None:

@@ -33,6 +33,8 @@ if "BAGUA_AUTOTUNE_RUN_TPU" not in os.environ:
     os.environ["JAX_PLATFORMS"] = "cpu"
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 import jax
 
@@ -46,11 +48,33 @@ import numpy as np
 import optax
 
 
+def measure_overlap(ddp, state, batch, label):
+    """One profiled step + trace-analysis join against the live step's HLO:
+    the realized ``measured_overlap_frac`` (and per-bucket wire rows) for the
+    plan the engine is running right now."""
+    import tempfile
+
+    from bagua_tpu.observability.core import ProfilerSession
+    from bagua_tpu.observability.trace_analysis import analyze_trace
+
+    variant = ddp.impl.step_variant(ddp._host_step or 0)
+    fn = ddp._step_fns.get(variant)
+    if fn is None:
+        state, _ = ddp.train_step(state, batch)  # populate the jit cache
+        fn = ddp._step_fns[ddp.impl.step_variant(ddp._host_step - 1)]
+    hlo = fn.lower(state, batch).compile().as_text()
+    prof_dir = tempfile.mkdtemp(prefix=f"bagua_autotune_{label}_")
+    state, _ = ProfilerSession(prof_dir).trace_steps(ddp.train_step, state, [batch])
+    analysis = analyze_trace(prof_dir, hlo_text=hlo)
+    return state, analysis
+
+
 def main():
     import bagua_tpu
     from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
     from bagua_tpu.ddp import AutotuneSession, DistributedDataParallel
     from bagua_tpu.models.mlp import init_mlp, mse_loss
+    from bagua_tpu.observability import Telemetry
     from bagua_tpu.service.autotune_client import AutotuneClient
     from bagua_tpu.service.autotune_service import AutotuneService, start_autotune_server
 
@@ -70,9 +94,10 @@ def main():
     trace = {"backend": jax.default_backend(), "samples": [], "devices": n}
     try:
         client = AutotuneClient(port=srv.server_address[1])
+        telemetry = Telemetry()
         ddp = DistributedDataParallel(
             mse_loss, optax.sgd(0.01), GradientAllReduceAlgorithm(),
-            process_group=group, bucket_size_bytes=1 << 15,
+            process_group=group, bucket_size_bytes=1 << 15, telemetry=telemetry,
         )
         state = ddp.init(params)
         session = AutotuneSession(ddp, "autotune_real", client=client, interval=5)
@@ -81,6 +106,19 @@ def main():
 
         rng = np.random.RandomState(0)
         batch_sz = 8 * n
+        probe_batch = (
+            jnp.asarray(rng.randn(batch_sz, dims[0]), jnp.float32),
+            jnp.asarray(rng.randn(batch_sz, dims[-1]), jnp.float32),
+        )
+        # Single-probe arrival measurement -> tensor_ready spans -> the
+        # service-side planner's arrival timeline.
+        session.profile_and_report(state, probe_batch)
+        # Realized overlap of the seed plan (one profiled step), shipped as
+        # per-bucket bucket_wire spans so the planner's cost model fits on a
+        # measured operating point before tuning starts.
+        state, before = measure_overlap(ddp, state, probe_batch, "before")
+        session.report_wire_timings(before)
+        trace["overlap_frac_before"] = before["measured_overlap_frac"]
         rebuckets = 0
         last_buckets = n_buckets_initial
         t_start = time.time()
@@ -126,6 +164,21 @@ def main():
         trace["rebuckets"] = rebuckets
         trace["final_buckets"] = ddp.plan.num_buckets
         trace["wall_s"] = round(time.time() - t_start, 1)
+
+        # Realized overlap of the locked plan — the before/after pair closes
+        # the planner's predicted-vs-measured loop in the committed artifact.
+        state, after = measure_overlap(ddp, state, probe_batch, "after")
+        trace["overlap_frac_after"] = after["measured_overlap_frac"]
+        # The service-side planner's full decision record (mode, fitted cost
+        # model, ranked candidates, warm-start points, DP-vs-greedy summary,
+        # chosen plan) over the HTTP surface workers actually use.
+        trace["planner_trail"] = client.get_planner_trail("autotune_real")
+        tel_snap = telemetry.registry.snapshot()
+        trace["telemetry"] = {
+            k: tel_snap[k]
+            for k in ("rebucket_total", "plan_version", "predicted_exposed_comm_ms")
+            if k in tel_snap
+        }
 
         assert completed_at is not None, "autotune session never completed"
         assert rebuckets >= 1, "service never changed the plan (no real tuning)"

@@ -310,6 +310,76 @@ def telemetry_smoke(out_prefix: str, steps: int = 6):
     return metrics_path
 
 
+def autotune_planner_lane(fixture_path=None):
+    """Recorded-span planner gate (pure cost model, no compile — CPU-safe).
+
+    Replays the committed VGG16 span fixture (``ci/record_vgg16_spans.py``)
+    through the trace-driven bucket planner and asserts its DP partition
+    predicts *strictly lower* exposed-communication time than the seed greedy
+    byte-threshold plan evaluated under the same cost model — the planner's
+    core claim, held on a recorded operating point every CI run.  A second
+    scheduler-trusting pass (η = 1, minimize the un-hidden tail) must also
+    not lose to greedy.  tests/test_ci_lane.py greps the sentinel.
+    """
+    from bagua_tpu.bucket import split_declarations
+    from bagua_tpu.defs import TensorDeclaration
+    from bagua_tpu.service.planner import BucketPlanner, CostModel, WireSample
+
+    path = fixture_path or os.path.join(REPO, "ci", "fixtures", "vgg16_bucket_spans.json")
+    with open(path) as f:
+        fx = json.load(f)
+    decls = [TensorDeclaration(**d) for d in fx["declarations"]]
+    samples = [WireSample(**s) for s in fx["wire_samples"]]
+    cost_model = CostModel.from_samples(samples)
+    # η = seconds-weighted measured overlap fraction of the recorded spans
+    attributed = [s for s in samples if s.hidden_frac is not None]
+    tot_s = sum(s.seconds for s in attributed)
+    eta = (
+        sum(s.hidden_frac * s.seconds for s in attributed) / tot_s if tot_s else 1.0
+    )
+    shapes = {td.name: (td.num_elements,) for td in decls}
+    greedy_specs = split_declarations(decls, shapes, fx["seed_bucket_size_bytes"])
+    greedy_buckets = [s.declarations() for s in greedy_specs]
+
+    def run(eta_val):
+        planner = BucketPlanner(
+            decls, fx["arrivals"], cost_model=cost_model, overlap_efficiency=eta_val
+        )
+        return planner.evaluate(greedy_buckets), planner.plan()
+
+    greedy, dp = run(eta)
+    assert dp.predicted_exposed_s < greedy.predicted_exposed_s, (
+        f"planner DP plan ({dp.summary()}) must predict strictly lower exposed "
+        f"comm than the seed greedy plan ({greedy.summary()}) on the recorded "
+        f"fixture (eta={eta})"
+    )
+    greedy_t, dp_t = run(1.0)  # scheduler-trusting pass: tail-only objective
+    assert dp_t.predicted_exposed_s <= greedy_t.predicted_exposed_s + 1e-12, (
+        f"planner DP plan must not lose to greedy at eta=1: "
+        f"{dp_t.summary()} vs {greedy_t.summary()}"
+    )
+    gain_ms = round((greedy.predicted_exposed_s - dp.predicted_exposed_s) * 1e3, 3)
+    print(
+        f"[audit] autotune planner lane passed: DP "
+        f"{dp.summary()['predicted_exposed_ms']} ms exposed < greedy "
+        f"{greedy.summary()['predicted_exposed_ms']} ms "
+        f"({len(greedy_buckets)} greedy buckets -> {dp.n_buckets} planned, "
+        f"gain {gain_ms} ms, eta={round(eta, 4)})",
+        file=sys.stderr,
+    )
+    return {
+        "fixture": os.path.relpath(path, REPO),
+        "n_declarations": len(decls),
+        "cost_model": cost_model.describe(),
+        "overlap_efficiency": round(eta, 6),
+        "greedy_plan": greedy.summary(),
+        "planner_plan": dp.summary(),
+        "gain_ms": gain_ms,
+        "eta1_greedy_plan": greedy_t.summary(),
+        "eta1_planner_plan": dp_t.summary(),
+    }
+
+
 def assert_overlap_census(ddp_results):
     """The overlap acceptance gate (runs on every invocation, incl. --quick).
 
@@ -772,13 +842,17 @@ def main():
     # Executed telemetry gate: emits + schema-validates the metrics stream
     # next to --out and asserts a retrace-free steady state.
     telemetry_smoke(args.out)
+    # Recorded-span planner gate: DP partition must beat the greedy seed
+    # plan's predicted exposed comm on the committed VGG16 fixture.
+    planner_result = autotune_planner_lane()
     fsdp_result = None if args.ddp_only else audit_fsdp()[0]
 
     trace = load_trace_overlap()
     with open(args.out + ".json", "w") as f:
         json.dump(
             {"ddp": ddp_results, "fsdp": fsdp_result, "mesh": n,
-             "model": args.model, "trace_overlap": trace},
+             "model": args.model, "trace_overlap": trace,
+             "autotune_planner": planner_result},
             f, indent=1,
         )
     with open(args.out + ".md", "w") as f:

@@ -1,0 +1,160 @@
+#!/usr/bin/env python3
+"""Distill TRACE_VGG16.json into a recorded span fixture for the planner lane.
+
+The trace-driven bucket planner (``bagua_tpu/service/planner.py``) consumes
+per-tensor cotangent arrival times plus measured wire timings.  On a real-TPU
+session both come straight from the profiler; the CI lane needs a *recorded*
+operating point it can replay on CPU without compiling VGG16.  This script
+derives one from artifacts already in the repo:
+
+* **declarations** — VGG16's parameter tensors via ``jax.eval_shape`` (no
+  weights materialized), named exactly as ``BucketPlan.from_tree`` names
+  leaves (``jax.tree_util.keystr``), so the fixture's greedy seed plan is
+  the plan a real engine would build;
+* **arrival times** — the backward-pass timeline reconstructed from the
+  committed trace artifact's ``forward_stage_breakdown``: the backward
+  visits stages in reverse forward order, each stage's share of the measured
+  ``derived.backward_ms`` proportional to its measured forward time, split
+  evenly over its layers (a layer's params' cotangents arrive when its
+  backward completes);
+* **wire sample** — the one recorded collective operating point
+  (``overlap_trace.collective_ms`` over the full gradient payload).
+
+Output: ``ci/fixtures/vgg16_bucket_spans.json`` (committed).  Regenerate
+after re-running ``ci/trace_vgg16.py``::
+
+    python ci/record_vgg16_spans.py
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+OUT = os.path.join(REPO, "ci", "fixtures", "vgg16_bucket_spans.json")
+
+
+def conv_stage_map(cfg):
+    """conv index -> 1-based stage number (stage boundaries at 'M')."""
+    out, stage, ci = {}, 1, 0
+    for v in cfg:
+        if v == "M":
+            stage += 1
+        else:
+            out[ci] = stage
+            ci += 1
+    return out
+
+
+def main():
+    from bagua_tpu.models.vgg import VGG16_CFG, init_vgg16
+
+    trace = json.load(open(os.path.join(REPO, "TRACE_VGG16.json")))
+    image_size = trace.get("image_size", 64)
+
+    params = jax.eval_shape(
+        lambda k: init_vgg16(k, image_size=image_size)[1], jax.random.PRNGKey(0)
+    )
+    paths_and_leaves, _ = jax.tree_util.tree_flatten_with_path(params)
+
+    # -- backward timeline from the recorded per-stage forward times ---------
+    stages = trace["forward_stage_breakdown"]
+    fwd_sum_ms = sum(s["time_ms"] for s in stages)
+    backward_ms = trace["derived"]["backward_ms"]
+    scale = backward_ms / fwd_sum_ms  # measured bwd/fwd ratio, ~2-3x
+
+    conv_to_stage = conv_stage_map(VGG16_CFG)
+    n_dense = sum(1 for p, _ in paths_and_leaves if "Dense" in jax.tree_util.keystr(p)) // 2
+    # layers per stage key: ints 1..5 for conv stages, "classifier" for dense
+    layers_in_stage = {}
+    for ci, st in conv_to_stage.items():
+        layers_in_stage[st] = layers_in_stage.get(st, 0) + 1
+    layers_in_stage["classifier"] = n_dense
+
+    # Backward visits stages in reverse forward order; inside a stage, the
+    # last forward layer's backward runs first.  Each layer's params arrive
+    # when its backward slice completes.
+    layer_arrival = {}  # ("conv", i) / ("dense", i) -> seconds
+    t = 0.0
+    for s in reversed(stages):
+        st = s["stage"]
+        per_layer_s = s["time_ms"] * scale / 1e3 / layers_in_stage[st]
+        if st == "classifier":
+            members = [("dense", i) for i in reversed(range(n_dense))]
+        else:
+            members = [
+                ("conv", ci) for ci, cs in sorted(conv_to_stage.items(), reverse=True)
+                if cs == st
+            ]
+        for m in members:
+            t += per_layer_s
+            layer_arrival[m] = t
+    compute_end_s = t
+
+    declarations, arrivals = [], {}
+    for path, leaf in paths_and_leaves:
+        name = jax.tree_util.keystr(path)
+        top = path[0].key  # 'Conv_3' / 'Dense_1'
+        kind, idx = top.split("_")
+        key = ("conv" if kind == "Conv" else "dense", int(idx))
+        from bagua_tpu.utils import to_bagua_datatype
+
+        declarations.append(
+            {
+                "name": name,
+                "num_elements": int(jnp.prod(jnp.array(leaf.shape))) if leaf.shape else 1,
+                "dtype": to_bagua_datatype(leaf.dtype),
+            }
+        )
+        arrivals[name] = round(layer_arrival[key], 6)
+
+    from bagua_tpu.defs import dtype_itemsize
+
+    total_bytes = sum(
+        d["num_elements"] * dtype_itemsize(d["dtype"]) for d in declarations
+    )
+    wire = trace["overlap_trace"]
+    fixture = {
+        "source": "TRACE_VGG16.json (backend=%s, image_size=%d) + VGG16 eval_shape"
+        % (trace.get("backend"), image_size),
+        "generator": "ci/record_vgg16_spans.py",
+        "model": "vgg16",
+        "backward_ms": round(backward_ms, 3),
+        "compute_end_s": round(compute_end_s, 6),
+        "seed_bucket_size_bytes": 10 * 1024 * 1024,
+        "declarations": declarations,
+        "arrivals": arrivals,
+        "wire_samples": [
+            {
+                "nbytes": int(total_bytes),
+                "seconds": round(wire["collective_ms"] / 1e3, 6),
+                "leg": "flat",
+                "hidden_frac": float(trace.get("measured_overlap_frac") or 0.0),
+            }
+        ],
+    }
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(fixture, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(
+        f"wrote {OUT}: {len(declarations)} declarations, "
+        f"backward {backward_ms:.0f} ms, wire {total_bytes / 2**20:.1f} MB in "
+        f"{wire['collective_ms']} ms",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,7 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     )
     assert "overlap wire-pattern assertion passed" in proc.stderr
     assert "telemetry metrics schema check passed" in proc.stderr
+    assert "autotune planner lane passed" in proc.stderr
 
     # The telemetry smoke emits a JSONL metrics stream next to --out; hold it
     # to the event schema here too (belt and braces: the subprocess already
@@ -69,6 +70,16 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert ov_flat["census"]["all-reduce"]["count"] == ov_flat["buckets"]
     assert ov_flat["buckets"] < ov_flat["slots"]  # multi-slot plan: the
     # per-bucket count is genuinely distinguishable from per-leaf
+
+    # The recorded-span planner gate: DP plan must beat the greedy seed plan
+    # on predicted exposed comm (the subprocess asserted it; re-check the
+    # recorded numbers so a silently-skipped lane can't pass).
+    planner = audit["autotune_planner"]
+    assert (
+        planner["planner_plan"]["predicted_exposed_ms"]
+        < planner["greedy_plan"]["predicted_exposed_ms"]
+    )
+    assert planner["gain_ms"] > 0
 
 
 def test_perf_audit_quick_bytegrad_compressed_census(tmp_path):

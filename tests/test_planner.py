@@ -1,0 +1,464 @@
+"""Trace-driven bucket planner: cost model, DP optimality, service integration.
+
+The planner (``bagua_tpu/service/planner.py``) is pure numpy-free Python, so
+most of this file runs instantly with no devices.  The DP solver is pinned
+against brute-force enumeration of every feasible contiguous partition — the
+strongest statement the unit tier can make about "optimal".  The recorded
+VGG16 fixture test mirrors the CI gate (``ci/perf_audit.py`` planner lane):
+on the committed measured spans the DP partition must be *strictly* cheaper
+than the seed greedy 10 MiB plan.  The tail of the file exercises the
+service-side integration (``AutotuneTaskManager``): spans → fitted cost model
+→ BO warm-start → decision trail, under each ``BAGUA_AUTOTUNE_PLANNER`` mode,
+and the end-to-end bitwise-parity guarantee of a mid-training re-bucket.
+"""
+
+import itertools
+import json
+import os
+
+import pytest
+
+from bagua_tpu.defs import TensorDeclaration, dtype_itemsize
+from bagua_tpu.service.planner import (
+    DEFAULT_FLAT,
+    AlphaBeta,
+    BucketPlanner,
+    CostModel,
+    WireSample,
+    fit_alpha_beta,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "ci", "fixtures", "vgg16_bucket_spans.json")
+
+
+def decls(sizes, dtype="f32", prefix="t"):
+    return [
+        TensorDeclaration(name=f"{prefix}{i}", num_elements=n, dtype=dtype)
+        for i, n in enumerate(sizes)
+    ]
+
+
+# -- α–β fitting ------------------------------------------------------------
+
+
+def test_fit_alpha_beta_recovers_linear_model():
+    true = AlphaBeta(alpha=120e-6, beta=35e9)
+    samples = [
+        WireSample(nbytes=n, seconds=true.predict(n))
+        for n in (1 << 16, 1 << 20, 1 << 22, 1 << 24, 1 << 25)
+    ]
+    fit = fit_alpha_beta(samples)
+    assert fit.n_samples == 5
+    assert fit.alpha == pytest.approx(true.alpha, rel=1e-6)
+    assert fit.beta == pytest.approx(true.beta, rel=1e-6)
+
+
+def test_fit_alpha_beta_no_samples_returns_prior():
+    assert fit_alpha_beta([]) is DEFAULT_FLAT
+    # zero-duration samples are noise, not measurements
+    assert fit_alpha_beta([WireSample(nbytes=1 << 20, seconds=0.0)]) is DEFAULT_FLAT
+
+
+def test_fit_alpha_beta_single_operating_point():
+    """One size: keep the prior's latency share, solve bandwidth from the
+    remainder — and never predict more than the measurement at that size."""
+    fit = fit_alpha_beta([WireSample(nbytes=1 << 24, seconds=2e-3)])
+    assert fit.alpha <= DEFAULT_FLAT.alpha
+    assert fit.predict(1 << 24) == pytest.approx(2e-3, rel=1e-6)
+
+
+def test_fit_alpha_beta_negative_intercept_clamped():
+    # these two points extrapolate to a negative latency; the fit must
+    # re-solve through the origin instead of predicting time travel
+    fit = fit_alpha_beta(
+        [WireSample(nbytes=1e6, seconds=1e-3), WireSample(nbytes=2e6, seconds=3e-3)]
+    )
+    assert fit.alpha == 0.0
+    assert fit.predict(0) == 0.0
+    assert fit.predict(1.5e6) == pytest.approx(2e-3, rel=1e-6)
+
+
+def test_fit_alpha_beta_nonpositive_slope_degrades_to_latency():
+    # time *decreasing* with bytes: bandwidth is unidentifiable, keep a
+    # pure-latency model at the mean with the prior's bandwidth
+    fit = fit_alpha_beta(
+        [WireSample(nbytes=1e6, seconds=3e-3), WireSample(nbytes=4e6, seconds=1e-3)]
+    )
+    assert fit.alpha == pytest.approx(2e-3)
+    assert fit.beta == DEFAULT_FLAT.beta
+
+
+def test_cost_model_from_samples_fits_legs_independently():
+    intra = AlphaBeta(alpha=20e-6, beta=90e9)
+    inter = AlphaBeta(alpha=300e-6, beta=20e9)
+    samples = [
+        WireSample(nbytes=n, seconds=intra.predict(n), leg="intra")
+        for n in (1 << 20, 1 << 23, 1 << 25)
+    ] + [
+        WireSample(nbytes=n, seconds=inter.predict(n), leg="inter")
+        for n in (1 << 18, 1 << 21, 1 << 23)
+    ]
+    cm = CostModel.from_samples(samples, intra_size=4)
+    # flat leg untouched (no samples -> prior)
+    assert cm.flat is DEFAULT_FLAT
+    assert cm.intra.alpha == pytest.approx(intra.alpha, rel=1e-6)
+    assert cm.inter.beta == pytest.approx(inter.beta, rel=1e-6)
+    # hierarchical = intra over full payload + inter over payload/intra_size
+    n = 1 << 24
+    assert cm.bucket_wire_time(n, hierarchical=True) == pytest.approx(
+        intra.predict(n) + inter.predict(n / 4), rel=1e-6
+    )
+    assert cm.bucket_wire_time(n, hierarchical=False) == DEFAULT_FLAT.predict(n)
+
+
+# -- DP solver vs brute force -----------------------------------------------
+
+
+def brute_force(planner, items, max_bucket_bytes=None, hierarchical=False):
+    """Minimum predicted exposed time over ALL feasible contiguous partitions
+    of the timeline (2^(n-1) cut masks, filtered for dtype homogeneity and
+    the size cap with singletons always feasible)."""
+    n = len(items)
+    best = None
+    for mask in range(1 << (n - 1)):
+        cuts, start = [], 0
+        for i in range(n - 1):
+            if mask & (1 << i):
+                cuts.append((start, i + 1))
+                start = i + 1
+        cuts.append((start, n))
+        buckets = [items[a:b] for a, b in cuts]
+        ok = True
+        for b in buckets:
+            if len({td.dtype for td in b}) > 1:
+                ok = False
+                break
+            size = sum(td.num_elements * dtype_itemsize(td.dtype) for td in b)
+            if max_bucket_bytes and size > max_bucket_bytes and len(b) > 1:
+                ok = False
+                break
+        if not ok:
+            continue
+        res = planner.evaluate(buckets, hierarchical)
+        if best is None or res.predicted_exposed_s < best - 1e-15:
+            best = res.predicted_exposed_s
+    return best
+
+
+@pytest.mark.parametrize("eta", [0.0, 0.4, 1.0])
+@pytest.mark.parametrize("cap", [None, 6 * 4096 * 4])
+def test_dp_matches_brute_force(eta, cap):
+    sizes = [4096, 65536, 4096, 32768, 8192, 131072, 4096, 16384]
+    ds = decls(sizes)
+    arrivals = {f"t{i}": t for i, t in enumerate([0.0, 0.1, 0.4, 0.5, 0.9, 1.3, 1.4, 2.0])}
+    cm = CostModel(flat=AlphaBeta(alpha=200e-6, beta=1e6))  # wire time matters
+    planner = BucketPlanner(ds, arrivals, cost_model=cm, overlap_efficiency=eta)
+    dp = planner.plan(max_bucket_bytes=cap)
+    bf = brute_force(planner, planner.timeline, max_bucket_bytes=cap)
+    assert dp.predicted_exposed_s == pytest.approx(bf, rel=1e-9, abs=1e-12)
+
+
+def test_dp_matches_brute_force_with_dtype_boundary():
+    ds = decls([4096, 8192, 4096], dtype="f32") + decls(
+        [16384, 4096], dtype="bf16", prefix="q"
+    )
+    arrivals = {"t0": 0.0, "t1": 0.2, "t2": 0.5, "q0": 0.3, "q1": 0.6}
+    cm = CostModel(flat=AlphaBeta(alpha=150e-6, beta=1e6))
+    planner = BucketPlanner(ds, arrivals, cost_model=cm, overlap_efficiency=0.7)
+    dp = planner.plan()
+    bf = brute_force(planner, planner.timeline)
+    assert dp.predicted_exposed_s == pytest.approx(bf, rel=1e-9, abs=1e-12)
+    for bucket in dp.buckets:
+        assert len({td.dtype for td in bucket}) == 1
+
+
+def test_dp_cap_bounds_fusion_not_tensors():
+    itemsz = dtype_itemsize("f32")
+    ds = decls([1024, 1024, 1 << 22, 1024])  # t2 alone exceeds any small cap
+    arrivals = {f"t{i}": 0.1 * i for i in range(4)}
+    planner = BucketPlanner(ds, arrivals)
+    cap = 4096 * itemsz
+    res = planner.plan(max_bucket_bytes=cap)
+    names = [[td.name for td in b] for b in res.buckets]
+    assert ["t2"] in names  # oversized tensor still got its own bucket
+    for bucket in res.buckets:
+        size = sum(td.num_elements * itemsz for td in bucket)
+        assert len(bucket) == 1 or size <= cap
+
+
+def test_eta_extremes_select_different_partitions():
+    """η=0 minimizes total wire (prefers fewer launches); η=1 minimizes the
+    tail (prefers overlapping early arrivals) — the calibration must actually
+    steer the solver, not just scale the reported number."""
+    ds = decls([1 << 18] * 6)
+    arrivals = {f"t{i}": 0.5 * i for i in range(6)}
+    cm = CostModel(flat=AlphaBeta(alpha=5e-3, beta=1e9))  # launches are costly
+    serial = BucketPlanner(ds, arrivals, cost_model=cm, overlap_efficiency=0.0)
+    hidden = BucketPlanner(ds, arrivals, cost_model=cm, overlap_efficiency=1.0)
+    assert serial.plan().n_buckets == 1  # one launch = least total wire
+    assert hidden.plan().n_buckets > 1  # spread over the backward = least tail
+
+
+def test_evaluate_handles_non_contiguous_partitions():
+    """The greedy seed plan is declaration-ordered, not arrival-ordered; the
+    simulator must still serialize its buckets on the measured clock."""
+    ds = decls([4096, 4096])
+    # declared t0 before t1, but t1's cotangent arrives first
+    planner = BucketPlanner(ds, {"t0": 1.0, "t1": 0.0})
+    res = planner.evaluate([[ds[0]], [ds[1]]])
+    rows = sorted(res.per_bucket, key=lambda r: r["start_s"])
+    assert rows[0]["ready_s"] == 0.0 and rows[1]["ready_s"] == 1.0
+    assert rows[1]["start_s"] >= rows[0]["finish_s"]  # wire serialization
+
+
+def test_unmeasured_tensors_placed_at_latest_arrival():
+    ds = decls([4096, 4096, 4096])
+    planner = BucketPlanner(ds, {"t0": 0.2, "t1": 0.9})  # t2 never measured
+    assert planner.arrivals["t2"] == 0.9
+    assert planner.timeline[-1].name in ("t1", "t2")
+
+
+def test_rank_caps_sorted_and_complete():
+    ds = decls([1 << 16] * 4)
+    arrivals = {f"t{i}": 0.05 * i for i in range(4)}
+    planner = BucketPlanner(ds, arrivals)
+    ranked = planner.rank_caps(range(18, 22))
+    assert len(ranked) == 4 * 2  # caps × {flat, hierarchical}
+    costs = [c["predicted_exposed_ms"] for c in ranked]
+    assert costs == sorted(costs)
+    assert {c["is_hierarchical_reduce"] for c in ranked} == {0, 1}
+
+
+def test_empty_planner_is_harmless():
+    planner = BucketPlanner([], {})
+    res = planner.plan()
+    assert res.n_buckets == 0 and res.predicted_exposed_s == 0.0
+
+
+# -- the recorded VGG16 fixture (the CI acceptance gate, in-suite) -----------
+
+
+def test_fixture_planner_strictly_beats_seed_greedy():
+    """On the committed measured spans, the DP partition's predicted exposed
+    communication is strictly lower than the seed greedy 10 MiB plan's —
+    the same assertion ``ci/perf_audit.py``'s planner lane gates on."""
+    from bagua_tpu.bucket import split_declarations
+
+    fx = json.load(open(FIXTURE))
+    ds = [TensorDeclaration(**d) for d in fx["declarations"]]
+    samples = [WireSample(**s) for s in fx["wire_samples"]]
+    cm = CostModel.from_samples(samples)
+    num = sum(s.hidden_frac * s.seconds for s in samples if s.hidden_frac is not None)
+    den = sum(s.seconds for s in samples if s.hidden_frac is not None)
+    eta = num / den if den else 1.0
+    planner = BucketPlanner(ds, fx["arrivals"], cost_model=cm, overlap_efficiency=eta)
+    shapes = {td.name: (td.num_elements,) for td in ds}
+    greedy_specs = split_declarations(ds, shapes, fx["seed_bucket_size_bytes"])
+    greedy = planner.evaluate([s.declarations() for s in greedy_specs])
+    dp = planner.plan()
+    assert dp.predicted_exposed_s < greedy.predicted_exposed_s
+    # every declared tensor is in exactly one planned bucket
+    planned = sorted(td.name for b in dp.buckets for td in b)
+    assert planned == sorted(td.name for td in ds)
+
+
+# -- service integration: AutotuneTaskManager -------------------------------
+
+
+def wire_span(nbytes=1 << 24, seconds=2e-3, hidden_frac=0.5, intra_size=1):
+    return {
+        "action": "bucket_wire",
+        "tensor_name": "bucket0",
+        "start_time": 0.0,
+        "end_time": seconds,
+        "nbytes": nbytes,
+        "seconds": seconds,
+        "leg": "flat",
+        "hidden_frac": hidden_frac,
+        "intra_size": intra_size,
+    }
+
+
+def ready_spans(names_and_times):
+    return [
+        {"action": "tensor_ready", "tensor_name": n, "start_time": t}
+        for n, t in names_and_times
+    ]
+
+
+def make_manager(mode, n=6):
+    from bagua_tpu.service.autotune_task_manager import AutotuneTaskManager
+
+    mgr = AutotuneTaskManager("m", planner_mode=mode)
+    mgr.tensor_list = decls([1 << 18] * n)
+    return mgr
+
+
+def test_manager_warmstart_builds_planner_and_trail():
+    mgr = make_manager("warmstart")
+    spans = ready_spans((f"t{i}", 0.01 * i) for i in range(6))
+    spans.append(wire_span(hidden_frac=0.25))
+    mgr.report_spans(spans)
+    assert mgr.planner is not None
+    trail = mgr.decision_trail
+    assert trail["spans_reported"] is True
+    assert trail["overlap_efficiency"] == pytest.approx(0.25)
+    assert trail["cost_model"]["flat"]["n_samples"] == 1
+    assert trail["dp_plan"] and trail["greedy_plan"]
+    assert trail["candidates"] and trail["warm_start"]
+    # the warm-start queue feeds the optimizer's next asks, best first
+    assert mgr.optimizer._pending
+    first = mgr.optimizer.ask()
+    assert first == trail["warm_start"][0]
+    # proposals flow through the planner: predicted cost attached + recorded
+    hp = mgr.tell_and_ask(score=10.0, train_iter=1)
+    assert hp.predicted_exposed_ms is not None
+    assert trail["chosen"]["predicted_exposed_ms"] == hp.predicted_exposed_ms
+    assert trail["proposals"][-1] is trail["chosen"]
+
+
+def test_manager_mode_off_never_activates_planner():
+    mgr = make_manager("off")
+    spans = ready_spans((f"t{i}", 0.01 * i) for i in range(6))
+    spans.append(wire_span())
+    mgr.report_spans(spans)
+    assert mgr.planner is None
+    assert mgr.decision_trail["mode"] == "off"
+    assert mgr.decision_trail["spans_reported"] is False
+    assert not mgr.optimizer._pending
+    hp = mgr.tell_and_ask(score=10.0, train_iter=1)
+    assert hp.predicted_exposed_ms is None  # pure BO, seed behavior
+
+
+def test_manager_mode_on_uses_dp_partition():
+    mgr = make_manager("on")
+    # early tensors bunch at t~0, last one arrives late: the DP under a
+    # permissive cap fuses the early group — a split greedy can't reproduce
+    mgr.report_spans(
+        ready_spans([("t0", 0.0), ("t1", 0.001), ("t2", 0.002),
+                     ("t3", 0.003), ("t4", 0.004), ("t5", 0.5)])
+        + [wire_span(hidden_frac=0.0)]
+    )
+    cap_2p = 24  # 16 MiB >= all six tensors together
+    hp = mgr.recommended_from_param_dict(
+        {"bucket_size_2p": cap_2p, "is_hierarchical_reduce": 0}
+    )
+    assert hp.predicted_exposed_ms is not None
+    dp_direct = mgr.planner.plan(max_bucket_bytes=1 << cap_2p)
+    assert [[td.name for td in b] for b in hp.buckets] == [
+        [td.name for td in b] for b in dp_direct.buckets
+    ]
+    for bucket in hp.buckets:  # cap respected (no tensor here exceeds it)
+        assert sum(td.num_elements * 4 for td in bucket) <= 1 << cap_2p
+
+
+def test_manager_no_spans_is_pure_bo():
+    """Measured signal is an upgrade, never a requirement: with nothing
+    reported the optimizer runs its cold deterministic walk unchanged."""
+    mgr = make_manager("warmstart")
+    assert mgr.planner is None and not mgr.optimizer._pending
+    hp = mgr.tell_and_ask(score=1.0, train_iter=0)
+    assert hp.buckets and hp.predicted_exposed_ms is None
+
+
+def test_manager_malformed_wire_span_ignored():
+    mgr = make_manager("warmstart")
+    bad = wire_span()
+    del bad["seconds"]
+    mgr.report_spans(ready_spans([("t0", 0.0), ("t1", 0.1)]) + [bad])
+    assert mgr.wire_samples == []  # dropped, not crashed
+    assert mgr.planner is not None  # arrivals alone still build a planner
+
+
+def test_planner_mode_env_knob(monkeypatch):
+    from bagua_tpu.env import get_autotune_planner_mode
+
+    monkeypatch.delenv("BAGUA_AUTOTUNE_PLANNER", raising=False)
+    assert get_autotune_planner_mode() == "warmstart"
+    monkeypatch.setenv("BAGUA_AUTOTUNE_PLANNER", "ON")
+    assert get_autotune_planner_mode() == "on"
+    monkeypatch.setenv("BAGUA_AUTOTUNE_PLANNER", "off")
+    assert get_autotune_planner_mode() == "off"
+    monkeypatch.setenv("BAGUA_AUTOTUNE_PLANNER", "bogus")
+    assert get_autotune_planner_mode() == "warmstart"
+    # the manager default follows the env knob
+    from bagua_tpu.service.autotune_task_manager import AutotuneTaskManager
+
+    monkeypatch.setenv("BAGUA_AUTOTUNE_PLANNER", "off")
+    assert AutotuneTaskManager("envm").planner_mode == "off"
+
+
+# -- mid-training re-bucket: bitwise parity (the adoption-safety gate) -------
+
+
+def test_midtrain_planner_rebucket_bitwise_parity(group):
+    """Adopting a planner-proposed plan mid-training must be numerically
+    invisible: engine A trains k steps, re-buckets onto the planner's DP
+    partition, trains m more; engine B starts fresh on that plan and runs the
+    same m steps from A's pre-rebucket state.  Bitwise-identical params —
+    re-bucketing changes the wire schedule, never the math."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.bucket import BucketPlan
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+    params = init_mlp(jax.random.PRNGKey(0), [16, 64, 64, 4])
+
+    def make_engine():
+        return DistributedDataParallel(
+            mse_loss, optax.sgd(0.05), GradientAllReduceAlgorithm(),
+            process_group=group, bucket_size_bytes=1 << 10, overlap=True,
+        )
+
+    def batches(n, seed):
+        rng = np.random.RandomState(seed)
+        return [
+            (jnp.asarray(rng.randn(16, 16), np.float32),
+             jnp.asarray(rng.randn(16, 4), np.float32))
+            for _ in range(n)
+        ]
+
+    ddp_a = make_engine()
+    state = ddp_a.init(params)
+    for batch in batches(3, seed=1):
+        state, _ = ddp_a.train_step(state, batch)
+    # steps donate their input buffers: keep a live copy for engine B
+    saved = jax.tree.map(jnp.copy, state)
+
+    # planner plan over the engine's own declarations (synthetic arrivals in
+    # declaration order stand in for a trace on this tiny model)
+    flat_decls = [td for b in ddp_a.plan.declarations() for td in b]
+    arrivals = {td.name: 0.001 * i for i, td in enumerate(flat_decls)}
+    # η=0 models a serializing backend: the DP fuses the tiny seed buckets
+    result = BucketPlanner(flat_decls, arrivals, overlap_efficiency=0.0).plan()
+    assert result.n_buckets != ddp_a.plan.num_buckets  # genuinely a new plan
+    new_plan = BucketPlan.from_declarations(
+        result.buckets, ddp_a._tree_template, align_elems=group.size
+    )
+
+    ddp_a.rebucket(new_plan, predicted_exposed_ms=result.predicted_exposed_s * 1e3)
+    assert ddp_a.plan_version == 1
+    tail = batches(3, seed=2)
+    state_a = state
+    for batch in tail:
+        state_a, _ = ddp_a.train_step(state_a, batch)
+
+    # engine B: fresh build, adopts the same plan before compiling anything
+    ddp_b = make_engine()
+    ddp_b.init(params)  # binds the tree template
+    ddp_b.rebucket(new_plan)
+    state_b = saved
+    for batch in tail:
+        state_b, _ = ddp_b.train_step(state_b, batch)
+
+    for pa, pb in zip(
+        jax.tree_util.tree_leaves(state_a.params),
+        jax.tree_util.tree_leaves(state_b.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))

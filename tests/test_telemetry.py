@@ -393,3 +393,75 @@ def test_telemetry_wires_watchdog_snapshot():
     assert wd.snapshot_provider == tel.snapshot  # bound to this hub
     tel.enter_phase("data")
     assert wd.last_phase == "data" and tel.current_phase == "data"
+
+
+def test_on_rebucket_counter_gauges_and_event(tmp_path):
+    """A plan swap shows up on every telemetry surface at once: the
+    ``rebucket_total`` counter, the ``plan_version`` gauge, the optional
+    predicted/measured exposed-comm gauges, a schema-valid ``rebucket`` JSONL
+    event, and the Prometheus text export."""
+    path = str(tmp_path / "m.jsonl")
+    tel = Telemetry(metrics_jsonl=path)
+    tel.on_rebucket(plan_version=1, n_buckets=4, step=7, predicted_exposed_ms=12.5)
+    tel.on_rebucket(plan_version=2, n_buckets=2, step=9, measured_exposed_ms=3.25)
+    tel.close()
+
+    snap = tel.registry.snapshot()
+    assert snap["rebucket_total"] == 2
+    assert snap["plan_version"] == 2.0
+    assert snap["predicted_exposed_comm_ms"] == 12.5
+    assert snap["measured_exposed_comm_ms"] == 3.25
+
+    from bagua_tpu.observability import validate_metrics_file
+
+    assert validate_metrics_file(path) == []
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    rb = [e for e in events if e["event"] == "rebucket"]
+    assert [e["plan_version"] for e in rb] == [1, 2]
+    assert rb[0]["n_buckets"] == 4 and rb[0]["step"] == 7
+    assert rb[0]["predicted_exposed_ms"] == 12.5
+    assert "predicted_exposed_ms" not in rb[1]  # optional field stays absent
+    assert rb[1]["measured_exposed_ms"] == 3.25
+
+    prom = tel.registry.to_prometheus()
+    assert "bagua_rebucket_total 2" in prom
+    assert "bagua_plan_version 2" in prom
+
+
+def test_rebucket_emits_telemetry_from_engine(group, tmp_path):
+    """End-to-end: DistributedDataParallel.rebucket bumps plan_version and
+    feeds the hub; training continues on the new plan."""
+    from bagua_tpu.bucket import BucketPlan
+    from bagua_tpu.models.mlp import init_mlp
+
+    path = str(tmp_path / "e.jsonl")
+    tel = Telemetry(metrics_jsonl=path)
+    params = init_mlp(jax.random.PRNGKey(0), [16, 32, 4])
+    ddp = DistributedDataParallel(
+        mse_loss, optax.sgd(0.05), GradientAllReduceAlgorithm(),
+        process_group=group, bucket_size_bytes=1 << 10, telemetry=tel,
+    )
+    state = ddp.init(params)
+    rng = np.random.RandomState(0)
+    batch = (
+        jnp.asarray(rng.randn(16, 16), np.float32),
+        jnp.asarray(rng.randn(16, 4), np.float32),
+    )
+    state, _ = ddp.train_step(state, batch)
+    assert ddp.plan_version == 0
+
+    coarse = BucketPlan.from_declarations(
+        [[td for b in ddp.plan.declarations() for td in b]],  # one mega-bucket
+        ddp._tree_template, align_elems=group.size,
+    )
+    ddp.rebucket(coarse, predicted_exposed_ms=1.5)
+    assert ddp.plan_version == 1
+    snap = tel.registry.snapshot()
+    assert snap["rebucket_total"] == 1 and snap["plan_version"] == 1.0
+    assert snap["predicted_exposed_comm_ms"] == 1.5
+
+    state, losses = ddp.train_step(state, batch)
+    assert np.isfinite(np.asarray(losses)).all()
+    tel.close()
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    assert any(e["event"] == "rebucket" and e["plan_version"] == 1 for e in events)
