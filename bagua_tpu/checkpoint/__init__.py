@@ -1,6 +1,8 @@
 """Checkpoint / resume (reference ``bagua/torch_api/checkpoint/``)."""
 
 from bagua_tpu.checkpoint.checkpointing import (  # noqa: F401
+    COMPLETE_FILENAME,
+    TRACKER_FILENAME,
     save_checkpoint,
     load_checkpoint,
     get_latest_iteration,

@@ -20,10 +20,25 @@ from typing import Optional, Tuple
 import jax
 
 TRACKER_FILENAME = "latest_checkpointed_iteration.txt"
+COMPLETE_FILENAME = ".complete"
 
 
 def _ckpt_path(ckpt_dir: str, iteration: int) -> str:
     return os.path.join(ckpt_dir, f"iter_{iteration:07d}")
+
+
+def _atomic_write(path: str, text: str) -> None:
+    """Write-temp + rename so no reader ever sees a torn file (the previous
+    in-place tracker write could be observed half-written by a concurrently
+    restarting rank, sending it to a garbage iteration)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def _is_complete(ckpt_dir: str, iteration: int) -> bool:
+    return os.path.exists(os.path.join(_ckpt_path(ckpt_dir, iteration), COMPLETE_FILENAME))
 
 
 def _checkpointer():
@@ -84,18 +99,52 @@ def save_checkpoint(
             ckpt.save(os.path.join(path, "expert_states"), expert, force=True)
     else:
         ckpt.save(os.path.join(path, "model_states"), state, force=True)
-    # Tracker last: its presence certifies a complete checkpoint.
-    with open(os.path.join(ckpt_dir, TRACKER_FILENAME), "w") as f:
-        f.write(str(iteration))
+    # Completion marker inside the checkpoint, then the tracker — both via
+    # write-temp + atomic rename.  Ordering matters: the marker certifies
+    # the states landed; the tracker is only ever an *optimization* over
+    # scanning, and a crash between the two leaves a complete, discoverable
+    # checkpoint with a stale tracker (healed by get_latest_iteration's
+    # marker check + scan fallback), never the reverse.
+    _atomic_write(os.path.join(path, COMPLETE_FILENAME), str(iteration))
+    _atomic_write(os.path.join(ckpt_dir, TRACKER_FILENAME), str(iteration))
     return path
 
 
-def get_latest_iteration(ckpt_dir: str) -> Optional[int]:
-    tracker = os.path.join(ckpt_dir, TRACKER_FILENAME)
-    if not os.path.exists(tracker):
+def _scan_latest_complete(ckpt_dir: str) -> Optional[int]:
+    """Newest ``iter_*`` directory bearing the completion marker."""
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
         return None
-    with open(tracker) as f:
-        return int(f.read().strip())
+    iterations = []
+    for name in names:
+        if name.startswith("iter_"):
+            try:
+                iterations.append(int(name[len("iter_"):]))
+            except ValueError:
+                continue
+    for it in sorted(iterations, reverse=True):
+        if _is_complete(ckpt_dir, it):
+            return it
+    return None
+
+
+def get_latest_iteration(ckpt_dir: str) -> Optional[int]:
+    """The newest *complete* checkpointed iteration, or None.
+
+    The tracker names the candidate, but it is only trusted when the
+    checkpoint it points at carries its completion marker — a torn tracker
+    (unreadable) or a truncated checkpoint directory (killed writer) falls
+    back to scanning ``iter_*`` directories for the newest marked one."""
+    tracker = os.path.join(ckpt_dir, TRACKER_FILENAME)
+    try:
+        with open(tracker) as f:
+            it = int(f.read().strip())
+        if _is_complete(ckpt_dir, it):
+            return it
+    except (OSError, ValueError):
+        pass
+    return _scan_latest_complete(ckpt_dir)
 
 
 def _restore_to_host(ckpt, path):

@@ -271,6 +271,54 @@ class DistributedDataParallel:
                 predicted_exposed_ms=predicted_exposed_ms,
             )
 
+    # -- plan carry-over (elastic resume) -----------------------------------
+
+    def export_plan_payload(self) -> Optional[dict]:
+        """The live bucket plan as a JSON-serializable payload — what the
+        async snapshotter embeds in every manifest so a restarted gang can
+        re-adopt the tuned plan (:meth:`adopt_plan_payload`) instead of
+        cold-starting the planner."""
+        if self.plan is None:
+            return None
+        return {
+            "plan_version": self.plan_version,
+            "bucket_size_bytes": int(self.bucket_size_bytes),
+            "buckets": [
+                [td.model_dump() for td in bucket]
+                for bucket in self.plan.declarations()
+            ],
+        }
+
+    def adopt_plan_payload(self, payload: dict) -> bool:
+        """Adopt a previously exported plan payload (elastic resume).
+
+        Returns True when the engine now runs the saved plan — either it was
+        re-adopted via :meth:`rebucket`, or the fresh plan already matches it
+        (same bucket assignment ⇒ nothing to swap).  Raises when the payload
+        no longer fits the model (renamed leaves, empty buckets) or the
+        algorithm holds bucketized state; callers treat that as "keep the
+        fresh plan"."""
+        from bagua_tpu.defs import TensorDeclaration
+
+        buckets = [
+            [TensorDeclaration(**td) for td in bucket]
+            for bucket in payload.get("buckets", [])
+        ]
+        if not buckets:
+            return False
+        assignment = [[td.name for td in b] for b in buckets]
+        if self.plan is not None and assignment == [
+            [td.name for td in b] for b in self.plan.declarations()
+        ]:
+            return True
+        plan = BucketPlan.from_declarations(
+            buckets, self._tree_template, align_elems=self.group.size
+        )
+        self.rebucket(plan)
+        if payload.get("bucket_size_bytes"):
+            self.bucket_size_bytes = int(payload["bucket_size_bytes"])
+        return True
+
     # -- the step -----------------------------------------------------------
 
     def _build_step(self, variant: str):
@@ -714,6 +762,21 @@ class AutotuneSession:
         # registration order — which IS the plan's order — so nothing is lost
         # relative to round-1's (circular) plan-order report.
         self.profiled = False
+        # Mid-run service flaps degrade the session to its current local
+        # hyperparameters instead of crashing the step loop: report/ask are
+        # retried (client-level, see autotune_client), and once the breaker
+        # opens the tick becomes a fast no-op until the cooldown.
+        from bagua_tpu.env import (
+            get_rpc_breaker_cooldown_s, get_rpc_breaker_threshold,
+        )
+        from bagua_tpu.resilience.retry import CircuitBreaker, CircuitOpenError
+
+        self._breaker = CircuitBreaker(
+            failure_threshold=get_rpc_breaker_threshold(),
+            cooldown_s=get_rpc_breaker_cooldown_s(),
+            name="autotune",
+        )
+        self._CircuitOpenError = CircuitOpenError
 
     def profile_and_report(self, state, batch) -> None:
         """Measure the real per-bucket gradient-readiness order and ship it
@@ -754,12 +817,29 @@ class AutotuneSession:
         import jax
 
         rank = jax.process_index()
-        self.client.report_metrics(
-            self.model_name, rank, self._step, self.ddp.speed_meter.speed(60.0)
-        )
-        hp, self.completed = self.client.ask_hyperparameters(
-            self.model_name, rank, self._step
-        )
+        try:
+            self._breaker.before_call()
+            self.client.report_metrics(
+                self.model_name, rank, self._step, self.ddp.speed_meter.speed(60.0)
+            )
+            hp, self.completed = self.client.ask_hyperparameters(
+                self.model_name, rank, self._step
+            )
+        except self._CircuitOpenError:
+            return  # breaker open: fast no-op until the cooldown expires
+        except (OSError, ConnectionError) as e:
+            # The client already retried with backoff; a surfaced failure
+            # means the service is down — record it (opens the breaker after
+            # N consecutive flaps) and keep training on current hps.
+            self._breaker.record_failure()
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "autotune service unreachable at step %d (%s); keeping "
+                "current hyperparameters", self._step, e,
+            )
+            return
+        self._breaker.record_success()
         self._apply(hp)
 
     def _apply(self, hp) -> None:

@@ -469,8 +469,16 @@ class RendezvousClient:
         self.node_rank = node_rank
         self.timeout_s = timeout_s
         self.addr = addr  # this node's reachable address, advertised on join
+        # Store ops ride the resilience retry layer: a coordinator hiccup
+        # (restart, GC pause) is retried with jittered backoff instead of
+        # surfacing as a one-shot OSError that benches the whole node; the
+        # outage paths above (leave/restart/crash) keep their own
+        # best-effort semantics on top of the retries.
+        from bagua_tpu.resilience.retry import RetryPolicy
 
-    def _call(self, path: str, payload: Optional[dict] = None) -> dict:
+        self._retry_policy = RetryPolicy()
+
+    def _call_once(self, path: str, payload: Optional[dict] = None) -> dict:
         import urllib.request
 
         url = self.endpoint + path
@@ -484,6 +492,13 @@ class RendezvousClient:
             )
         with urllib.request.urlopen(req, timeout=10.0) as resp:
             return json.loads(resp.read())
+
+    def _call(self, path: str, payload: Optional[dict] = None) -> dict:
+        from bagua_tpu.resilience.retry import retry_call
+
+        return retry_call(
+            self._call_once, path, payload, policy=self._retry_policy
+        )
 
     # -- membership ----------------------------------------------------------
 

@@ -114,6 +114,37 @@ def get_autotune_logfile_path() -> str:
     return os.environ.get("BAGUA_AUTOTUNE_LOGFILE_PATH", "/tmp/bagua_autotune.log")
 
 
+def get_snapshot_every() -> int:
+    """``BAGUA_SNAPSHOT_EVERY``: async-snapshot cadence in steps for the
+    resilience subsystem (0 disables; overrides the Trainer argument so an
+    operator can retune the lost-work bound without editing the script)."""
+    return int(os.environ.get("BAGUA_SNAPSHOT_EVERY", 0))
+
+
+def get_rpc_retries() -> int:
+    """``BAGUA_RPC_RETRIES``: attempts (1 + retries) for service RPCs
+    (autotune client, rendezvous KV) before the error surfaces."""
+    return int(os.environ.get("BAGUA_RPC_RETRIES", 3))
+
+
+def get_rpc_backoff_base_s() -> float:
+    return float(os.environ.get("BAGUA_RPC_BACKOFF_BASE_S", 0.1))
+
+
+def get_rpc_backoff_max_s() -> float:
+    return float(os.environ.get("BAGUA_RPC_BACKOFF_MAX_S", 2.0))
+
+
+def get_rpc_breaker_threshold() -> int:
+    """``BAGUA_RPC_BREAKER_THRESHOLD``: consecutive RPC failures before the
+    circuit opens and calls fail fast (0 disables circuit breaking)."""
+    return int(os.environ.get("BAGUA_RPC_BREAKER_THRESHOLD", 5))
+
+
+def get_rpc_breaker_cooldown_s() -> float:
+    return float(os.environ.get("BAGUA_RPC_BREAKER_COOLDOWN_S", 30.0))
+
+
 def get_compile_cache_dir() -> Optional[str]:
     """Directory for JAX's persistent (on-disk) compilation cache.
 

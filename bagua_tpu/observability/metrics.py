@@ -195,6 +195,18 @@ EVENT_PAYLOAD_FIELDS = {
     # one bucket-plan swap adopted by the engine (autotune re-bucket);
     # predicted/measured exposed-comm ms ride as optional fields
     "rebucket": {"plan_version": int, "n_buckets": int},
+    # one async/final state snapshot written by the resilience subsystem
+    # (kind: "async" = cadenced background write, "final" = preemption drain)
+    "snapshot": {"wall_ms": (int, float), "bytes": int, "kind": str},
+    # one elastic resume: the gang restarted from a snapshot (step = the
+    # resumed-from step; lost_steps = steps the previous incarnation ran
+    # past it, 0 for a drained preemption exit)
+    "restart": {
+        "old_world_size": int,
+        "new_world_size": int,
+        "plan_source": str,
+        "lost_steps": int,
+    },
 }
 
 
@@ -246,6 +258,14 @@ class JsonlSink:
                 raise ValueError(f"JsonlSink({self.path}) is closed")
             self._f.write(line + "\n")
             self._f.flush()
+
+    def flush(self) -> None:
+        """Push buffered lines to the OS (emit already flushes per line;
+        this is the teardown-path belt-and-suspenders).  No-op when closed."""
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+                os.fsync(self._f.fileno())
 
     def close(self) -> None:
         with self._lock:

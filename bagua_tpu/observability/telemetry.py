@@ -258,6 +258,58 @@ class Telemetry:
                 event["measured_exposed_ms"] = round(float(measured_exposed_ms), 4)
             self.jsonl.emit(event)
 
+    def on_snapshot(
+        self, step: int, wall_ms: float, n_bytes: int, kind: str = "async"
+    ) -> None:
+        """The resilience subsystem wrote one state snapshot (``kind``
+        ``"async"`` = cadenced background write off the critical path,
+        ``"final"`` = forced synchronous write on the preemption drain).
+        ``wall_ms`` is the *writer thread's* wall time — the hot path only
+        paid the device-side buffer copy dispatch."""
+        r = self.registry
+        r.counter("snapshots_total", help="state snapshots written").inc()
+        r.histogram(
+            "snapshot_wall_ms",
+            help="background snapshot write time (off the critical path)",
+        ).observe(float(wall_ms))
+        r.gauge("snapshot_last_step", help="step of the newest snapshot").set(step)
+        if self.jsonl:
+            self.jsonl.emit(
+                {"event": "snapshot", "step": int(step),
+                 "wall_ms": round(float(wall_ms), 3),
+                 "bytes": int(n_bytes), "kind": kind}
+            )
+
+    def on_restart(
+        self,
+        step: int,
+        old_world_size: int,
+        new_world_size: int,
+        plan_source: str = "fresh",
+        lost_steps: int = 0,
+    ) -> None:
+        """The gang resumed from a snapshot (elastic restart).  ``step`` is
+        the resumed-from step; ``lost_steps`` counts steps the previous
+        incarnation ran past it (0 when the preemption drain landed its
+        final snapshot); ``plan_source`` records whether the tuned bucket
+        plan was carried over (``"carried"``) or rebuilt (``"fresh"``)."""
+        r = self.registry
+        r.counter("restarts_total", help="elastic resumes from a snapshot").inc()
+        r.counter(
+            "lost_steps_total",
+            help="training steps lost across restarts (bounded by the snapshot cadence)",
+        ).inc(max(0, int(lost_steps)))
+        r.gauge("resumed_world_size", help="gang size after the latest resume").set(
+            new_world_size
+        )
+        if self.jsonl:
+            self.jsonl.emit(
+                {"event": "restart", "step": int(step),
+                 "old_world_size": int(old_world_size),
+                 "new_world_size": int(new_world_size),
+                 "plan_source": plan_source, "lost_steps": int(lost_steps)}
+            )
+
     def _emit_alert(self, msg: str, retraces_in_window: int) -> None:
         self.registry.counter(
             "retrace_alerts_total", help="recompile-rate alarms raised"
@@ -274,6 +326,13 @@ class Telemetry:
     def export_prometheus(self, path: str) -> None:
         """Write the registry as a Prometheus textfile (atomic)."""
         self.registry.write_prometheus(path)
+
+    def flush(self) -> None:
+        """Durably flush the JSONL stream without closing it — the trainer's
+        exception-safe teardown calls this so a crash mid-``fit`` never loses
+        buffered events, while the hub stays usable for a post-mortem."""
+        if self.jsonl:
+            self.jsonl.flush()
 
     def close(self) -> None:
         if self.jsonl:

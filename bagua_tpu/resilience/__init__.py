@@ -1,0 +1,53 @@
+"""Resilience: preemption-aware async checkpointing + elastic resume.
+
+The subsystem that keeps a BAGUA-style job alive on preemptible pools —
+see ``docs/elastic.md`` for the operator story.  Four pieces:
+
+* :class:`AsyncSnapshotter` / :class:`SnapshotStore` — double-buffered
+  device→host state copies every K steps, off the critical path, with
+  atomic (write-temp + rename) manifests;
+* :class:`PreemptionWatcher` — SIGTERM → drain the in-flight step, force a
+  final snapshot, exit with a resumable marker;
+* :class:`ElasticResumeCoordinator` — ranks agree on the newest *complete*
+  snapshot, remap into the (possibly resized) gang, carry the tuned bucket
+  plan over;
+* :func:`retry_call` / :class:`CircuitBreaker` — jittered-exponential
+  retries with circuit breaking for the autotune + rendezvous RPCs.
+"""
+
+from bagua_tpu.resilience.preemption import (
+    RESUMABLE_MARKER,
+    PreemptionWatcher,
+    clear_resumable_marker,
+    read_resumable_marker,
+    write_resumable_marker,
+)
+from bagua_tpu.resilience.resume import ElasticResumeCoordinator, ResumeResult
+from bagua_tpu.resilience.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+    retry_call,
+)
+from bagua_tpu.resilience.snapshot import (
+    MANIFEST_FILENAME,
+    AsyncSnapshotter,
+    SnapshotStore,
+)
+
+__all__ = [
+    "AsyncSnapshotter",
+    "SnapshotStore",
+    "MANIFEST_FILENAME",
+    "PreemptionWatcher",
+    "RESUMABLE_MARKER",
+    "write_resumable_marker",
+    "read_resumable_marker",
+    "clear_resumable_marker",
+    "ElasticResumeCoordinator",
+    "ResumeResult",
+    "RetryPolicy",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "retry_call",
+]

@@ -1,0 +1,229 @@
+"""Elastic resume: agree on the newest complete snapshot, replay it into
+the (possibly resized) gang, carry the bucket plan over.
+
+Resume is the half of elasticity the launcher can't do alone: after a
+preemption or crash the gang re-forms — maybe smaller (a node benched),
+maybe larger (capacity returned) — and every rank must (1) pick the *same*
+snapshot, (2) remap the rank-stacked state to the new world size
+(:func:`bagua_tpu.checkpoint.remap_world_size`), and (3) keep the autotune
+investment: the bucket plan the tuner had converged on rides in the
+snapshot manifest and is re-adopted here, so the restarted gang starts at
+the tuned operating point instead of the cold greedy split.
+
+Snapshot choice: the local scan (``SnapshotStore.latest_complete``) is
+authoritative on a shared filesystem.  When a rendezvous store is
+reachable *and* the group spans processes, ranks additionally publish their
+local view and take the **minimum** — a rank whose filesystem view lags
+(NFS attribute caching) must not be resumed past what it can actually
+read.  Store outages degrade to the local scan (retry + breaker from
+:mod:`bagua_tpu.resilience.retry`), never block the restart.
+"""
+
+import json
+import logging
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from bagua_tpu.resilience.retry import CircuitBreaker, RetryPolicy, retry_call
+from bagua_tpu.resilience.snapshot import SnapshotStore
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ElasticResumeCoordinator", "ResumeResult"]
+
+
+class ResumeResult:
+    """What a resume did: the committed state + provenance for telemetry."""
+
+    def __init__(self, state, step: int, old_world_size: int, new_world_size: int,
+                 plan_source: str):
+        self.state = state
+        self.step = step
+        self.old_world_size = old_world_size
+        self.new_world_size = new_world_size
+        #: ``"carried"`` when the manifest's bucket plan was re-adopted,
+        #: ``"fresh"`` when the engine kept its cold-start plan
+        self.plan_source = plan_source
+
+
+class ElasticResumeCoordinator:
+    """One resume attempt for one engine.
+
+    Args:
+        store: :class:`SnapshotStore` (or directory path) the snapshotter
+            wrote into.
+        rendezvous_client: optional
+            :class:`~bagua_tpu.distributed.rendezvous.RendezvousClient` for
+            the cross-rank snapshot agreement (multi-process gangs only).
+        expert_filter: forwarded to ``remap_world_size`` (MoE leaves).
+        telemetry: optional hub; a successful resume emits ``on_restart``.
+    """
+
+    def __init__(
+        self,
+        store,
+        rendezvous_client=None,
+        expert_filter=None,
+        telemetry=None,
+        agreement_timeout_s: float = 30.0,
+    ):
+        self.store = store if isinstance(store, SnapshotStore) else SnapshotStore(store)
+        self.rendezvous_client = rendezvous_client
+        self.expert_filter = expert_filter
+        self.telemetry = telemetry
+        self.agreement_timeout_s = agreement_timeout_s
+
+    # -- snapshot agreement --------------------------------------------------
+
+    def agreed_resume_step(self, nonce: str = "0") -> Optional[int]:
+        """The step every rank will resume from (None = cold start).
+
+        ``nonce`` namespaces the agreement round in the rendezvous KV (pass
+        the launcher's attempt counter / rendezvous epoch) so a second
+        restart never reads the first restart's stale views."""
+        import jax
+
+        local = self.store.latest_complete()
+        client = self.rendezvous_client
+        nprocs = jax.process_count()
+        if client is None or nprocs <= 1:
+            return local
+        policy = RetryPolicy()
+        breaker = CircuitBreaker(name="rendezvous-kv")
+        rank = jax.process_index()
+        try:
+            retry_call(
+                client.kv_set,
+                f"resilience/resume/{nonce}/rank{rank}",
+                json.dumps(local),
+                policy=policy, breaker=breaker,
+            )
+            deadline = time.monotonic() + self.agreement_timeout_s
+            views: Dict[int, Optional[int]] = {}
+            while time.monotonic() < deadline and len(views) < nprocs:
+                for r in range(nprocs):
+                    if r in views:
+                        continue
+                    raw = retry_call(
+                        client.kv_get,
+                        f"resilience/resume/{nonce}/rank{r}",
+                        policy=policy, breaker=breaker,
+                    )
+                    if raw is not None:
+                        views[r] = json.loads(raw)
+                if len(views) < nprocs:
+                    time.sleep(0.1)
+            if len(views) < nprocs:
+                logger.warning(
+                    "snapshot agreement timed out (%d/%d views); using local scan",
+                    len(views), nprocs,
+                )
+                return local
+            if any(v is None for v in views.values()):
+                return None  # some rank sees no snapshot: cold start everywhere
+            agreed = min(views.values())
+            if agreed != local:
+                logger.info(
+                    "snapshot agreement chose step %s (local view was %s)",
+                    agreed, local,
+                )
+            return agreed
+        except (OSError, ConnectionError) as e:
+            logger.warning("rendezvous store unreachable for agreement (%s); "
+                           "using local scan", e)
+            return local
+
+    # -- the resume ----------------------------------------------------------
+
+    def resume(self, ddp, init_state, nonce: str = "0") -> Optional[ResumeResult]:
+        """Replay the agreed snapshot into ``ddp``'s gang.
+
+        ``init_state`` is the freshly built :class:`~bagua_tpu.ddp.TrainState`
+        from ``ddp.init(...)`` — it supplies the treedef, leaf dtypes and the
+        target sharding.  Returns None when there is nothing to resume."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from bagua_tpu.checkpoint import remap_world_size
+        from bagua_tpu.communication import ALL_AXES
+
+        step = self.agreed_resume_step(nonce=nonce)
+        if step is None:
+            return None
+        manifest, leaves = self.store.load_stacked(step)
+        treedef = jax.tree_util.tree_structure(init_state)
+        if treedef.num_leaves != len(leaves):
+            raise ValueError(
+                f"snapshot step {step} holds {len(leaves)} leaves but the "
+                f"engine's state has {treedef.num_leaves} — model/optimizer "
+                "definition changed since the snapshot was taken"
+            )
+        host_state = jax.tree_util.tree_unflatten(treedef, leaves)
+        old_world = int(manifest["world_size"])
+        new_world = ddp.group.size
+        if old_world != new_world:
+            logger.info(
+                "remapping snapshot step %d from world size %d to %d",
+                step, old_world, new_world,
+            )
+            kwargs = {}
+            if self.expert_filter is not None:
+                kwargs["expert_filter"] = self.expert_filter
+            host_state = remap_world_size(host_state, new_world, **kwargs)
+        # Match the init state's leaf dtypes (remap's broadcast goes through
+        # jnp and can weak-type) and commit to the step function's sharding —
+        # each process materializes exactly its addressable shards.
+        sharding = NamedSharding(ddp.group.mesh, P(ALL_AXES))
+
+        def commit(host, like):
+            arr = np.asarray(host, dtype=like.dtype)
+            if arr.shape != like.shape:
+                raise ValueError(
+                    f"snapshot leaf shape {arr.shape} != engine state {like.shape}"
+                )
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx, a=arr: a[idx]
+            )
+
+        state = jax.tree.map(commit, host_state, init_state)
+        plan_source = "fresh"
+        if self._adopt_plan(ddp, manifest.get("plan")):
+            plan_source = "carried"
+        # Lost work: the drained exit's marker records the step the previous
+        # incarnation actually reached; without one (hard kill) the loss is
+        # unknown but bounded by the snapshot cadence K.
+        from bagua_tpu.resilience.preemption import (
+            clear_resumable_marker, read_resumable_marker,
+        )
+
+        marker = read_resumable_marker(self.store.directory)
+        lost = max(0, int(marker["step"]) - step) if marker else 0
+        clear_resumable_marker(self.store.directory)
+        if self.telemetry is not None:
+            self.telemetry.on_restart(
+                step=step,
+                old_world_size=old_world,
+                new_world_size=new_world,
+                plan_source=plan_source,
+                lost_steps=lost,
+            )
+        logger.info(
+            "resumed at step %d (world %d -> %d, plan %s)",
+            step, old_world, new_world, plan_source,
+        )
+        return ResumeResult(state, step, old_world, new_world, plan_source)
+
+    def _adopt_plan(self, ddp, payload: Optional[Dict[str, Any]]) -> bool:
+        """Re-adopt the snapshot's bucket plan (no planner cold-start).  Best
+        effort: a payload that no longer matches the model (leaf renames,
+        bucketized-state algorithms) keeps the engine's fresh plan."""
+        if not payload:
+            return False
+        try:
+            return bool(ddp.adopt_plan_payload(payload))
+        except Exception as e:
+            logger.warning("could not carry bucket plan over (%s); keeping "
+                           "the fresh plan", e)
+            return False

@@ -1,0 +1,178 @@
+"""Jittered-exponential retry + circuit breaking for service RPCs.
+
+The resilience contract for every out-of-process dependency (the autotune
+service, the rendezvous store): a *transient* failure is retried with
+jittered exponential backoff; a *persistent* failure trips a circuit
+breaker so subsequent calls fail fast instead of stacking timeouts — a
+flapping sidecar service must degrade the job to its local defaults, never
+hang the gang (the reference's autotune client likewise treats the service
+as advisory).
+
+Knobs are env-carried like everything else (``bagua_tpu.env``):
+``BAGUA_RPC_RETRIES``, ``BAGUA_RPC_BACKOFF_BASE_S``,
+``BAGUA_RPC_BACKOFF_MAX_S``, ``BAGUA_RPC_BREAKER_THRESHOLD``,
+``BAGUA_RPC_BREAKER_COOLDOWN_S``.
+"""
+
+import logging
+import random
+import threading
+import time
+from typing import Callable, Optional, Tuple, Type
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CircuitOpenError", "CircuitBreaker", "RetryPolicy", "retry_call"]
+
+
+class CircuitOpenError(ConnectionError):
+    """Raised (fast, no I/O) while a circuit breaker is open."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker (thread-safe).
+
+    CLOSED: calls pass through; ``failure_threshold`` consecutive failures
+    open the circuit.  OPEN: :meth:`before_call` raises
+    :class:`CircuitOpenError` immediately.  After ``cooldown_s`` the next
+    call is admitted as a half-open probe — its success closes the circuit,
+    its failure re-opens it for another cooldown.  ``failure_threshold <= 0``
+    disables the breaker entirely.
+    """
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        cooldown_s: float = 30.0,
+        name: str = "rpc",
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False
+        self.times_opened = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._opened_at is None:
+                return "closed"
+            if self._clock() - self._opened_at >= self.cooldown_s:
+                return "half-open"
+            return "open"
+
+    def before_call(self) -> None:
+        """Gate one call attempt; raises :class:`CircuitOpenError` while
+        open.  In the half-open window exactly one probe is admitted at a
+        time (concurrent callers keep failing fast until it resolves)."""
+        if self.failure_threshold <= 0:
+            return
+        with self._lock:
+            if self._opened_at is None:
+                return
+            if self._clock() - self._opened_at < self.cooldown_s or self._probing:
+                raise CircuitOpenError(
+                    f"{self.name} circuit open "
+                    f"({self._consecutive_failures} consecutive failures); "
+                    f"failing fast for {self.cooldown_s}s cooldowns"
+                )
+            self._probing = True  # half-open: admit this caller as the probe
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._opened_at = None
+            self._probing = False
+
+    def record_failure(self) -> None:
+        if self.failure_threshold <= 0:
+            return
+        with self._lock:
+            self._consecutive_failures += 1
+            was_open = self._opened_at is not None
+            if self._probing or self._consecutive_failures >= self.failure_threshold:
+                self._opened_at = self._clock()
+                self._probing = False
+                if not was_open or self._consecutive_failures == self.failure_threshold:
+                    self.times_opened += 1
+                    logger.warning(
+                        "%s circuit OPEN after %d consecutive failures; "
+                        "degrading to local defaults for %.1fs",
+                        self.name, self._consecutive_failures, self.cooldown_s,
+                    )
+
+
+class RetryPolicy:
+    """Jittered exponential backoff: attempt ``i`` (0-based) sleeps
+    ``uniform(0, min(max_s, base_s * 2**i))`` before retrying — full jitter,
+    so a gang of workers retrying a recovering service doesn't stampede it
+    in lockstep."""
+
+    def __init__(
+        self,
+        retries: Optional[int] = None,
+        base_s: Optional[float] = None,
+        max_s: Optional[float] = None,
+        seed: Optional[int] = None,
+    ):
+        from bagua_tpu.env import (
+            get_rpc_backoff_base_s, get_rpc_backoff_max_s, get_rpc_retries,
+        )
+
+        self.retries = get_rpc_retries() if retries is None else retries
+        self.base_s = get_rpc_backoff_base_s() if base_s is None else base_s
+        self.max_s = get_rpc_backoff_max_s() if max_s is None else max_s
+        self._rng = random.Random(seed)
+
+    def backoff_s(self, attempt: int) -> float:
+        return self._rng.uniform(0.0, min(self.max_s, self.base_s * (2 ** attempt)))
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    policy: Optional[RetryPolicy] = None,
+    breaker: Optional[CircuitBreaker] = None,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError, ConnectionError),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)`` under the retry policy + breaker.
+
+    :class:`CircuitOpenError` from the breaker is never retried (the whole
+    point is to fail fast); any other ``retry_on`` exception is retried up
+    to ``policy.retries`` times with jittered backoff, and every outcome is
+    reported to the breaker so persistent flapping opens the circuit."""
+    policy = policy or RetryPolicy()
+    last: Optional[BaseException] = None
+    for attempt in range(policy.retries + 1):
+        if breaker is not None:
+            breaker.before_call()  # raises CircuitOpenError while open
+        try:
+            out = fn(*args, **kwargs)
+        except retry_on as e:
+            if breaker is not None:
+                breaker.record_failure()
+            last = e
+            if attempt >= policy.retries:
+                break
+            delay = policy.backoff_s(attempt)
+            if on_retry is not None:
+                on_retry(attempt, e)
+            logger.debug(
+                "retry %d/%d after %s (backoff %.3fs)",
+                attempt + 1, policy.retries, e, delay,
+            )
+            sleep(delay)
+        else:
+            if breaker is not None:
+                breaker.record_success()
+            return out
+    assert last is not None
+    raise last

@@ -1,5 +1,15 @@
 """Client for the autotune service (reference ``AutotuneClient``,
-``service/autotune_service.py:325``) — stdlib urllib, no requests dependency."""
+``service/autotune_service.py:325``) — stdlib urllib, no requests dependency.
+
+Every RPC goes through the resilience retry layer
+(:func:`bagua_tpu.resilience.retry.retry_call`): transient connection
+failures are retried with jittered exponential backoff
+(``BAGUA_RPC_RETRIES`` x ``BAGUA_RPC_BACKOFF_BASE_S``), and persistent ones
+trip the client's circuit breaker so a dead service fails fast instead of
+stacking 10s timeouts on every tick.  The *caller* (``AutotuneSession``)
+additionally degrades to its current local hyperparameters when the failure
+surfaces — the service is advisory, never load-bearing.
+"""
 
 import json
 import time
@@ -13,11 +23,22 @@ from bagua_tpu.env import get_bagua_service_port
 
 class AutotuneClient:
     def __init__(self, host: str = "127.0.0.1", port: Optional[int] = None, timeout: float = 10.0):
+        from bagua_tpu.env import (
+            get_rpc_breaker_cooldown_s, get_rpc_breaker_threshold,
+        )
+        from bagua_tpu.resilience.retry import CircuitBreaker, RetryPolicy
+
         port = port if port is not None else get_bagua_service_port()
         self.base = f"http://{host}:{port}"
         self.timeout = timeout
+        self.retry_policy = RetryPolicy()
+        self.breaker = CircuitBreaker(
+            failure_threshold=get_rpc_breaker_threshold(),
+            cooldown_s=get_rpc_breaker_cooldown_s(),
+            name="autotune-rpc",
+        )
 
-    def _post(self, path: str, payload: Dict) -> Dict:
+    def _post_once(self, path: str, payload: Dict) -> Dict:
         req = urllib.request.Request(
             self.base + path,
             data=json.dumps(payload).encode(),
@@ -26,6 +47,14 @@ class AutotuneClient:
         )
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             return json.loads(resp.read())
+
+    def _post(self, path: str, payload: Dict) -> Dict:
+        from bagua_tpu.resilience.retry import retry_call
+
+        return retry_call(
+            self._post_once, path, payload,
+            policy=self.retry_policy, breaker=self.breaker,
+        )
 
     def health_check(self) -> bool:
         try:

@@ -25,6 +25,14 @@ class Trainer:
             :class:`~bagua_tpu.ddp.DistributedDataParallel`.
         ckpt_dir: if set, checkpoints every ``ckpt_interval`` steps and
             auto-resumes from the latest checkpoint on startup.
+        snapshot_dir: if set, the resilience subsystem snapshots the train
+            state every ``snapshot_every`` steps *off the critical path*
+            (:class:`~bagua_tpu.resilience.AsyncSnapshotter`), installs a
+            SIGTERM preemption watcher that drains the in-flight step and
+            forces a final snapshot before a clean exit, and auto-resumes
+            from the newest complete snapshot on startup — carrying the
+            tuned bucket plan over.  ``BAGUA_SNAPSHOT_EVERY`` overrides the
+            cadence; a run stopped by preemption sets ``self.preempted``.
         autotune_model_name: if set (and the autotune service is reachable),
             runs the report/ask/re-bucket cycle.
         watchdog_timeout_s: hang detector (0 disables;
@@ -52,6 +60,9 @@ class Trainer:
         process_group=None,
         ckpt_dir: Optional[str] = None,
         ckpt_interval: int = 1000,
+        snapshot_dir: Optional[str] = None,
+        snapshot_every: int = 10,
+        snapshot_keep: int = 2,
         autotune_model_name: Optional[str] = None,
         watchdog_timeout_s: float = 300.0,
         dp_filter=None,
@@ -94,10 +105,60 @@ class Trainer:
         self.profile_steps = profile_steps
         self._profiler = None
         self._profiled = False  # one capture per Trainer, across fit() calls
+        # Resilience: async snapshotter + preemption watcher (tentpole).
+        self.snapshot_dir = snapshot_dir
+        self.snapshotter = None
+        self.preemption = None
+        self.preempted = False
+        self.resume_result = None
+        self._closed = False
+        if snapshot_dir:
+            from bagua_tpu.env import get_snapshot_every
+            from bagua_tpu.resilience import AsyncSnapshotter, PreemptionWatcher
+
+            every = get_snapshot_every() or snapshot_every
+            self.snapshotter = AsyncSnapshotter(
+                snapshot_dir, every,
+                world_size=self.ddp.group.size,
+                telemetry=telemetry,
+                keep=snapshot_keep,
+                # the live bucket plan rides every manifest so resume never
+                # cold-starts the planner
+                manifest_extra_fn=lambda: {"plan": self.ddp.export_plan_payload()},
+            )
+            self.preemption = PreemptionWatcher()
+            try:
+                self.preemption.install()
+            except ValueError:
+                # signal handlers only install on the main thread; a trainer
+                # driven from a worker thread keeps programmatic trigger()
+                logger.warning("not on the main thread: preemption watcher "
+                               "responds to trigger() only, not SIGTERM")
 
     def init_state(self, params=None, stacked_params=None):
         state = self.ddp.init(params, stacked_params=stacked_params)
-        if self.ckpt_dir:
+        resumed = False
+        if self.snapshotter is not None:
+            # Elastic resume from the newest complete snapshot (preferred
+            # over the synchronous checkpoint path: the drain writes here).
+            from bagua_tpu.resilience import ElasticResumeCoordinator
+
+            coordinator = ElasticResumeCoordinator(
+                self.snapshotter.store,
+                rendezvous_client=self._rendezvous_client(),
+                telemetry=self.telemetry,
+            )
+            try:
+                result = coordinator.resume(
+                    self.ddp, state, nonce=os.environ.get("BAGUA_ATTEMPT", "0")
+                )
+            except Exception as e:
+                logger.warning("snapshot resume failed (%s); starting fresh", e)
+                result = None
+            if result is not None:
+                state, resumed = result.state, True
+                self.resume_result = result
+        if not resumed and self.ckpt_dir:
             from bagua_tpu.checkpoint import get_latest_iteration, load_checkpoint
 
             it = get_latest_iteration(self.ckpt_dir)
@@ -110,6 +171,22 @@ class Trainer:
             except Exception as e:  # service not reachable: train without tuning
                 logger.warning("autotune disabled: %s", e)
         return state
+
+    def _rendezvous_client(self):
+        """A store client for the cross-rank snapshot agreement, when the
+        launcher exported an endpoint and the job actually spans processes."""
+        endpoint = os.environ.get("BAGUA_RDZV_ENDPOINT")
+        if not endpoint or jax.process_count() <= 1:
+            return None
+        try:
+            from bagua_tpu.distributed.rendezvous import RendezvousClient
+
+            return RendezvousClient(
+                endpoint, node_rank=int(os.environ.get("NODE_RANK", 0))
+            )
+        except Exception as e:
+            logger.warning("rendezvous client unavailable for resume (%s)", e)
+            return None
 
     def fit(self, state, batches: Iterable, n_steps: Optional[int] = None, log_every: int = 100):
         """Run the training loop; returns the final state."""
@@ -148,7 +225,12 @@ class Trainer:
                 self.watchdog.beat()
             if self._session:
                 self._session.tick(n_samples)
-            step = int(state.step[0])
+            step = self._state_step(state)
+            if self.snapshotter is not None:
+                self.snapshotter.maybe_snapshot(state, step)
+            if self.preemption is not None and self.preemption.should_stop():
+                self._drain_and_exit(state, step)
+                return state
             if self.ckpt_dir and step % self.ckpt_interval == 0:
                 from bagua_tpu.checkpoint import save_checkpoint
 
@@ -176,19 +258,72 @@ class Trainer:
             logger.info("xprof trace (cut at epoch end) captured to %s", self.profile_dir)
         return state
 
+    def _state_step(self, state) -> int:
+        """Completed-step count, readable on every process of the gang (the
+        rank-0 slice of ``state.step`` may not be addressable here)."""
+        if self.ddp._host_step is not None:
+            return self.ddp._host_step
+        arr = state.step
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            import jax.numpy as jnp
+
+            return int(jnp.reshape(arr.addressable_shards[0].data, (-1,))[0])
+        return int(arr[0])
+
+    def _drain_and_exit(self, state, step: int) -> None:
+        """The preemption path: the in-flight step has completed (we only
+        poll between steps), so drain device work, force a synchronous final
+        snapshot and leave a resumable marker — the restarted gang loses
+        zero steps instead of up-to-K."""
+        from bagua_tpu.resilience import write_resumable_marker
+
+        logger.warning("preemption signal received: draining at step %d", step)
+        jax.block_until_ready(state)
+        try:
+            self.snapshotter.force_snapshot(state, step)
+            write_resumable_marker(self.snapshot_dir, step)
+        except Exception:
+            logger.exception("final snapshot failed; newest complete "
+                             "snapshot still bounds the lost work")
+        self.preempted = True
+
     def close(self) -> None:
-        """Release background machinery: the hang watchdog and any algorithm
-        threads (async averager).  Safe to call more than once."""
+        """Release background machinery: profiler, snapshotter, preemption
+        handler, the hang watchdog, telemetry buffers and any algorithm
+        threads (async averager).  Idempotent and exception-safe: every
+        teardown runs even when an earlier one fails (a profiler that died
+        mid-``fit`` must not leave the watchdog thread alive or the JSONL
+        stream unflushed), and a second call is a no-op."""
+        if self._closed:
+            return
+        self._closed = True
+        for what, teardown in (
+            ("profiler", self._stop_profiler),
+            ("snapshotter", lambda: self.snapshotter and self.snapshotter.close()),
+            ("preemption watcher", lambda: self.preemption and self.preemption.uninstall()),
+            ("watchdog", self._stop_watchdog),
+            ("telemetry", lambda: self.telemetry and self.telemetry.flush()),
+            ("ddp", self.ddp.shutdown),
+        ):
+            try:
+                teardown()
+            except Exception:
+                logger.exception("error closing %s (continuing teardown)", what)
+
+    def _stop_profiler(self) -> None:
         if self._profiler is not None:  # fit() ended inside the window
             self._profiler.stop()
             self._profiler = None
+
+    def _stop_watchdog(self) -> None:
         if self.watchdog:
             self.watchdog.stop()
             self.watchdog = None
-        self.ddp.shutdown()
 
     def __enter__(self) -> "Trainer":
         return self
 
     def __exit__(self, *exc) -> None:
+        # Runs on the exception path too: a fit() that raises mid-step still
+        # stops the watchdog and flushes telemetry (close is exception-safe).
         self.close()

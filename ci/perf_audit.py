@@ -41,6 +41,7 @@ import json
 import os
 import re
 import sys
+import tempfile
 import time
 
 os.environ.setdefault("XLA_FLAGS", "")
@@ -845,6 +846,18 @@ def main():
     # Recorded-span planner gate: DP partition must beat the greedy seed
     # plan's predicted exposed comm on the committed VGG16 fixture.
     planner_result = autotune_planner_lane()
+    # Fault-injection resilience gate: SIGTERM a live 2-process gang, resume
+    # it, hold the resumed state bitwise-equal to an uninterrupted run (the
+    # --algo lanes skip it — one execution per CI run is the evidence).
+    resilience_result = None
+    if args.algo is None:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import fault_injection
+
+        resilience_result = fault_injection.run_lane(
+            tempfile.mkdtemp(prefix="bagua_fault_injection_"),
+            args.out + "_resilience.json",
+        )
     fsdp_result = None if args.ddp_only else audit_fsdp()[0]
 
     trace = load_trace_overlap()
@@ -852,7 +865,8 @@ def main():
         json.dump(
             {"ddp": ddp_results, "fsdp": fsdp_result, "mesh": n,
              "model": args.model, "trace_overlap": trace,
-             "autotune_planner": planner_result},
+             "autotune_planner": planner_result,
+             "resilience": resilience_result},
             f, indent=1,
         )
     with open(args.out + ".md", "w") as f:

@@ -83,6 +83,72 @@ def test_resume_training_identical(group, tmp_path):
     assert int(state2.step[0]) == 6
 
 
+def test_tracker_torn_write_race_falls_back_to_scan(tmp_path):
+    """The save path publishes the completion marker *then* the tracker, both
+    via write-temp + atomic rename — so every torn-write interleaving a
+    restarting rank can observe heals to the newest checkpoint that actually
+    landed, never a garbage iteration."""
+    from bagua_tpu.checkpoint.checkpointing import (
+        COMPLETE_FILENAME, TRACKER_FILENAME, _atomic_write,
+    )
+
+    root = str(tmp_path)
+
+    def fake_ckpt(iteration, complete=True):
+        d = tmp_path / f"iter_{iteration:07d}"
+        d.mkdir()
+        (d / "model_states").mkdir()
+        if complete:
+            _atomic_write(str(d / COMPLETE_FILENAME), str(iteration))
+
+    # nothing on disk at all
+    assert get_latest_iteration(root) is None
+
+    fake_ckpt(3)
+    fake_ckpt(5, complete=False)  # writer killed before the marker landed
+    # the crash window: states of iter 5 half-written, tracker still says 3
+    (tmp_path / TRACKER_FILENAME).write_text("3")
+    assert get_latest_iteration(root) == 3
+    # ...or the tracker itself was advanced to the incomplete checkpoint by a
+    # buggy/older writer: the marker check rejects it, the scan heals to 3
+    (tmp_path / TRACKER_FILENAME).write_text("5")
+    assert get_latest_iteration(root) == 3
+    # a torn tracker (reader caught a half-flushed in-place write) is not fatal
+    (tmp_path / TRACKER_FILENAME).write_text("5\x00garbage")
+    assert get_latest_iteration(root) == 3
+    # tracker deleted entirely: pure scan
+    (tmp_path / TRACKER_FILENAME).unlink()
+    assert get_latest_iteration(root) == 3
+    # tracker pointing past every directory (NFS lag): scan fallback again
+    (tmp_path / TRACKER_FILENAME).write_text("9000")
+    assert get_latest_iteration(root) == 3
+
+    # no checkpoint ever completed: None, not a crash
+    (tmp_path / f"iter_{3:07d}" / COMPLETE_FILENAME).unlink()
+    assert get_latest_iteration(root) is None
+    # junk directory names are skipped by the scan
+    (tmp_path / "iter_notanumber").mkdir()
+    assert get_latest_iteration(root) is None
+
+
+@pytest.mark.slow
+def test_save_checkpoint_publishes_marker_before_tracker(tmp_path):
+    """After a real save: marker inside the checkpoint, tracker at the root,
+    and no .tmp residue anywhere (every publish was an atomic rename)."""
+    from bagua_tpu.checkpoint.checkpointing import COMPLETE_FILENAME, TRACKER_FILENAME
+
+    tree = {"w": jnp.arange(4.0)}
+    save_checkpoint(7, str(tmp_path), tree, moe_split=False)
+    assert (tmp_path / "iter_0000007" / COMPLETE_FILENAME).read_text() == "7"
+    assert (tmp_path / TRACKER_FILENAME).read_text() == "7"
+    residue = [
+        os.path.join(r, n)
+        for r, _, names in os.walk(tmp_path) for n in names if ".tmp." in n
+    ]
+    assert residue == []
+    assert get_latest_iteration(str(tmp_path)) == 7
+
+
 def test_remap_world_size_replicated_and_expert():
     """Elastic restart remap: replicated leaves re-stack to the new size;
     expert leaves redistribute the global expert pool (total preserved)."""
@@ -114,6 +180,54 @@ def test_remap_world_size_replicated_and_expert():
 
     with pytest.raises(ValueError):
         remap_world_size(state, 5, expert_filter=is_expert)  # 16 % 5 != 0
+
+
+def test_remap_world_size_edge_cases():
+    """Elastic-resume remap corners: odd→even shrink, growing past the
+    original size, and the expert pool surviving a down-up round trip
+    bitwise."""
+    from bagua_tpu.checkpoint import remap_world_size
+
+    is_expert = lambda path: "experts" in path
+    state = {
+        "dense": {"w": jnp.broadcast_to(jnp.arange(5.0)[None], (6, 5))},
+        "moe": {"experts": jnp.arange(6 * 2 * 3.0).reshape(6, 2, 3)},
+    }
+
+    # odd world size shrinking to an even one: 6 ranks x 2 experts = 12
+    # experts redistribute as 4 x 3; the flattened pool is order-preserved
+    down = remap_world_size(state, 4, expert_filter=is_expert)
+    assert down["dense"]["w"].shape == (4, 5)
+    assert down["moe"]["experts"].shape == (4, 3, 3)
+    np.testing.assert_array_equal(
+        np.asarray(down["moe"]["experts"]).reshape(12, 3),
+        np.asarray(state["moe"]["experts"]).reshape(12, 3),
+    )
+
+    # growing PAST the original size: 12 experts over 12 ranks, one each
+    up = remap_world_size(state, 12, expert_filter=is_expert)
+    assert up["moe"]["experts"].shape == (12, 1, 3)
+    assert up["dense"]["w"].shape == (12, 5)
+    np.testing.assert_array_equal(up["dense"]["w"][11], state["dense"]["w"][0])
+    # ...but a growth the pool cannot fill (12 % 24 != 0) fails loud
+    with pytest.raises(ValueError):
+        remap_world_size(state, 24, expert_filter=is_expert)
+
+    # MoE down-up round trip is bitwise: shrink 6 -> 2, grow back 2 -> 6
+    shrunk = remap_world_size(state, 2, expert_filter=is_expert)
+    assert shrunk["moe"]["experts"].shape == (2, 6, 3)
+    back = remap_world_size(shrunk, 6, expert_filter=is_expert)
+    for key in ("dense", "moe"):
+        for leaf, orig in zip(
+            jax.tree.leaves(back[key]), jax.tree.leaves(state[key])
+        ):
+            np.testing.assert_array_equal(np.asarray(leaf), np.asarray(orig))
+
+    # None leaves (the expert/model split placeholders) pass through
+    holey = {"dense": None, "moe": {"experts": state["moe"]["experts"]}}
+    remapped = remap_world_size(holey, 3, expert_filter=is_expert)
+    assert remapped["dense"] is None
+    assert remapped["moe"]["experts"].shape == (3, 4, 3)
 
 
 def test_parse_nnodes():

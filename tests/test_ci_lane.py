@@ -38,6 +38,7 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert "overlap wire-pattern assertion passed" in proc.stderr
     assert "telemetry metrics schema check passed" in proc.stderr
     assert "autotune planner lane passed" in proc.stderr
+    assert "fault-injection resilience lane passed" in proc.stderr
 
     # The telemetry smoke emits a JSONL metrics stream next to --out; hold it
     # to the event schema here too (belt and braces: the subprocess already
@@ -80,6 +81,18 @@ def test_perf_audit_quick_overlap_census(tmp_path):
         < planner["greedy_plan"]["predicted_exposed_ms"]
     )
     assert planner["gain_ms"] > 0
+
+    # The fault-injection lane's artifact: a killed-and-resumed gang landed
+    # bitwise-identical to the uninterrupted reference run, on the carried
+    # bucket plan, losing no more work than the snapshot cadence bounds.
+    with open(str(out) + "_resilience.json") as f:
+        resilience = json.load(f)
+    fi = resilience["fault_injection"]
+    assert fi["bitwise_identical"] is True
+    assert fi["plan_source"] == "carried"
+    assert fi["lost_steps"] <= 2 * fi["snapshot_every"]
+    assert audit["resilience"]["fault_injection"] == fi
+    assert resilience["overhead"]["p50_on_ms"] > 0
 
 
 def test_perf_audit_quick_bytegrad_compressed_census(tmp_path):

@@ -1,0 +1,648 @@
+"""Resilience subsystem: async snapshots, preemption drain, elastic resume,
+retry/breaker — the unit half of the tentpole's acceptance.
+
+The fault-injection CI lane (``ci/fault_injection.py``, driven by
+``tests/test_ci_lane.py``) proves the end-to-end story with real signals
+against a live gang.  What it *cannot* exercise in this container — the
+CPU backend refuses cross-process computations, so a genuine 2-process
+gang never jits — is pinned here instead: the multi-process snapshot
+layout (per-process files + stacked load), the cross-rank KV agreement
+against a live rendezvous store (process count/index monkeypatched), and
+every torn/partial/outage edge the filesystem and network can produce.
+"""
+
+import json
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.models.mlp import init_mlp, mse_loss
+from bagua_tpu.observability import Telemetry, validate_metrics_file
+from bagua_tpu.resilience import (
+    MANIFEST_FILENAME,
+    AsyncSnapshotter,
+    CircuitBreaker,
+    CircuitOpenError,
+    ElasticResumeCoordinator,
+    PreemptionWatcher,
+    RetryPolicy,
+    SnapshotStore,
+    clear_resumable_marker,
+    read_resumable_marker,
+    retry_call,
+    write_resumable_marker,
+)
+
+LAYERS = [12, 16, 16, 4]
+
+
+def make_batch(seed=0, n=32):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(n, LAYERS[0]).astype(np.float32))
+    y = jnp.asarray(rng.randn(n, LAYERS[-1]).astype(np.float32))
+    return x, y
+
+
+def make_ddp(group, bucket_size=1 << 9):
+    return DistributedDataParallel(
+        mse_loss, optax.sgd(0.1), GradientAllReduceAlgorithm(),
+        process_group=group, bucket_size_bytes=bucket_size,
+    )
+
+
+def leaves_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- SnapshotStore: atomic completeness rules ---------------------------------
+
+
+def test_store_completeness_skips_torn_and_partial(tmp_path):
+    """A snapshot is complete iff its manifest exists AND every file it
+    names exists — a killed writer leaves garbage that is skipped, never an
+    error or a torn read."""
+    store = SnapshotStore(str(tmp_path))
+    arrays = [np.arange(8, dtype=np.float32).reshape(2, 4), np.ones(3)]
+
+    # process file without a manifest: not yet committed
+    store.write_process_arrays(5, 0, arrays)
+    assert not store.is_complete(5)
+    assert store.latest_complete() is None
+
+    store.write_manifest(5, {"step": 5, "world_size": 2, "num_processes": 1,
+                             "files": ["proc0.npz"]})
+    assert store.is_complete(5) and store.latest_complete() == 5
+
+    # a newer directory holding only a torn tmp file (writer killed mid-write)
+    os.makedirs(store.step_dir(9), exist_ok=True)
+    with open(os.path.join(store.step_dir(9), "proc0.npz.tmp.123"), "wb") as f:
+        f.write(b"torn")
+    # a newer manifest that names a file which never landed (rank died)
+    store.write_manifest(12, {"step": 12, "world_size": 4, "num_processes": 2,
+                              "files": ["proc0.npz", "proc1.npz"]})
+    store.write_process_arrays(12, 0, arrays)
+    assert not store.is_complete(9) and not store.is_complete(12)
+    assert store.latest_complete() == 5  # only 5 may be trusted
+
+    # atomic writes leave no tmp residue in the committed snapshot
+    assert not [n for n in os.listdir(store.step_dir(5)) if ".tmp." in n]
+    # non-step junk in the root is ignored
+    os.makedirs(os.path.join(str(tmp_path), "step_garbage"), exist_ok=True)
+    (tmp_path / "notes.txt").write_text("x")
+    assert store.steps() == [5, 9, 12]
+
+
+def test_store_multiprocess_layout_and_stacked_load(tmp_path):
+    """The multi-process layout this container can't produce live: each
+    process writes its leading-axis slice; load_stacked concatenates the
+    manifest-named files in process order into full (world_size, ...) hosts."""
+    store = SnapshotStore(str(tmp_path))
+    full = [np.arange(4 * 3, dtype=np.float32).reshape(4, 3),
+            np.arange(4, dtype=np.int32).reshape(4, 1)]
+    store.write_process_arrays(3, 0, [a[:2] for a in full])
+    store.write_process_arrays(3, 1, [a[2:] for a in full])
+    manifest_in = {"step": 3, "world_size": 4, "num_processes": 2,
+                   "files": ["proc0.npz", "proc1.npz"], "plan": {"v": 1}}
+    store.write_manifest(3, manifest_in)
+
+    manifest, leaves = store.load_stacked(3)
+    assert manifest == manifest_in
+    assert len(leaves) == 2
+    for got, want in zip(leaves, full):
+        np.testing.assert_array_equal(got, want)
+
+    # process files that disagree on leaf count: torn gang, loud failure
+    store.write_process_arrays(8, 0, [full[0][:2], full[1][:2]])
+    store.write_process_arrays(8, 1, [full[0][2:]])
+    store.write_manifest(8, {"step": 8, "world_size": 4, "num_processes": 2,
+                             "files": ["proc0.npz", "proc1.npz"]})
+    with pytest.raises(ValueError, match="disagree on leaf count"):
+        store.load_stacked(8)
+    # loading an incomplete snapshot is a loud FileNotFoundError
+    with pytest.raises(FileNotFoundError):
+        store.load_stacked(99)
+
+
+def test_store_gc_keeps_newest_complete_and_inflight(tmp_path):
+    """gc keeps the newest ``keep`` complete snapshots plus any incomplete
+    directory *newer* than the newest complete one (may still be in flight);
+    older incomplete garbage goes."""
+    store = SnapshotStore(str(tmp_path))
+    arrays = [np.ones(2)]
+    for step in (2, 4, 6):
+        store.write_process_arrays(step, 0, arrays)
+        store.write_manifest(step, {"step": step, "world_size": 1,
+                                    "num_processes": 1, "files": ["proc0.npz"]})
+    os.makedirs(store.step_dir(1), exist_ok=True)  # old killed-writer garbage
+    os.makedirs(store.step_dir(7), exist_ok=True)  # newer: may be in flight
+    store.gc(keep=2)
+    assert store.steps() == [4, 6, 7]
+    assert store.latest_complete() == 6
+
+
+# -- AsyncSnapshotter ---------------------------------------------------------
+
+
+def test_snapshotter_cadence_dedupe_and_busy_skip(tmp_path):
+    state = {"w": jnp.arange(16.0), "b": jnp.ones((4,))}
+    tel = Telemetry()
+    snap = AsyncSnapshotter(
+        str(tmp_path), every=2, process_index=0, num_processes=1,
+        world_size=1, telemetry=tel, keep=10,
+        manifest_extra_fn=lambda: {"plan": {"buckets": [["w"]]}},
+    )
+    try:
+        assert snap.maybe_snapshot(state, 1) is False  # off cadence
+        assert snap.maybe_snapshot(state, 2) is True
+        snap.drain()
+        assert snap.store.latest_complete() == 2
+        assert snap.maybe_snapshot(state, 2) is False  # same step: dedupe
+
+        # writer busy at the cadence tick: skipped (counted), never queued
+        snap._idle.clear()
+        assert snap.maybe_snapshot(state, 4) is False
+        snap._idle.set()
+        assert snap.skipped == 1
+        assert tel.registry.snapshot()["snapshot_skipped_total"] == 1
+
+        # forced (drain-path) snapshot blocks until the manifest is on disk
+        assert snap.force_snapshot(state, 6) is True
+        manifest = snap.store.read_manifest(6)
+        assert manifest["kind"] == "final"
+        assert manifest["plan"] == {"buckets": [["w"]]}  # extras ride along
+        assert snap.written == 2
+        # the written snapshot round-trips bitwise
+        _, leaves = snap.store.load_stacked(6)
+        leaves_equal(leaves, [state["b"], state["w"]])  # flatten order: b, w
+    finally:
+        snap.close()
+        snap.close()  # idempotent
+    assert tel.registry.snapshot()["snapshots_total"] == 2
+
+
+def test_snapshotter_disabled_and_error_surfacing(tmp_path):
+    state = {"w": jnp.ones(3)}
+    snap = AsyncSnapshotter(str(tmp_path / "off"), every=0, process_index=0,
+                            num_processes=1, world_size=1)
+    try:
+        assert snap.maybe_snapshot(state, 10) is False  # every=0 disables
+    finally:
+        snap.close()
+
+    snap2 = AsyncSnapshotter(str(tmp_path / "err"), every=1, process_index=0,
+                             num_processes=1, world_size=1)
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    snap2.store.write_process_arrays = boom
+    try:
+        with pytest.raises(OSError, match="disk full"):
+            snap2.force_snapshot(state, 1)  # blocking: the error surfaces here
+    finally:
+        snap2.close()
+
+
+# -- retry / backoff / circuit breaking ---------------------------------------
+
+
+def test_retry_policy_env_knobs_and_backoff_bounds(monkeypatch):
+    monkeypatch.setenv("BAGUA_RPC_RETRIES", "7")
+    monkeypatch.setenv("BAGUA_RPC_BACKOFF_BASE_S", "0.5")
+    monkeypatch.setenv("BAGUA_RPC_BACKOFF_MAX_S", "1.25")
+    p = RetryPolicy()
+    assert p.retries == 7 and p.base_s == 0.5 and p.max_s == 1.25
+
+    p = RetryPolicy(retries=3, base_s=1.0, max_s=4.0, seed=0)
+    for attempt in range(6):
+        for _ in range(20):  # full jitter: uniform(0, min(max, base * 2^i))
+            assert 0.0 <= p.backoff_s(attempt) <= min(4.0, 2.0 ** attempt)
+
+
+def test_retry_call_recovers_exhausts_and_passes_through():
+    calls, sleeps, retried = [], [], []
+    policy = RetryPolicy(retries=3, base_s=0.25, max_s=0.25, seed=1)
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    out = retry_call(flaky, policy=policy, sleep=sleeps.append,
+                     on_retry=lambda i, e: retried.append(i))
+    assert out == "ok" and len(calls) == 3
+    assert len(sleeps) == 2 and all(0.0 <= s <= 0.25 for s in sleeps)
+    assert retried == [0, 1]
+
+    def dead():
+        calls.append(1)
+        raise OSError("persistent")
+
+    calls.clear()
+    with pytest.raises(OSError, match="persistent"):
+        retry_call(dead, policy=policy, sleep=lambda s: None)
+    assert len(calls) == 4  # 1 + retries attempts, then the last error raises
+
+    def wrong():
+        calls.append(1)
+        raise ValueError("not transient")
+
+    calls.clear()
+    with pytest.raises(ValueError):  # outside retry_on: no retries burned
+        retry_call(wrong, policy=policy, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_circuit_breaker_open_halfopen_probe_lifecycle():
+    now = [0.0]
+    br = CircuitBreaker(failure_threshold=2, cooldown_s=10.0, clock=lambda: now[0])
+    assert br.state == "closed"
+    br.record_failure()
+    br.before_call()  # one failure: still closed
+    br.record_failure()
+    assert br.state == "open" and br.times_opened == 1
+    with pytest.raises(CircuitOpenError):
+        br.before_call()  # fast-fail, no I/O
+
+    now[0] = 11.0
+    assert br.state == "half-open"
+    br.before_call()  # admitted as THE probe
+    with pytest.raises(CircuitOpenError):
+        br.before_call()  # concurrent caller while the probe is in flight
+    br.record_failure()  # probe failed: re-open for another cooldown
+    with pytest.raises(CircuitOpenError):
+        br.before_call()
+
+    now[0] = 22.0
+    br.before_call()
+    br.record_success()  # probe succeeded: circuit closes
+    assert br.state == "closed"
+    br.before_call()
+
+    off = CircuitBreaker(failure_threshold=0)
+    for _ in range(10):
+        off.record_failure()
+    off.before_call()  # threshold <= 0 disables breaking entirely
+
+
+def test_retry_call_fails_fast_while_circuit_open():
+    """CircuitOpenError is never retried — the whole point is that a
+    flapping service degrades the job instantly, not after stacked timeouts."""
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=1000.0, clock=lambda: 0.0)
+    calls = []
+
+    def dead():
+        calls.append(1)
+        raise ConnectionError("down")
+
+    policy = RetryPolicy(retries=5, base_s=0.0, max_s=0.0)
+    with pytest.raises(CircuitOpenError):
+        retry_call(dead, policy=policy, breaker=br, sleep=lambda s: None)
+    assert len(calls) == 1  # first failure opened the circuit; no more I/O
+
+
+# -- preemption watcher + resumable marker ------------------------------------
+
+
+def test_preemption_trigger_and_marker_roundtrip(tmp_path):
+    w = PreemptionWatcher()
+    assert not w.should_stop() and not w.preempted
+    w.trigger()
+    assert w.should_stop() and w.preempted
+
+    d = str(tmp_path)
+    assert read_resumable_marker(d) is None
+    write_resumable_marker(d, 12, reason="preempted")
+    marker = read_resumable_marker(d)
+    assert marker["step"] == 12 and marker["reason"] == "preempted"
+    assert not [n for n in os.listdir(d) if ".tmp." in n]  # atomic publish
+    clear_resumable_marker(d)
+    assert read_resumable_marker(d) is None
+    clear_resumable_marker(d)  # idempotent
+
+
+def test_preemption_sigterm_sets_flag_and_chains_prior_handler():
+    """A real SIGTERM flips the flag (handler does nothing else — no I/O in
+    signal context) and any previously installed Python handler still runs."""
+    prior_calls = []
+    original = signal.signal(signal.SIGTERM, lambda s, f: prior_calls.append(s))
+    w = PreemptionWatcher()
+    try:
+        w.install().install()  # idempotent
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 5.0
+        while not w.preempted and time.time() < deadline:
+            time.sleep(0.01)
+        assert w.preempted and w.signum == signal.SIGTERM
+        assert prior_calls == [signal.SIGTERM]
+    finally:
+        w.uninstall()
+        restored = signal.getsignal(signal.SIGTERM)
+        signal.signal(signal.SIGTERM, original)
+    assert prior_calls and callable(restored)  # uninstall re-installed the prior
+
+
+# -- cross-rank snapshot agreement (live rendezvous KV) -----------------------
+
+
+def _complete_snapshot(store, step, world=2):
+    store.write_process_arrays(step, 0, [np.full((world, 2), float(step))])
+    store.write_manifest(step, {"step": step, "world_size": world,
+                                "num_processes": 1, "files": ["proc0.npz"]})
+
+
+@pytest.fixture()
+def kv_store():
+    """A live rendezvous store + two rank clients on localhost."""
+    from bagua_tpu.distributed.rendezvous import (
+        RendezvousClient, RendezvousState, start_rendezvous_server,
+    )
+    from tests.helpers import free_port
+
+    port = free_port()
+    server = start_rendezvous_server(RendezvousState(min_nodes=1), port)
+    endpoint = f"http://127.0.0.1:{port}"
+    try:
+        yield RendezvousClient(endpoint, node_rank=0), RendezvousClient(endpoint, node_rank=1)
+    finally:
+        server.shutdown()
+
+
+def test_agreed_resume_step_is_min_over_ranks(tmp_path, monkeypatch, kv_store):
+    """Ranks publish their local view under the attempt nonce and take the
+    minimum — a rank whose filesystem lags must not be resumed past what it
+    can read.  (Process count/index are monkeypatched: this container's CPU
+    backend cannot run a real multi-process gang.)"""
+    client0, client1 = kv_store
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    store = SnapshotStore(str(tmp_path))
+    _complete_snapshot(store, 3)
+    _complete_snapshot(store, 6)
+
+    coord = ElasticResumeCoordinator(store, rendezvous_client=client0,
+                                     agreement_timeout_s=10.0)
+    # rank 1's filesystem view lags at step 3: the gang agrees on 3, not 6
+    client1.kv_set("resilience/resume/7/rank1", json.dumps(3))
+    assert coord.agreed_resume_step(nonce="7") == 3
+    # rank 0's own view landed in the KV under the same nonce
+    assert json.loads(client0.kv_get("resilience/resume/7/rank0")) == 6
+
+    # a different nonce namespaces a different round: rank 1 sees nothing
+    # on disk, so the whole gang cold-starts
+    client1.kv_set("resilience/resume/8/rank1", json.dumps(None))
+    assert coord.agreed_resume_step(nonce="8") is None
+
+
+def test_agreement_timeout_and_outage_fall_back_to_local(
+    tmp_path, monkeypatch, kv_store
+):
+    client0, _ = kv_store
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setenv("BAGUA_RPC_RETRIES", "0")  # keep the outage path fast
+    monkeypatch.setenv("BAGUA_RPC_BACKOFF_MAX_S", "0.01")
+    store = SnapshotStore(str(tmp_path))
+    _complete_snapshot(store, 4)
+
+    # rank 1 never publishes: the agreement times out, local scan wins
+    coord = ElasticResumeCoordinator(store, rendezvous_client=client0,
+                                     agreement_timeout_s=0.5)
+    assert coord.agreed_resume_step(nonce="t") == 4
+
+    # store unreachable entirely: degrade to the local scan, never block
+    from bagua_tpu.distributed.rendezvous import RendezvousClient
+    from tests.helpers import free_port
+
+    dead = RendezvousClient(f"http://127.0.0.1:{free_port()}", node_rank=0,
+                            timeout_s=1.0)
+    coord = ElasticResumeCoordinator(store, rendezvous_client=dead,
+                                     agreement_timeout_s=0.5)
+    assert coord.agreed_resume_step(nonce="u") == 4
+
+    # single-process gang never consults the store at all
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    coord = ElasticResumeCoordinator(store, rendezvous_client=dead)
+    assert coord.agreed_resume_step() == 4
+
+
+# -- elastic resume into a live engine ----------------------------------------
+
+
+def test_resume_bitwise_roundtrip_carries_plan_and_marker(group, tmp_path):
+    """The core resume contract: the restored state is bitwise-identical to
+    the snapshotted one, the manifest's bucket plan is re-adopted (no planner
+    cold-start), the drain marker is consumed into ``lost_steps``, and the
+    restart lands on every telemetry surface."""
+    jsonl = str(tmp_path / "metrics.jsonl")
+    tel = Telemetry(metrics_jsonl=jsonl)
+    ddp = make_ddp(group, bucket_size=1 << 9)
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    batch = make_batch()
+    for _ in range(2):
+        state, _ = ddp.train_step(state, batch)
+    assert ddp.plan.num_buckets > 1
+
+    snap_dir = str(tmp_path / "snaps")
+    snap = AsyncSnapshotter(
+        snap_dir, every=1, world_size=group.size,
+        manifest_extra_fn=lambda: {"plan": ddp.export_plan_payload()},
+    )
+    snap.force_snapshot(state, 2)
+    snap.close()
+    # the previous incarnation drained at step 5 before its final snapshot
+    # failed: 3 steps of work are lost and the marker says so
+    write_resumable_marker(snap_dir, 5)
+
+    # the restarted engine cold-starts with a different (single-bucket) plan
+    ddp2 = make_ddp(group, bucket_size=1 << 22)
+    init2 = ddp2.init(init_mlp(jax.random.PRNGKey(9), LAYERS))
+    assert ddp2.plan.num_buckets == 1
+    coord = ElasticResumeCoordinator(snap_dir, telemetry=tel)
+    res = coord.resume(ddp2, init2)
+
+    assert res is not None and res.step == 2
+    assert res.old_world_size == res.new_world_size == group.size
+    assert res.plan_source == "carried"
+    assert ddp2.plan.num_buckets == ddp.plan.num_buckets  # tuned plan adopted
+    assert [[td.name for td in b] for b in ddp2.plan.declarations()] == [
+        [td.name for td in b] for b in ddp.plan.declarations()
+    ]
+    leaves_equal(res.state, state)  # bitwise, params + opt state + step
+    assert read_resumable_marker(snap_dir) is None  # resume consumed it
+
+    # resumed state trains on the adopted plan
+    state2, losses = ddp2.train_step(res.state, batch)
+    assert np.isfinite(np.asarray(losses)).all()
+    assert int(np.asarray(state2.step)[0]) == 3
+
+    tel.close()
+    assert validate_metrics_file(jsonl) == []
+    events = [json.loads(l) for l in open(jsonl) if l.strip()]
+    (restart,) = [e for e in events if e["event"] == "restart"]
+    assert restart["step"] == 2 and restart["lost_steps"] == 3
+    assert restart["plan_source"] == "carried"
+    assert tel.registry.snapshot()["lost_steps_total"] == 3
+    ddp.shutdown()
+    ddp2.shutdown()
+
+
+def test_resume_remaps_snapshot_into_resized_gang(group, tmp_path):
+    """A snapshot taken at world size 4 resumes into this 8-way gang: the
+    replicated leaves re-stack to the new size bitwise."""
+    ddp = make_ddp(group)
+    state = ddp.init(init_mlp(jax.random.PRNGKey(1), LAYERS))
+    state, _ = ddp.train_step(state, make_batch(1))
+
+    store = SnapshotStore(str(tmp_path))
+    halves = [np.asarray(leaf)[:4] for leaf in jax.tree.leaves(state)]
+    store.write_process_arrays(1, 0, halves)
+    store.write_manifest(1, {"step": 1, "world_size": 4, "num_processes": 1,
+                             "files": ["proc0.npz"]})
+
+    init2 = ddp.init(init_mlp(jax.random.PRNGKey(2), LAYERS))
+    res = ElasticResumeCoordinator(store).resume(ddp, init2)
+    assert res.old_world_size == 4 and res.new_world_size == group.size
+    assert res.plan_source == "fresh"  # no plan rode in this manifest
+    # allreduce keeps every rank row bitwise equal, so the remapped state
+    # must equal the original 8-stacked state exactly
+    leaves_equal(res.state, state)
+    ddp.shutdown()
+
+
+def test_resume_refuses_mismatched_state_shape(group, tmp_path):
+    """A snapshot from a different model/optimizer definition fails loud —
+    leaf-count drift must never be silently zip-truncated into the state."""
+    ddp = make_ddp(group)
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    snap_dir = str(tmp_path / "snaps")
+    snap = AsyncSnapshotter(snap_dir, every=1, world_size=group.size)
+    snap.force_snapshot(state, 1)
+    snap.close()
+
+    ddp2 = make_ddp(group)
+    init_smaller = ddp2.init(init_mlp(jax.random.PRNGKey(0), [12, 16, 4]))
+    with pytest.raises(ValueError, match="leaves"):
+        ElasticResumeCoordinator(snap_dir).resume(ddp2, init_smaller)
+    ddp.shutdown()
+    ddp2.shutdown()
+
+    # nothing on disk at all: resume is a clean None (cold start)
+    empty = ElasticResumeCoordinator(str(tmp_path / "empty"))
+    assert empty.resume(ddp2, init_smaller) is None
+
+
+# -- Trainer integration ------------------------------------------------------
+
+TR_LAYERS = [8, 12, 4]
+
+
+def make_trainer(group, tmp_path, telemetry=None, **kw):
+    from bagua_tpu.trainer import Trainer
+
+    kw.setdefault("snapshot_dir", str(tmp_path / "snaps"))
+    kw.setdefault("snapshot_every", 1000)  # cadence noise off; tests force
+    kw.setdefault("watchdog_timeout_s", 0)
+    return Trainer(
+        mse_loss, optax.sgd(0.05), GradientAllReduceAlgorithm(),
+        process_group=group, telemetry=telemetry, **kw,
+    )
+
+
+def tr_batches(n, seed=3):
+    rng = np.random.RandomState(seed)
+    return [
+        (jnp.asarray(rng.randn(16, TR_LAYERS[0]), np.float32),
+         jnp.asarray(rng.randn(16, TR_LAYERS[-1]), np.float32))
+        for _ in range(n)
+    ]
+
+
+def test_trainer_preemption_drain_then_elastic_resume(group, tmp_path):
+    """In-process end-to-end: a triggered preemption drains the in-flight
+    step, forces a final snapshot + resumable marker, and a second Trainer
+    over the same directory resumes at that exact step with zero lost work,
+    on the carried bucket plan."""
+    batches = tr_batches(6)
+    tr1 = make_trainer(group, tmp_path)
+    state = tr1.init_state(init_mlp(jax.random.PRNGKey(0), TR_LAYERS))
+    assert tr1.resume_result is None  # nothing to resume from yet
+    state = tr1.fit(state, batches[:3], log_every=0)
+    assert tr1._state_step(state) == 3 and not tr1.preempted
+
+    tr1.preemption.trigger()  # the orchestrator-sidecar path; SIGTERM is
+    # exercised with a real signal by ci/fault_injection.py
+    state = tr1.fit(state, batches[3:], log_every=0)
+    assert tr1.preempted  # drained after ONE more step, not the full epoch
+    assert tr1._state_step(state) == 4
+    snap_dir = str(tmp_path / "snaps")
+    assert read_resumable_marker(snap_dir)["step"] == 4
+    assert SnapshotStore(snap_dir).latest_complete() == 4
+    tr1.close()
+
+    tel = Telemetry(metrics_jsonl=str(tmp_path / "m.jsonl"))
+    tr2 = make_trainer(group, tmp_path, telemetry=tel)
+    state2 = tr2.init_state(init_mlp(jax.random.PRNGKey(7), TR_LAYERS))
+    res = tr2.resume_result
+    assert res is not None and res.step == 4
+    assert res.plan_source == "carried"
+    leaves_equal(state2, state)  # bitwise: params, opt state, step counter
+    assert read_resumable_marker(snap_dir) is None  # marker consumed
+
+    state2 = tr2.fit(state2, batches[4:], log_every=0)
+    assert tr2._state_step(state2) == 6
+    tr2.close()
+    assert validate_metrics_file(str(tmp_path / "m.jsonl")) == []
+    (restart,) = [
+        json.loads(l) for l in open(tmp_path / "m.jsonl") if l.strip()
+        and json.loads(l)["event"] == "restart"
+    ]
+    assert restart["step"] == 4 and restart["lost_steps"] == 0
+
+
+def test_trainer_close_idempotent_and_exception_safe(group, tmp_path, monkeypatch):
+    """close() tears everything down exactly once, keeps going past a
+    failing teardown, and the context manager closes on the exception path."""
+    monkeypatch.setenv("BAGUA_SNAPSHOT_EVERY", "5")  # env overrides the arg
+    tel = Telemetry()
+    tr = make_trainer(group, tmp_path, telemetry=tel, watchdog_timeout_s=60)
+    assert tr.snapshotter.every == 5
+    assert tr.preemption._installed  # SIGTERM handler live on the main thread
+    watchdog = tr.watchdog
+    assert watchdog is not None and watchdog._thread.is_alive()
+
+    shutdowns = []
+    monkeypatch.setattr(tr.ddp, "shutdown", lambda: shutdowns.append(1))
+
+    def boom():
+        raise RuntimeError("snapshotter teardown failed")
+
+    real_close = tr.snapshotter.close
+    tr.snapshotter.close = boom
+    tr.close()  # must not raise, must not stop early
+    assert tr._closed
+    assert tr.watchdog is None and watchdog._stopped.is_set()
+    watchdog._thread.join(timeout=10.0)
+    assert not watchdog._thread.is_alive()
+    assert not tr.preemption._installed  # signal handler restored
+    assert shutdowns == [1]  # teardown ran past the failing snapshotter
+    tr.close()  # second call is a no-op
+    assert shutdowns == [1]
+    real_close()  # don't leak the writer thread the test sabotaged
+
+    with pytest.raises(ValueError, match="mid-fit"):
+        with make_trainer(group, tmp_path / "ctx") as tr2:
+            raise ValueError("died mid-fit")
+    assert tr2._closed  # __exit__ closed on the exception path too

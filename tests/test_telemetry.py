@@ -428,6 +428,69 @@ def test_on_rebucket_counter_gauges_and_event(tmp_path):
     assert "bagua_plan_version 2" in prom
 
 
+def test_snapshot_and_restart_event_schemas(tmp_path):
+    """The resilience subsystem's JSONL events are schema-validated like
+    every other event type: required payload fields, typed, with torn or
+    truncated records reported rather than crashing the validator."""
+    snap_ok = {"ts": 1.0, "event": "snapshot", "step": 6,
+               "wall_ms": 12.5, "bytes": 4096, "kind": "async"}
+    restart_ok = {"ts": 2.0, "event": "restart", "step": 6,
+                  "old_world_size": 8, "new_world_size": 4,
+                  "plan_source": "carried", "lost_steps": 2}
+    assert validate_metrics_event(snap_ok) == []
+    assert validate_metrics_event(restart_ok) == []
+
+    missing = dict(snap_ok)
+    del missing["kind"]
+    assert any("'kind'" in p for p in validate_metrics_event(missing))
+    badtype = dict(restart_ok, lost_steps="two")
+    assert any("'lost_steps'" in p for p in validate_metrics_event(badtype))
+
+    path = str(tmp_path / "r.jsonl")
+    with JsonlSink(path) as sink:
+        sink.emit(dict(snap_ok))
+        sink.emit(dict(restart_ok))
+        with pytest.raises(ValueError):  # the sink refuses incomplete events
+            sink.emit({"event": "restart", "step": 1})
+    assert validate_metrics_file(path) == []
+
+
+def test_on_snapshot_and_on_restart_surfaces(tmp_path):
+    """A snapshot write and an elastic resume land on every telemetry surface
+    at once: counters/gauges/histograms, schema-valid JSONL events, and the
+    Prometheus text export."""
+    path = str(tmp_path / "res.jsonl")
+    tel = Telemetry(metrics_jsonl=path)
+    tel.on_snapshot(step=3, wall_ms=7.25, n_bytes=1 << 20, kind="async")
+    tel.on_snapshot(step=6, wall_ms=9.0, n_bytes=1 << 20, kind="final")
+    tel.on_restart(step=6, old_world_size=8, new_world_size=4,
+                   plan_source="carried", lost_steps=2)
+    tel.close()
+
+    snap = tel.registry.snapshot()
+    assert snap["snapshots_total"] == 2
+    assert snap["snapshot_last_step"] == 6.0
+    assert snap["snapshot_wall_ms"]["count"] == 2
+    assert snap["restarts_total"] == 1
+    assert snap["lost_steps_total"] == 2
+    assert snap["resumed_world_size"] == 4.0
+
+    assert validate_metrics_file(path) == []
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    snaps = [e for e in events if e["event"] == "snapshot"]
+    assert [e["kind"] for e in snaps] == ["async", "final"]
+    assert snaps[0]["bytes"] == 1 << 20 and snaps[0]["wall_ms"] == 7.25
+    (restart,) = [e for e in events if e["event"] == "restart"]
+    assert restart["step"] == 6 and restart["plan_source"] == "carried"
+    assert restart["old_world_size"] == 8 and restart["new_world_size"] == 4
+
+    prom = tel.registry.to_prometheus()
+    assert "bagua_snapshots_total 2" in prom
+    assert "bagua_restarts_total 1" in prom
+    assert "bagua_lost_steps_total 2" in prom
+    assert "bagua_snapshot_wall_ms_count 2" in prom
+
+
 def test_rebucket_emits_telemetry_from_engine(group, tmp_path):
     """End-to-end: DistributedDataParallel.rebucket bumps plan_version and
     feeds the hub; training continues on the new plan."""
