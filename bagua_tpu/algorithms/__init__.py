@@ -84,6 +84,24 @@ GlobalAlgorithmRegistry.register(
     "no communication (optimizer-owned comm, e.g. ZeRO-2, or debugging)",
 )
 
+
+def _zero_factory(**kwargs):
+    # Imported lazily: bagua_tpu.sharded.algorithm itself imports
+    # algorithms.base, so an eager import here would make
+    # ``import bagua_tpu.sharded`` (which triggers this package's __init__
+    # mid-flight) circular.
+    from bagua_tpu.sharded.algorithm import ZeroAlgorithm
+
+    return ZeroAlgorithm(**kwargs)
+
+
+GlobalAlgorithmRegistry.register(
+    "zero",
+    _zero_factory,
+    "ZeRO-sharded exchange: reduce-scatter grads, shard-only optimizer "
+    "update, deferred all-gather overlapped into the next forward",
+)
+
 from bagua_tpu.algorithms.grad_accumulation import (  # noqa: F401,E402
     GradientAccumulation,
     GradientAccumulationImpl,

@@ -1,60 +1,28 @@
-"""Fused optimizer: run the optimizer update on dtype-grouped fused arrays.
+"""DEPRECATED shim — the fused optimizer moved into the engine.
 
-TPU-native analog of the reference's generic fused optimizer
-(``contrib/fuse/optimizer.py``, 574 LoC).  The reference flattens parameter /
-gradient / state storages into contiguous buffers and intersects contiguous
-runs so one CUDA kernel covers many small tensors.  Under XLA the win is
-different but real: fusing N per-tensor update loops into a handful of flat
-array ops shrinks the HLO graph (faster compiles on models with thousands of
-small tensors) and guarantees the update lowers to a few large fused kernels.
-
-Usage (mirrors ``bagua_tpu`` optimizers being plain optax transforms)::
-
-    opt = fuse_optimizer(optax.adam(1e-3))
-
-The wrapper is exact: ``fuse_optimizer(opt)`` produces bitwise-identical
-updates to ``opt`` for any elementwise optimizer (SGD/momentum/Adam/...),
-because the fused arrays are just a re-layout of the same leaves.
+``fuse_optimizer`` / ``FusedState`` now live in
+:mod:`bagua_tpu.sharded.updater`: the dtype-group fusion this wrapper
+provided is engine-native there (the sharded updater concatenates every
+dtype group's bucket shards into one inner-optimizer call), and the
+standalone wrapper is re-exported for unsharded use.  This module stays as
+an import-compatible alias and will be removed in a future release.
 """
 
-from typing import NamedTuple, Optional
+import warnings
 
-import jax
-import optax
+from bagua_tpu.sharded.updater import FusedState, fuse_optimizer as _fuse_optimizer
 
-from bagua_tpu.bucket import BucketPlan
-
-
-class FusedState(NamedTuple):
-    inner: optax.OptState
+__all__ = ["FusedState", "fuse_optimizer"]
 
 
-def _plan_cache(params) -> BucketPlan:
-    # One bucket per dtype: single fused array per dtype group.
-    return BucketPlan.from_tree(params, bucket_size_bytes=1 << 62)
-
-
-def fuse_optimizer(inner: optax.GradientTransformation) -> optax.GradientTransformation:
-    """Wrap an optax transformation to run on fused flat arrays."""
-    plans = {}
-
-    def get_plan(tree):
-        leaves, structure = jax.tree.flatten(tree)
-        key = (structure, tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
-        if key not in plans:
-            plans[key] = _plan_cache(tree)
-        return plans[key]
-
-    def init_fn(params):
-        plan = get_plan(params)
-        fused_params = plan.bucketize(params)
-        return FusedState(inner=inner.init(fused_params))
-
-    def update_fn(updates, state, params=None):
-        plan = get_plan(updates)
-        fused_updates = plan.bucketize(updates)
-        fused_params = plan.bucketize(params) if params is not None else None
-        new_fused, new_inner = inner.update(fused_updates, state.inner, fused_params)
-        return plan.debucketize(new_fused), FusedState(inner=new_inner)
-
-    return optax.GradientTransformation(init_fn, update_fn)
+def fuse_optimizer(inner):
+    """Deprecated alias of :func:`bagua_tpu.sharded.updater.fuse_optimizer`
+    (bitwise-identical behavior)."""
+    warnings.warn(
+        "bagua_tpu.contrib.fuse_optimizer is deprecated; use "
+        "bagua_tpu.sharded.fuse_optimizer (or the engine-native sharded "
+        "updater via the 'zero' algorithm)",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    return _fuse_optimizer(inner)

@@ -1,5 +1,13 @@
 """ZeRO-1/2: optimizer-state (and gradient) sharding over the DP axes.
 
+.. deprecated::
+    These wrappers are superseded by the engine-native ``zero`` algorithm
+    (:mod:`bagua_tpu.sharded`): ``build_algorithm("zero")`` gets the same
+    reduce-scatter + sharded update with the parameter all-gather deferred
+    into the next step's forward, plus overlap, planner, telemetry and
+    snapshot integration the wrappers cannot see.  They remain functional
+    (and tested) for optimizer-level composition outside the engine.
+
 Absent from the reference (SURVEY §2.4: "ZeRO-style sharded optimizer — no")
 but a natural capability of the mesh substrate.  Both stages are optax
 wrappers usable inside the DDP engine's shard_mapped step (their ``update``

@@ -37,6 +37,8 @@ from bagua_tpu.communication import ALL_AXES, BaguaProcessGroup, get_default_gro
 from bagua_tpu.env import get_default_bucket_size
 from bagua_tpu.observability.annotations import step_scope
 from bagua_tpu.observability.core import StepTimer
+from bagua_tpu.sharded.layout import ShardLayout, reshard_group_flat
+from bagua_tpu.sharded.updater import ShardedOptState, ShardedOptimizerUpdater
 from bagua_tpu.utils import SpeedMeter
 
 
@@ -141,6 +143,15 @@ class DistributedDataParallel:
         # tensors_to_buckets; init() refreshes it before computing the plan.
         self.impl.overlap_hint = self.overlap_enabled
         self.plan: Optional[BucketPlan] = None
+        #: set when the algorithm reports ``sharded_update=True`` (the zero
+        #: algorithm): the engine replaces the whole-tree optimizer update
+        #: with the shard-only phase and carries per-bucket update shards in
+        #: the algorithm state (see bagua_tpu.sharded)
+        self._sharded_updater: Optional[ShardedOptimizerUpdater] = None
+        #: the shard layout live state was built under, captured by the FIRST
+        #: rebucket since the last application; train_step migrates the state
+        #: host-side before the next dispatch
+        self._pending_reshard: Optional[ShardLayout] = None
         #: monotonic bucket-plan version: 0 = the init() plan, +1 per
         #: rebucket() — exported as the telemetry ``plan_version`` gauge so a
         #: dashboard can line up throughput shifts with plan swaps
@@ -191,6 +202,10 @@ class DistributedDataParallel:
             template, self.bucket_size_bytes, filter_fn=self.dp_filter
         )
         self.impl.bind_plan(self.plan)
+        if getattr(self.impl, "sharded_update", False):
+            self._sharded_updater = ShardedOptimizerUpdater(
+                self.optimizer, self.plan, self.group
+            )
         self._tree_template = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template
         )
@@ -208,14 +223,14 @@ class DistributedDataParallel:
         if stacked_params is not None:
             build_stacked = lambda sp: TrainState(
                 params=sp,
-                opt_state=jax.vmap(self.optimizer.init)(sp),
+                opt_state=jax.vmap(self._opt_init)(sp),
                 algo_state=jax.vmap(self.impl.init_state)(sp),
                 step=jnp.zeros((n,), jnp.int32),
             )
             return jax.jit(build_stacked, out_shardings=sharding)(stacked_params)
         build = lambda p: TrainState(
             params=_stack(p, n),
-            opt_state=_stack(self.optimizer.init(p), n),
+            opt_state=_stack(self._opt_init(p), n),
             algo_state=_stack(self.impl.init_state(p), n),
             step=jnp.zeros((n,), jnp.int32),
         )
@@ -224,6 +239,28 @@ class DistributedDataParallel:
 
             params = jax.tree.map(np.asarray, params)
         return jax.jit(build, out_shardings=sharding)(params)
+
+    def _opt_init(self, params):
+        """Optimizer state for one rank: shard-sized under a sharded-update
+        algorithm (1/n of every moment per chip), the plain whole-tree init
+        otherwise."""
+        if self._sharded_updater is not None:
+            return self._sharded_updater.init(params)
+        return self.optimizer.init(params)
+
+    def state_template(self):
+        """Shape/dtype skeleton of the CURRENT state layout (rank-stacked),
+        without allocating — what a resume commit should validate leaf shapes
+        against after host-side resharding (``init_state`` built before a
+        plan adoption may describe a different shard layout)."""
+        n = self.group.size
+        build = lambda p: TrainState(
+            params=_stack(p, n),
+            opt_state=_stack(self._opt_init(p), n),
+            algo_state=_stack(self.impl.init_state(p), n),
+            step=jnp.zeros((n,), jnp.int32),
+        )
+        return jax.eval_shape(build, self._tree_template)
 
     # -- execution mode -----------------------------------------------------
 
@@ -259,8 +296,17 @@ class DistributedDataParallel:
                 "re-bucketing mid-training would desync it (the reference "
                 "likewise excludes such algorithms from autotune re-bucketing)"
             )
+        if self._sharded_updater is not None and self._pending_reshard is None:
+            # Keep the layout live state was actually built under (the FIRST
+            # of a burst of rebuckets): train_step migrates optimizer shards
+            # and pending updates host-side before the next dispatch.
+            self._pending_reshard = self._sharded_updater.layout
         self.plan = plan
         self.impl.bind_plan(plan)
+        if self._sharded_updater is not None:
+            self._sharded_updater = ShardedOptimizerUpdater(
+                self.optimizer, plan, self.group
+            )
         self._step_fns = {}
         self.plan_version += 1
         if self.telemetry is not None:
@@ -280,7 +326,7 @@ class DistributedDataParallel:
         cold-starting the planner."""
         if self.plan is None:
             return None
-        return {
+        payload = {
             "plan_version": self.plan_version,
             "bucket_size_bytes": int(self.bucket_size_bytes),
             "buckets": [
@@ -288,6 +334,12 @@ class DistributedDataParallel:
                 for bucket in self.plan.declarations()
             ],
         }
+        if self._sharded_updater is not None:
+            # Shard geometry rides the manifest so a resumed gang (possibly a
+            # different world size) can re-shard the per-rank optimizer state
+            # it finds in the snapshot (resilience/resume.py).
+            payload["shard"] = self._sharded_updater.layout.payload()
+        return payload
 
     def adopt_plan_payload(self, payload: dict) -> bool:
         """Adopt a previously exported plan payload (elastic resume).
@@ -324,6 +376,7 @@ class DistributedDataParallel:
     def _build_step(self, variant: str):
         impl, plan, group = self.impl, self.plan, self.group
         overlap = self.overlap_enabled
+        updater = self._sharded_updater  # rebucket rebuilds it + clears _step_fns
 
         def local_step(state: TrainState, batch):
             params, opt_state, algo_state, step = (
@@ -398,7 +451,23 @@ class DistributedDataParallel:
                     grads, params, algo_state = impl.transform_gradients(
                         grads, params, algo_state, ctx
                     )
-            if getattr(impl, "skips_optimizer_update", False):
+            if updater is not None:
+                # Sharded-update phase (zero algorithm): the exchange left the
+                # reduced gradients in rank-me's shard slice of every bucket;
+                # update only those slices (optimizer state is shard-sized)
+                # and stash the per-bucket *updated parameter* shards in the
+                # algorithm state — on_step_start of the NEXT step all-gathers
+                # them and swaps them in right before the forward, hiding the
+                # gather behind compute.  The updater applies p + u inside
+                # its own fusion cluster so rounding matches a standalone
+                # optax jit bitwise.  dp_filter-excluded leaves update in
+                # place.
+                with step_scope("sharded_update"):
+                    pending, opt_state, params = updater.update_shards(
+                        grads, params, opt_state
+                    )
+                    algo_state = impl.stash_updates(algo_state, pending)
+            elif getattr(impl, "skips_optimizer_update", False):
                 # Accumulating algorithms (no_sync analog) apply the optimizer
                 # only on their boundary steps — a zero-grad update would
                 # still mutate momentum/bias-correction state.
@@ -470,6 +539,8 @@ class DistributedDataParallel:
         ov = self.host_overhead
         step_ov = {}
         t0 = time.perf_counter()
+        if self._pending_reshard is not None:
+            state = self._apply_pending_reshard(state)
         state = self.impl.host_pre_dispatch(state)
         t1 = time.perf_counter()
         ov["pre"] += t1 - t0
@@ -507,6 +578,14 @@ class DistributedDataParallel:
             tel.enter_phase("wait")
             leaves = jax.tree_util.tree_leaves(batch)
             n_samples = int(leaves[0].shape[0]) if leaves and leaves[0].ndim else 0
+            wire_by_leg = None
+            if self._sharded_updater is not None and self.plan is not None:
+                # Ring-model bytes per leg: a reduce-scatter or all-gather of
+                # an N-byte bucket moves N*(n-1)/n on the wire — each leg half
+                # of the all-reduce's 2N*(n-1)/n.
+                n = self.group.size
+                leg = self.plan.total_bytes() * (n - 1) // n
+                wire_by_leg = {"rs": leg, "ag": leg}
             tel.on_step(
                 step=self._host_step - 1,
                 wall_s=wall,
@@ -514,8 +593,138 @@ class DistributedDataParallel:
                 wire_bytes=self.plan.total_bytes() if self.plan else 0,
                 variant=variant,
                 host_overhead=step_ov,
+                wire_bytes_by_leg=wire_by_leg,
             )
         return new_state, losses
+
+    # -- shard-layout migration (sharded-update algorithms) ------------------
+
+    def clear_pending_reshard(self) -> None:
+        """Drop a queued shard-layout migration — used by resume when the
+        committed snapshot is ALREADY in the just-adopted plan's layout (the
+        rebucket inside ``adopt_plan_payload`` queued a migration for live
+        state that is about to be replaced wholesale)."""
+        self._pending_reshard = None
+
+    def _apply_pending_reshard(self, state: TrainState) -> TrainState:
+        """Migrate live sharded state from the layout it was built under to
+        the current plan's layout (queued by ``rebucket``).  Host-side numpy,
+        element-value-preserving by tensor name (see sharded/layout.py), then
+        recommitted to the group mesh.  One host round-trip per plan swap —
+        the same cost class as the re-jit the swap already triggers."""
+        import numpy as np
+
+        old = self._pending_reshard
+        self._pending_reshard = None
+        new = self._sharded_updater.layout
+        host = jax.tree.map(np.asarray, state)
+        opt = host.opt_state
+        new_sharded = []
+        for new_g in new.groups:
+            old_g = old.group_for(new_g.dtype)
+            if old_g is None:
+                raise ValueError(
+                    f"cannot reshard: old layout lacks dtype group {new_g.dtype!r}"
+                )
+            st = opt.sharded[old.groups.index(old_g)]
+
+            def fix(l, old_g=old_g):
+                arr = np.asarray(l)
+                if (
+                    arr.ndim >= 2
+                    and arr.shape[0] == old.n_shards
+                    and arr.shape[-1] == old_g.shard_total
+                ):
+                    return reshard_group_flat(arr, old, new, old_g.dtype).astype(arr.dtype)
+                return arr
+
+            new_sharded.append(jax.tree.map(fix, st))
+        algo = self.impl.reshard_host_state(host.algo_state, old, new)
+        host = host._replace(
+            opt_state=ShardedOptState(sharded=tuple(new_sharded), local=opt.local),
+            algo_state=algo,
+        )
+        sharding = jax.sharding.NamedSharding(self.group.mesh, P(ALL_AXES))
+        return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding), host)
+
+    def reshard_host_state(
+        self, host_state: TrainState, plan_payload: dict, old_world: int
+    ) -> TrainState:
+        """Re-shard a snapshot's host state (numpy, rank-stacked at
+        ``old_world``) into this engine's current layout and world size — the
+        sharded-update replacement for a plain ``remap_world_size`` broadcast
+        on elastic resume.  Replicated leaves (params, step, the local
+        optimizer state) broadcast from row 0 as before; per-rank optimizer
+        shards and pending update shards genuinely migrate."""
+        import numpy as np
+
+        from bagua_tpu.checkpoint.checkpointing import remap_world_size
+
+        old = ShardLayout.from_payload(plan_payload, old_world)
+        new = self._sharded_updater.layout
+        n_new = self.group.size
+        opt = host_state.opt_state
+        rep = remap_world_size(
+            {"params": host_state.params, "step": host_state.step, "local": opt.local},
+            n_new,
+        )
+        new_sharded = []
+        for new_g in new.groups:
+            old_g = old.group_for(new_g.dtype)
+            if old_g is None:
+                raise ValueError(
+                    f"snapshot shard layout lacks dtype group {new_g.dtype!r}"
+                )
+            st = opt.sharded[old.groups.index(old_g)]
+
+            def fix(l, old_g=old_g):
+                arr = np.asarray(l)
+                if (
+                    arr.ndim >= 2
+                    and arr.shape[0] == old.n_shards
+                    and arr.shape[-1] == old_g.shard_total
+                ):
+                    return reshard_group_flat(arr, old, new, old_g.dtype).astype(arr.dtype)
+                if arr.ndim >= 1 and arr.shape[0] == old.n_shards:
+                    one = arr[0]  # replicated across ranks (e.g. adam count)
+                    return np.broadcast_to(one[None], (n_new,) + one.shape).copy()
+                return arr
+
+            new_sharded.append(jax.tree.map(fix, st))
+        algo = self.impl.reshard_host_state(host_state.algo_state, old, new)
+        return TrainState(
+            params=rep["params"],
+            opt_state=ShardedOptState(sharded=tuple(new_sharded), local=rep["local"]),
+            algo_state=algo,
+            step=rep["step"],
+        )
+
+    def finalize_pending_updates(self, state: TrainState) -> TrainState:
+        """Flush the deferred parameter all-gather: swap in the last step's
+        pending updated-parameter shards NOW instead of at the next step's
+        start.  Call before eval/export/final checkpoint under a
+        sharded-update algorithm — until then the covered parameters lag
+        their update by one exchange.  No-op for unsharded algorithms and
+        for a freshly initialized state (the step-0 gate keeps the initial
+        params); idempotent, since the gather *replaces* params with the
+        same pending values each time."""
+        if self._sharded_updater is None:
+            return state
+        impl, plan, group = self.impl, self.plan, self.group
+
+        def local_fin(state):
+            params = _local(state.params)
+            algo_state = _local(state.algo_state)
+            ctx = StepContext(group=group, step=state.step[0], plan=plan)
+            params, algo_state = impl.on_step_start(params, algo_state, ctx)
+            return state._replace(
+                params=_restack(params), algo_state=_restack(algo_state)
+            )
+
+        fn = self.group.shard_map(
+            local_fin, in_specs=(P(ALL_AXES),), out_specs=P(ALL_AXES)
+        )
+        return jax.jit(fn)(state)
 
     def host_overhead_snapshot(self, reset: bool = False) -> dict:
         """Per-step host-side milliseconds by phase (see ``host_overhead``)."""
@@ -797,10 +1006,14 @@ class AutotuneSession:
         the model with the live plan's operating point."""
         if hierarchical is None:
             hierarchical = bool(getattr(self.ddp.impl, "hierarchical", False))
+        # Sharded-update algorithms exchange gradients by reduce-scatter, so
+        # their bucket_wire spans calibrate the planner's rs leg, not flat.
+        leg = "rs" if getattr(self.ddp.impl, "sharded_update", False) else None
         self.spans.record_wire_timings(
             self.ddp.plan, analysis,
             intra_size=self.ddp.group.intra_size,
             hierarchical=hierarchical,
+            leg=leg,
         )
         self.spans.report_to_autotune(self.client, self.model_name)
 

@@ -59,7 +59,8 @@ class SpanRecorder:
                 self.record("tensor_ready", slot.name, start, start + 1e-9)
 
     def record_wire_timings(
-        self, plan, analysis: Dict, intra_size: int = 1, hierarchical: bool = False
+        self, plan, analysis: Dict, intra_size: int = 1, hierarchical: bool = False,
+        leg: Optional[str] = None,
     ) -> None:
         """Convert a device-trace analysis
         (:func:`~bagua_tpu.observability.trace_analysis.analyze_trace`) into
@@ -67,7 +68,9 @@ class SpanRecorder:
         attributed per-bucket row becomes one sample carrying the bucket's
         wire bytes (from the plan), measured collective seconds and hidden
         fraction; hierarchical captures tag the leg so intra/inter paths are
-        fitted separately."""
+        fitted separately.  An explicit ``leg`` overrides the tag — sharded
+        exchanges pass ``"rs"``/``"ag"`` so the planner fits the
+        reduce-scatter and all-gather wire paths independently."""
         for row in analysis.get("per_bucket", []):
             bi = row.get("bucket")
             if bi is None or bi >= len(plan.specs):
@@ -84,7 +87,7 @@ class SpanRecorder:
                         "end_time": seconds,
                         "nbytes": int(plan.specs[bi].nbytes),
                         "seconds": seconds,
-                        "leg": "intra" if hierarchical else "flat",
+                        "leg": leg or ("intra" if hierarchical else "flat"),
                         "hidden_frac": float(row.get("overlap_frac", 0.0)),
                         "intra_size": int(intra_size),
                     }

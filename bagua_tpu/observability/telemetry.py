@@ -187,8 +187,15 @@ class Telemetry:
         wire_bytes: int,
         variant: str = "default",
         host_overhead: Optional[Dict] = None,
+        wire_bytes_by_leg: Optional[Dict[str, int]] = None,
     ) -> None:
-        """One dispatched training step's host-side evidence."""
+        """One dispatched training step's host-side evidence.
+
+        ``wire_bytes_by_leg`` breaks ``wire_bytes`` down by wire pattern leg
+        (sharded exchanges report ``{"rs": ..., "ag": ...}``); each leg gets
+        its own ``wire_bytes_<leg>_total`` counter and the dict rides the
+        ``step`` JSONL event (the schema validator allows extra fields on
+        known event types)."""
         self.current_step = int(step)
         self.current_variant = variant
         self.recompile.record_step()
@@ -200,6 +207,12 @@ class Telemetry:
             "wire_bytes_total",
             help="bytes communicated per rank (bucket-plan census)",
         ).inc(max(0, int(wire_bytes)))
+        if wire_bytes_by_leg:
+            for leg, nbytes in sorted(wire_bytes_by_leg.items()):
+                r.counter(
+                    f"wire_bytes_{leg}_total",
+                    help=f"bytes communicated per rank on the {leg} leg",
+                ).inc(max(0, int(nbytes)))
         r.histogram("step_wall_ms", help="host-observed step wall time").observe(
             wall_s * 1e3
         )
@@ -216,6 +229,10 @@ class Telemetry:
             if host_overhead:
                 event["host_overhead_ms"] = {
                     k: round(v * 1e3, 4) for k, v in host_overhead.items()
+                }
+            if wire_bytes_by_leg:
+                event["wire_bytes_by_leg"] = {
+                    k: int(v) for k, v in sorted(wire_bytes_by_leg.items())
                 }
             self.jsonl.emit(event)
 

@@ -153,7 +153,26 @@ class ElasticResumeCoordinator:
         if step is None:
             return None
         manifest, leaves = self.store.load_stacked(step)
-        treedef = jax.tree_util.tree_structure(init_state)
+        old_world = int(manifest["world_size"])
+        new_world = ddp.group.size
+        # Adopt the carried plan BEFORE interpreting the snapshot leaves: a
+        # sharded (``zero``) engine's state STRUCTURE — per-bucket pending
+        # shards, per-dtype-group optimizer states — depends on the bucket
+        # plan, so even the leaf count is only meaningful once the engine is
+        # on the snapshot's layout.
+        plan_payload = manifest.get("plan")
+        plan_source = "carried" if self._adopt_plan(ddp, plan_payload) else "fresh"
+        if hasattr(ddp, "clear_pending_reshard"):
+            # The adoption above goes through ``rebucket``, which queues an
+            # in-band state migration — but the snapshot was *taken* in the
+            # carried layout, so there is nothing to migrate.
+            ddp.clear_pending_reshard()
+        # The template is re-derived from the engine (not ``init_state``)
+        # because plan adoption above may have changed sharded-state shapes.
+        like_state = (
+            ddp.state_template() if hasattr(ddp, "state_template") else init_state
+        )
+        treedef = jax.tree_util.tree_structure(like_state)
         if treedef.num_leaves != len(leaves):
             raise ValueError(
                 f"snapshot step {step} holds {len(leaves)} leaves but the "
@@ -161,18 +180,29 @@ class ElasticResumeCoordinator:
                 "definition changed since the snapshot was taken"
             )
         host_state = jax.tree_util.tree_unflatten(treedef, leaves)
-        old_world = int(manifest["world_size"])
-        new_world = ddp.group.size
         if old_world != new_world:
             logger.info(
                 "remapping snapshot step %d from world size %d to %d",
                 step, old_world, new_world,
             )
-            kwargs = {}
-            if self.expert_filter is not None:
-                kwargs["expert_filter"] = self.expert_filter
-            host_state = remap_world_size(host_state, new_world, **kwargs)
-        # Match the init state's leaf dtypes (remap's broadcast goes through
+            sharded = bool(
+                plan_payload
+                and plan_payload.get("shard")
+                and getattr(ddp, "_sharded_updater", None) is not None
+            )
+            if sharded:
+                # Optimizer-shard rows genuinely diverge per rank: replicate-
+                # row-0 remapping would corrupt them.  Reassemble full flats
+                # from the old shard layout and re-slice for the new world.
+                host_state = ddp.reshard_host_state(
+                    host_state, plan_payload, old_world
+                )
+            else:
+                kwargs = {}
+                if self.expert_filter is not None:
+                    kwargs["expert_filter"] = self.expert_filter
+                host_state = remap_world_size(host_state, new_world, **kwargs)
+        # Match the engine state's leaf dtypes (remap's broadcast goes through
         # jnp and can weak-type) and commit to the step function's sharding —
         # each process materializes exactly its addressable shards.
         sharding = NamedSharding(ddp.group.mesh, P(ALL_AXES))
@@ -187,10 +217,7 @@ class ElasticResumeCoordinator:
                 arr.shape, sharding, lambda idx, a=arr: a[idx]
             )
 
-        state = jax.tree.map(commit, host_state, init_state)
-        plan_source = "fresh"
-        if self._adopt_plan(ddp, manifest.get("plan")):
-            plan_source = "carried"
+        state = jax.tree.map(commit, host_state, like_state)
         # Lost work: the drained exit's marker records the step the previous
         # incarnation actually reached; without one (hard kill) the loss is
         # unknown but bounded by the snapshot cadence K.

@@ -33,6 +33,16 @@ Leaves are stored flat (``leaf_00000`` … in pytree-flatten order) with their
 ``keystr`` paths recorded in the manifest — restore rebuilds against a
 template treedef, which every resume path has (the freshly ``init()``-ed
 state), so no pickled structure rides in the artifact.
+
+**Sharded (ZeRO) engines** need no special casing on the write path: the
+engine's state is rank-stacked on the leading axis and each process writes
+only its addressable rows, so under the ``zero`` algorithm a process
+serializes exactly its own optimizer-state *shard* — per-chip snapshot
+bytes scale as ``1/n`` for the optimizer state, matching its residency.
+The shard layout itself rides in the manifest via ``manifest_extra_fn``
+(the engine's ``export_plan_payload`` includes a ``"shard"`` section), and
+:class:`~bagua_tpu.resilience.resume.ElasticResumeCoordinator` uses it to
+re-shard the optimizer state when the gang resizes.
 """
 
 import json

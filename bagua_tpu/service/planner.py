@@ -66,9 +66,12 @@ class WireSample:
     """One measured collective: ``nbytes`` on the wire took ``seconds``.
 
     ``leg`` tags the wire path: ``"flat"`` (single-level exchange),
-    ``"intra"`` (hierarchical intra-axis reduce) or ``"inter"``
-    (hierarchical cross-axis exchange).  ``hidden_frac`` is the span's
-    measured overlap fraction from the device trace, if attributed."""
+    ``"intra"`` (hierarchical intra-axis reduce), ``"inter"``
+    (hierarchical cross-axis exchange), ``"rs"`` (sharded reduce-scatter,
+    the ``zero`` algorithm's in-backward leg) or ``"ag"`` (the deferred
+    parameter all-gather riding the next step's forward).  ``hidden_frac``
+    is the span's measured overlap fraction from the device trace, if
+    attributed."""
 
     nbytes: float
     seconds: float
@@ -95,6 +98,11 @@ class AlphaBeta:
 DEFAULT_FLAT = AlphaBeta(alpha=100e-6, beta=40e9)
 DEFAULT_INTRA = AlphaBeta(alpha=30e-6, beta=100e9)
 DEFAULT_INTER = AlphaBeta(alpha=200e-6, beta=25e9)
+# Sharded (ZeRO) legs: a reduce-scatter or all-gather moves (n-1)/n of the
+# payload around the ring — half an allreduce's traffic each — so the
+# effective bandwidth prior sits above the flat allreduce prior.
+DEFAULT_RS = AlphaBeta(alpha=100e-6, beta=80e9)
+DEFAULT_AG = AlphaBeta(alpha=100e-6, beta=80e9)
 
 
 def fit_alpha_beta(
@@ -134,7 +142,11 @@ class CostModel:
     collective: the flat path is a single exchange; the hierarchical path is
     an intra-axis reduce over the full payload followed by an inter-axis
     exchange over ``nbytes / intra_size`` (each intra group contributes one
-    reduced copy to the cross-axis leg)."""
+    reduced copy to the cross-axis leg).  ``wire_pattern="sharded"`` models
+    the ``zero`` algorithm's in-backward leg instead — one reduce-scatter
+    per bucket (the deferred all-gather rides the *next* step's forward and
+    is priced separately by :meth:`ag_time`, not charged to the backward
+    tail this planner minimizes)."""
 
     def __init__(
         self,
@@ -142,11 +154,15 @@ class CostModel:
         intra: AlphaBeta = DEFAULT_INTRA,
         inter: AlphaBeta = DEFAULT_INTER,
         intra_size: int = 1,
+        rs: AlphaBeta = DEFAULT_RS,
+        ag: AlphaBeta = DEFAULT_AG,
     ):
         self.flat = flat
         self.intra = intra
         self.inter = inter
         self.intra_size = max(1, int(intra_size))
+        self.rs = rs
+        self.ag = ag
 
     @classmethod
     def from_samples(
@@ -160,14 +176,28 @@ class CostModel:
             intra=fit_alpha_beta(by_leg.get("intra", []), DEFAULT_INTRA),
             inter=fit_alpha_beta(by_leg.get("inter", []), DEFAULT_INTER),
             intra_size=intra_size,
+            rs=fit_alpha_beta(by_leg.get("rs", []), DEFAULT_RS),
+            ag=fit_alpha_beta(by_leg.get("ag", []), DEFAULT_AG),
         )
 
-    def bucket_wire_time(self, nbytes: float, hierarchical: bool = False) -> float:
+    def bucket_wire_time(
+        self,
+        nbytes: float,
+        hierarchical: bool = False,
+        wire_pattern: str = "allreduce",
+    ) -> float:
+        if wire_pattern == "sharded":
+            return self.rs.predict(nbytes)
         if hierarchical:
             return self.intra.predict(nbytes) + self.inter.predict(
                 nbytes / self.intra_size
             )
         return self.flat.predict(nbytes)
+
+    def ag_time(self, nbytes: float) -> float:
+        """Predicted time of the deferred parameter all-gather for one
+        bucket's full payload (the sharded pattern's second leg)."""
+        return self.ag.predict(nbytes)
 
     def describe(self) -> Dict:
         return {
@@ -176,7 +206,13 @@ class CostModel:
                 "beta_gbps": round(m.beta / 1e9, 3),
                 "n_samples": m.n_samples,
             }
-            for leg, m in (("flat", self.flat), ("intra", self.intra), ("inter", self.inter))
+            for leg, m in (
+                ("flat", self.flat),
+                ("intra", self.intra),
+                ("inter", self.inter),
+                ("rs", self.rs),
+                ("ag", self.ag),
+            )
         }
 
 
@@ -215,6 +251,9 @@ class BucketPlanner:
         cost_model: fitted :class:`CostModel` (default: priors only).
         overlap_efficiency: η calibration from the measured aggregate
             overlap fraction (see module docstring); clamped to [0, 1].
+        wire_pattern: ``"allreduce"`` (default) or ``"sharded"`` — which
+            per-bucket collective the cost model prices (the ``zero``
+            algorithm's in-backward leg is a reduce-scatter).
     """
 
     def __init__(
@@ -223,10 +262,12 @@ class BucketPlanner:
         arrivals: Dict[str, float],
         cost_model: Optional[CostModel] = None,
         overlap_efficiency: float = 1.0,
+        wire_pattern: str = "allreduce",
     ):
         self.declarations = list(declarations)
         self.cost_model = cost_model or CostModel()
         self.eta = min(1.0, max(0.0, float(overlap_efficiency)))
+        self.wire_pattern = wire_pattern
         latest = max(arrivals.values(), default=0.0)
         self.arrivals = {
             td.name: float(arrivals.get(td.name, latest)) for td in self.declarations
@@ -259,7 +300,9 @@ class BucketPlanner:
         t = 0.0
         total_wire = 0.0
         for r in rows:
-            w = self.cost_model.bucket_wire_time(r["nbytes"], hierarchical)
+            w = self.cost_model.bucket_wire_time(
+                r["nbytes"], hierarchical, wire_pattern=self.wire_pattern
+            )
             start = max(t, r["ready_s"])
             t = start + w
             total_wire += w
@@ -317,7 +360,9 @@ class BucketPlanner:
                 if max_bucket_bytes and size > max_bucket_bytes and i < j - 1:
                     break  # cap bounds fusion; singletons are always feasible
                 ready = arr[j - 1]  # arrival-sorted: last tensor arrives last
-                w = self.cost_model.bucket_wire_time(size, hierarchical)
+                w = self.cost_model.bucket_wire_time(
+                    size, hierarchical, wire_pattern=self.wire_pattern
+                )
                 for si, (cost_i, fin_i, _, _) in enumerate(frontier[i]):
                     fin = max(fin_i, ready) + w
                     # tail increment telescopes to max(fin_n, T) - T

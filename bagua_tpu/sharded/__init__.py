@@ -1,0 +1,46 @@
+"""ZeRO-fused bucketed exchange: reduce-scatter, sharded optimizer update,
+all-gather overlapped into the next step's forward.
+
+See ``docs/zero.md`` for the memory math and wire pattern.  The subsystem
+splits cleanly in three:
+
+* :mod:`~bagua_tpu.sharded.layout` — shard geometry + host-side resharding
+  (rebucket and elastic world-size remap share one code path);
+* :mod:`~bagua_tpu.sharded.updater` — the shard-only optimizer phase with
+  engine-native dtype-group fusion (absorbs ``contrib/fuse_optimizer``);
+* :mod:`~bagua_tpu.sharded.algorithm` — the registered ``zero`` algorithm
+  (reduce-scatter leg + deferred all-gather leg, ByteGrad-composable).
+"""
+
+from bagua_tpu.sharded.algorithm import ZeroAlgorithm, ZeroAlgorithmImpl
+from bagua_tpu.sharded.layout import (
+    BucketShard,
+    DtypeGroup,
+    ShardLayout,
+    ShardSlot,
+    assemble_full_flats,
+    reshard_bucket_rows,
+    reshard_group_flat,
+)
+from bagua_tpu.sharded.updater import (
+    FusedState,
+    ShardedOptState,
+    ShardedOptimizerUpdater,
+    fuse_optimizer,
+)
+
+__all__ = [
+    "ZeroAlgorithm",
+    "ZeroAlgorithmImpl",
+    "ShardLayout",
+    "ShardSlot",
+    "BucketShard",
+    "DtypeGroup",
+    "ShardedOptState",
+    "ShardedOptimizerUpdater",
+    "FusedState",
+    "fuse_optimizer",
+    "assemble_full_flats",
+    "reshard_bucket_rows",
+    "reshard_group_flat",
+]

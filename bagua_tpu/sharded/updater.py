@@ -1,0 +1,210 @@
+"""Sharded optimizer update: each rank updates only its shard of every bucket.
+
+The compute half of the ZeRO exchange (arXiv:2004.13336; reference
+``contrib/zero.py`` prototyped the wrapper form).  After the per-bucket
+reduce-scatter each rank holds the reduced gradients for its contiguous flat
+slice of every bucket; this module runs the inner optax transformation on
+exactly those slices and hands back per-bucket *update shards* for the
+deferred all-gather.  Optimizer state therefore exists only for ``1/n`` of
+every parameter on each chip — Adam's ``2P`` of moments becomes ``2P/n``.
+
+Fusion is engine-native here: all of a dtype group's bucket shards are
+concatenated into ONE flat vector per rank, so the inner optimizer runs once
+per dtype — the dtype-group fusion ``contrib/fuse_optimizer.py`` provided as
+a wrapper, absorbed into the engine (``fuse_optimizer`` itself now lives
+here, with a deprecated shim left behind in contrib).
+
+Bitwise contract: for elementwise optimizers (SGD/momentum/Adam/...) the
+update computed on a shard slice equals the corresponding slice of the
+update computed on the full tree, and alignment-padding slots carry zero
+gradients so their moments stay zero — concatenating the gathered shards
+reproduces the unsharded trajectory bit-for-bit (``tests/test_zero.py``).
+
+Leaves excluded from the plan by a ``dp_filter`` never ride a collective;
+they keep a small replicated "local" optimizer state and are updated in
+place each step, exactly as on the unsharded path.
+"""
+
+from typing import Any, Dict, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from bagua_tpu.bucket import BucketPlan, flatten_bucket_leaves
+from bagua_tpu.communication import rank_id
+from bagua_tpu.sharded.layout import ShardLayout
+from bagua_tpu.utils import from_bagua_datatype
+
+__all__ = ["ShardedOptState", "ShardedOptimizerUpdater", "FusedState", "fuse_optimizer"]
+
+
+class ShardedOptState(NamedTuple):
+    """Engine-side optimizer state under the zero algorithm: one inner state
+    per dtype group (shard-sized — the memory win), plus a replicated inner
+    state for dp_filter-excluded leaves."""
+
+    sharded: Tuple[Any, ...]
+    local: Any
+
+
+class ShardedOptimizerUpdater:
+    """Runs the inner optimizer on each rank's bucket shards only.
+
+    Built by the engine whenever the bound algorithm reports
+    ``sharded_update=True``; rebuilt on every ``rebucket`` (the layout is a
+    pure function of the plan + group size, and host-side resharding in
+    :mod:`bagua_tpu.sharded.layout` migrates live state between layouts).
+    """
+
+    def __init__(self, inner: optax.GradientTransformation, plan: BucketPlan, group):
+        self.inner = inner
+        self.plan = plan
+        self.group = group
+        self.layout = ShardLayout.from_plan(plan, group.size)
+        self._covered = {s.name for spec in plan.specs for s in spec.slots}
+
+    # -- helpers -------------------------------------------------------------
+
+    def _named_leaves(self, tree) -> Dict[str, Any]:
+        return {
+            jax.tree_util.keystr(p): l
+            for p, l in jax.tree_util.tree_flatten_with_path(tree)[0]
+        }
+
+    def _uncovered(self, tree) -> Dict[str, Any]:
+        return {
+            n: l for n, l in self._named_leaves(tree).items() if n not in self._covered
+        }
+
+    def _bucket_shards(self, tree, me) -> List[jnp.ndarray]:
+        """Rank ``me``'s flat slice of every bucket, plan order."""
+        groups = self.plan.group_leaves(tree)
+        shards = []
+        for bi, spec in enumerate(self.plan.specs):
+            leaves = [groups[bi][s.name] for s in spec.slots]
+            flat = flatten_bucket_leaves(leaves, spec)
+            sh = self.layout.buckets[bi].shard_numel
+            shards.append(jax.lax.dynamic_slice(flat, (me * sh,), (sh,)))
+        return shards
+
+    # -- API -----------------------------------------------------------------
+
+    def init(self, params) -> ShardedOptState:
+        """Shard-sized inner states (zeros are the correct shard values for
+        every optax init: moments start at zero, counts are shape-free)."""
+        sharded = tuple(
+            self.inner.init(jnp.zeros((g.shard_total,), from_bagua_datatype(g.dtype)))
+            for g in self.layout.groups
+        )
+        return ShardedOptState(sharded=sharded, local=self.inner.init(self._uncovered(params)))
+
+    def update_shards(self, grads, params, opt_state: ShardedOptState):
+        """One sharded optimizer phase (traced, inside shard_map).
+
+        ``grads`` is the exchanged tree: every bucket's flat image holds the
+        reduced values in rank-me's shard slice (the exchange zero-fills the
+        rest).  Returns ``(pending, new_opt_state, new_params)`` where
+        ``pending`` is one *updated parameter shard* per bucket — COVERED
+        PARAMS ARE NOT TOUCHED in ``new_params``; the algorithm all-gathers
+        the pending shards at the start of the next step and swaps them in
+        right before the forward, hiding the gather behind that step's
+        compute.  Pending carries post-update parameters (not raw updates)
+        so the ``p + u`` application happens HERE, in the same fusion
+        cluster as the optimizer math — rounding (FMA contraction included)
+        matches a standalone optax jit bitwise, keeping the trajectory
+        bitwise-identical to the plain-optax unsharded reference;
+        materializing raw updates across the gather boundary and adding
+        them later rounds differently.  Excluded leaves are updated in
+        place.
+        """
+        me = rank_id()
+        g_shards = self._bucket_shards(grads, me)
+        p_shards = self._bucket_shards(params, me)
+
+        pending: List[Any] = [None] * self.plan.num_buckets
+        new_sharded = []
+        for gi, grp in enumerate(self.layout.groups):
+            g_cat = jnp.concatenate([g_shards[bi] for bi in grp.buckets])
+            p_cat = jnp.concatenate([p_shards[bi] for bi in grp.buckets])
+            # Materialize contiguous inputs so the optimizer math forms its
+            # own fusion cluster, pinning it to the same codegen (FMA
+            # contraction included) as a standalone optax jit — the bitwise
+            # contract is against the plain-optax unsharded trajectory, and
+            # letting XLA fuse the math with the slice/concat data movement
+            # above would make rounding depend on the surrounding graph.
+            g_cat, p_cat = jax.lax.optimization_barrier((g_cat, p_cat))
+            upd_cat, st = self.inner.update(g_cat, opt_state.sharded[gi], p_cat)
+            newp_cat = optax.apply_updates(p_cat, upd_cat)
+            new_sharded.append(st)
+            off = 0
+            for bi in grp.buckets:
+                sh = self.layout.buckets[bi].shard_numel
+                pending[bi] = jax.lax.dynamic_slice(newp_cat, (off,), (sh,))
+                off += sh
+
+        # dp_filter-excluded leaves: local (replicated) update, applied now.
+        local_g = self._uncovered(grads)
+        new_local = opt_state.local
+        new_params = params
+        if local_g:
+            local_p = self._uncovered(params)
+            upd, new_local = self.inner.update(local_g, opt_state.local, local_p)
+            applied = optax.apply_updates(local_p, upd)
+            named = self._named_leaves(params)
+            named.update(applied)
+            paths, treedef = jax.tree_util.tree_flatten_with_path(params)
+            new_params = treedef.unflatten(
+                [named[jax.tree_util.keystr(p)] for p, _ in paths]
+            )
+        return (
+            tuple(pending),
+            ShardedOptState(sharded=tuple(new_sharded), local=new_local),
+            new_params,
+        )
+
+
+# -- fused (unsharded) optimizer ----------------------------------------------
+# Moved verbatim from contrib/fuse_optimizer.py (which now re-exports with a
+# DeprecationWarning): the dtype-group fusion idea whose engine-native form is
+# ShardedOptimizerUpdater above, kept as a standalone wrapper for unsharded
+# use.
+
+
+class FusedState(NamedTuple):
+    inner: optax.OptState
+
+
+def _plan_cache(params) -> BucketPlan:
+    # One bucket per dtype: single fused array per dtype group.
+    return BucketPlan.from_tree(params, bucket_size_bytes=1 << 62)
+
+
+def fuse_optimizer(inner: optax.GradientTransformation) -> optax.GradientTransformation:
+    """Wrap an optax transformation to run on fused flat arrays.
+
+    Exact: bitwise-identical updates to ``inner`` for any elementwise
+    optimizer, because the fused arrays are just a re-layout of the leaves.
+    """
+    plans = {}
+
+    def get_plan(tree):
+        leaves, structure = jax.tree.flatten(tree)
+        key = (structure, tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
+        if key not in plans:
+            plans[key] = _plan_cache(tree)
+        return plans[key]
+
+    def init_fn(params):
+        plan = get_plan(params)
+        fused_params = plan.bucketize(params)
+        return FusedState(inner=inner.init(fused_params))
+
+    def update_fn(updates, state, params=None):
+        plan = get_plan(updates)
+        fused_updates = plan.bucketize(updates)
+        fused_params = plan.bucketize(params) if params is not None else None
+        new_fused, new_inner = inner.update(fused_updates, state.inner, fused_params)
+        return plan.debucketize(new_fused), FusedState(inner=new_inner)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -175,6 +175,10 @@ VARIANTS = {
     "decentralized[overlap]": ({}, {"overlap": True}),
     "low_precision_decentralized": ({}, {"overlap": False}),
     "low_precision_decentralized[overlap]": ({}, {"overlap": True}),
+    # ZeRO-sharded exchange: per-bucket reduce-scatter + deferred all-gather;
+    # the optimizer updates only each rank's shard.
+    "zero": ({}, {"overlap": False}),
+    "zero[overlap]": ({}, {"overlap": True}),
 }
 
 # Compressed/decentralized overlap rows paired with their monolithic
@@ -234,6 +238,12 @@ def audit_ddp(algorithms, model="vgg16"):
         fn = ddp._build_step(variant)
         compiled = fn.lower(state, (x, y)).compile()
         text = compiled.as_text()
+        # Per-chip optimizer-state residency: the stacked state holds one row
+        # per rank, so a chip's share is total/ n.  Sharded (zero) rows carry
+        # 1/n-sized shard rows, so this drops ~n× vs the unsharded baseline.
+        opt_bytes = sum(
+            l.size * l.dtype.itemsize for l in jax.tree.leaves(state.opt_state)
+        )
         results[name] = {
             "census": census(text),
             "donation": donation(compiled),
@@ -242,6 +252,7 @@ def audit_ddp(algorithms, model="vgg16"):
             "buckets": ddp.plan.num_buckets,
             "slots": sum(len(s.slots) for s in ddp.plan.specs),
             "overlap": ddp.overlap_enabled,
+            "opt_state_bytes_per_chip": opt_bytes // n,
         }
         ddp.shutdown()
         print(f"[audit] ddp/{name}: {results[name]['census']}", file=sys.stderr)
@@ -538,6 +549,69 @@ def assert_compressed_overlap_census(ddp_results):
         )
 
 
+def assert_zero_census(ddp_results, n):
+    """The ZeRO sharded wire-pattern gate (docs/zero.md).
+
+    For each ``zero`` row present (needs the ``gradient_allreduce`` baseline
+    row in the same run): the compiled step must emit exactly one
+    ``reduce-scatter`` (the in-backward gradient leg) and one ``all-gather``
+    (the deferred parameter-update leg) per bucket, with ZERO gradient
+    all-reduces; the modeled ring traffic of the gradient-exchange leg must
+    be ≤ 0.55× the all-reduce baseline's (exactly 0.5 analytically — a
+    reduce-scatter moves half an allreduce's bytes); and the per-chip
+    optimizer-state bytes must be ≤ 0.2× the unsharded baseline's (1/n plus
+    padding, n = 8 here)."""
+    zero_rows = [k for k in ddp_results if k.split("[")[0] == "zero"]
+    if not zero_rows:
+        return
+    base = ddp_results.get("gradient_allreduce")
+    assert base is not None, "zero census gate needs the gradient_allreduce baseline row"
+    failures = []
+    for name in zero_rows:
+        row = ddp_results[name]
+        buckets = row["buckets"]
+        if buckets <= 1:
+            failures.append(f"{name}: single-bucket plan — per-bucket granularity untestable")
+            continue
+        for op in ("reduce-scatter", "all-gather"):
+            got = row["census"].get(op, {"count": 0})["count"]
+            if got != buckets:
+                failures.append(
+                    f"{name}: {got} {op}s, expected exactly one per bucket ({buckets})"
+                )
+        ar = row["census"].get("all-reduce", {"count": 0})["count"]
+        if ar != 0:
+            failures.append(f"{name}: {ar} all-reduces, expected none (sharded exchange)")
+        # Census records HLO *result* bytes.  RS result = payload/n, so its
+        # ring traffic is result×(n−1); AR result = payload, ring traffic
+        # result×2(n−1)/n.  The gradient-exchange leg is the RS alone (the
+        # all-gather carries parameter updates, hidden in the next forward).
+        rs_wire = _op_bytes(row, "reduce-scatter") * (n - 1)
+        ar_wire = _op_bytes(base, "all-reduce") * 2 * (n - 1) // n
+        if ar_wire and rs_wire > 0.55 * ar_wire:
+            failures.append(
+                f"{name}: grad-exchange ring bytes {rs_wire} > 0.55× the "
+                f"all-reduce baseline's {ar_wire}"
+            )
+        opt_ratio = row["opt_state_bytes_per_chip"] / max(
+            1, base["opt_state_bytes_per_chip"]
+        )
+        if opt_ratio > 0.2:
+            failures.append(
+                f"{name}: per-chip optimizer state "
+                f"{row['opt_state_bytes_per_chip']} B is {opt_ratio:.3f}× the "
+                f"baseline's {base['opt_state_bytes_per_chip']} B (expected ~1/{n})"
+            )
+    if failures:
+        raise SystemExit(
+            "zero sharded wire-pattern assertion FAILED:\n  " + "\n  ".join(failures)
+        )
+    print(
+        f"[audit] zero sharded wire-pattern assertion passed ({', '.join(zero_rows)})",
+        file=sys.stderr,
+    )
+
+
 def audit_fsdp():
     import bagua_tpu
     from bagua_tpu.parallel.fsdp import FSDP, scan_layers
@@ -610,6 +684,13 @@ EXPECTED = {
     "the optimizer update (post_step granularity switch; explicit opt-in — "
     "per-bucket min/max changes quantization granularity)",
     "async": "warmup all-reduce in-step; averaging rides the background thread's own jit",
+    "zero": "ZeRO-sharded exchange: one reduce-scatter per bucket (half an "
+    "allreduce's ring bytes), optimizer update on this rank's 1/n shard only "
+    "(per-chip Adam/momentum state drops ~n×), update all-gather deferred "
+    "into the NEXT step's forward — zero gradient all-reduces",
+    "zero[overlap]": "the reduce-scatter leg anchored inside the backward "
+    "pass per bucket (custom_vjp anchor, same as gradient_allreduce[overlap]); "
+    "the deferred all-gather already overlaps the forward in both modes",
 }
 
 
@@ -823,7 +904,10 @@ def main():
         "gradient_allreduce", "gradient_allreduce[flat]",
         "gradient_allreduce[overlap]", "gradient_allreduce[overlap,flat]",
     ]
-    if args.algo:
+    if args.algo == "zero":
+        # The sharded gate compares against the all-reduce baseline row.
+        algos = ["gradient_allreduce", "zero", "zero[overlap]"]
+    elif args.algo:
         algos = [args.algo, f"{args.algo}[overlap]"]
     elif args.quick:
         algos = gar_variants
@@ -833,6 +917,7 @@ def main():
             "qadam", "qadam[overlap]",
             "decentralized", "decentralized[overlap]",
             "low_precision_decentralized", "low_precision_decentralized[overlap]",
+            "zero", "zero[overlap]",
             "async",
         ]
     ddp_results, n = audit_ddp(algos, model=args.model)
@@ -840,6 +925,7 @@ def main():
     # which tests/test_ci_lane.py drives in the tier-1 lane).
     assert_overlap_census(ddp_results)
     assert_compressed_overlap_census(ddp_results)
+    assert_zero_census(ddp_results, n)
     # Executed telemetry gate: emits + schema-validates the metrics stream
     # next to --out and asserts a retrace-free steady state.
     telemetry_smoke(args.out)

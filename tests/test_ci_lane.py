@@ -130,3 +130,56 @@ def test_perf_audit_quick_bytegrad_compressed_census(tmp_path):
             ov["census"][op]["by_dtype"]["u8"]["bytes"]
             == mono["census"][op]["by_dtype"]["u8"]["bytes"]
         )
+
+
+def test_perf_audit_quick_zero_sharded_census(tmp_path):
+    """Satellite lane: ``--quick --algo=zero`` audits the sharded three-leg
+    exchange — exactly one reduce-scatter and one all-gather per bucket, no
+    gradient all-reduce, the RS ring bytes at ~0.5× (gated ≤0.55×) the
+    all-reduce baseline's, and per-chip optimizer state at ~1/n."""
+    out = tmp_path / "audit_zero"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "ci", "perf_audit.py"),
+            "--quick", "--algo=zero", "--model=mlp", "--ddp-only",
+            "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (
+        f"perf_audit --quick --algo=zero failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    assert "zero sharded wire-pattern assertion passed" in proc.stderr
+
+    with open(str(out) + ".json") as f:
+        audit = json.load(f)
+    rows = audit["ddp"]
+    assert "zero" in rows and "zero[overlap]" in rows
+    base = rows["gradient_allreduce"]
+    n = 8  # the subprocess builds its own 8-device CPU sim
+
+    def op_bytes(row, op):
+        return sum(
+            d["bytes"]
+            for d in row["census"].get(op, {}).get("by_dtype", {}).values()
+        )
+
+    for name in ("zero", "zero[overlap]"):
+        row = rows[name]
+        assert row["buckets"] > 1
+        # one RS (gradient leg) + one AG (parameter-update leg) per bucket,
+        # and the all-reduce is gone entirely
+        assert row["census"]["reduce-scatter"]["count"] == row["buckets"]
+        assert row["census"]["all-gather"]["count"] == row["buckets"]
+        assert row["census"].get("all-reduce", {"count": 0})["count"] == 0
+        # ring traffic of the gradient exchange: RS result bytes are
+        # payload/n, wire = result*(n-1); AR wire = result*2(n-1)/n
+        rs_wire = op_bytes(row, "reduce-scatter") * (n - 1)
+        ar_wire = op_bytes(base, "all-reduce") * 2 * (n - 1) // n
+        assert rs_wire <= 0.55 * ar_wire, (rs_wire, ar_wire)
+        # the memory claim: sharded Adam moments at ~1/n per chip
+        ratio = row["opt_state_bytes_per_chip"] / base["opt_state_bytes_per_chip"]
+        assert ratio <= 0.2, ratio

@@ -462,3 +462,61 @@ def test_midtrain_planner_rebucket_bitwise_parity(group):
         jax.tree_util.tree_leaves(state_b.params),
     ):
         np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+
+
+# -- sharded (zero) wire legs -------------------------------------------------
+
+
+def test_cost_model_fits_rs_ag_legs_independently():
+    """The sharded exchange reports its two legs separately; each must get
+    its own α–β fit while the allreduce legs keep their priors."""
+    from bagua_tpu.service.planner import DEFAULT_AG, DEFAULT_RS
+
+    rs = AlphaBeta(alpha=80e-6, beta=70e9)
+    ag = AlphaBeta(alpha=150e-6, beta=55e9)
+    samples = [
+        WireSample(nbytes=n, seconds=rs.predict(n), leg="rs")
+        for n in (1 << 20, 1 << 23, 1 << 25)
+    ] + [
+        WireSample(nbytes=n, seconds=ag.predict(n), leg="ag")
+        for n in (1 << 19, 1 << 22, 1 << 24)
+    ]
+    cm = CostModel.from_samples(samples)
+    assert cm.flat is DEFAULT_FLAT
+    assert cm.rs.alpha == pytest.approx(rs.alpha, rel=1e-6)
+    assert cm.rs.beta == pytest.approx(rs.beta, rel=1e-6)
+    assert cm.ag.alpha == pytest.approx(ag.alpha, rel=1e-6)
+    # no samples on a leg -> its prior stays
+    assert CostModel.from_samples([]).rs is DEFAULT_RS
+    assert CostModel.from_samples([]).ag is DEFAULT_AG
+    # the sharded pattern prices the RS leg; the deferred all-gather is
+    # priced by ag_time (next step's forward), never the backward tail
+    n = 1 << 24
+    assert cm.bucket_wire_time(n, wire_pattern="sharded") == pytest.approx(
+        rs.predict(n), rel=1e-6
+    )
+    assert cm.ag_time(n) == pytest.approx(ag.predict(n), rel=1e-6)
+
+
+def test_planner_sharded_wire_pattern_prices_rs_leg():
+    """A ``wire_pattern="sharded"`` planner sees cheaper per-bucket wire time
+    (RS moves half an allreduce's bytes), so with costly flat bandwidth the
+    sharded plan's predicted exposed tail must be strictly below the
+    allreduce plan's for the identical partition."""
+    ds = decls([1 << 18, 1 << 18, 1 << 18, 1 << 18])
+    arrivals = {td.name: 0.0005 * i for i, td in enumerate(ds)}
+    cm = CostModel(
+        flat=AlphaBeta(alpha=100e-6, beta=1e9),
+        rs=AlphaBeta(alpha=100e-6, beta=2e9),  # half the bytes on the wire
+    )
+    ar = BucketPlanner(ds, arrivals, cost_model=cm, wire_pattern="allreduce")
+    sh = BucketPlanner(ds, arrivals, cost_model=cm, wire_pattern="sharded")
+    part = [[ds[0], ds[1]], [ds[2], ds[3]]]
+    assert (
+        sh.evaluate(part).predicted_exposed_s
+        < ar.evaluate(part).predicted_exposed_s
+    )
+    # and the DP search itself runs under the sharded pattern
+    res = sh.plan()
+    assert res.n_buckets >= 1
+    assert res.total_wire_s < ar.plan().total_wire_s

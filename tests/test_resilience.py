@@ -646,3 +646,115 @@ def test_trainer_close_idempotent_and_exception_safe(group, tmp_path, monkeypatc
         with make_trainer(group, tmp_path / "ctx") as tr2:
             raise ValueError("died mid-fit")
     assert tr2._closed  # __exit__ closed on the exception path too
+
+
+# -- elastic resume of the sharded (zero) engine ------------------------------
+# The gang resize path above remaps replicated rank-stacked leaves; under the
+# zero algorithm the optimizer state and the pending updated-parameter shards
+# are SHARDED, so a resize must re-shard them through the layout recorded in
+# the snapshot manifest's plan payload.
+
+from bagua_tpu.communication import new_group  # noqa: E402
+from bagua_tpu.sharded import ZeroAlgorithm  # noqa: E402
+
+
+def make_zero_ddp(group, bucket_size=1 << 9):
+    return DistributedDataParallel(
+        mse_loss, optax.adam(1e-2), ZeroAlgorithm(),
+        process_group=group, bucket_size_bytes=bucket_size, overlap=True,
+    )
+
+
+def zero_snapshot(ddp, state, world, tmp_path, name, step):
+    snap_dir = str(tmp_path / name)
+    snap = AsyncSnapshotter(
+        snap_dir, every=1, world_size=world,
+        manifest_extra_fn=lambda: {"plan": ddp.export_plan_payload()},
+    )
+    snap.force_snapshot(state, step)
+    snap.close()
+    return snap_dir
+
+
+def test_zero_manifest_records_shard_layout(group, tmp_path):
+    """Satellite contract: snapshot manifests under the zero algorithm carry
+    the shard layout (world count + per-bucket shard geometry) so a resumer
+    can rebuild the exact layout the optimizer shards were written under."""
+    ddp = make_zero_ddp(group)
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    state, _ = ddp.train_step(state, make_batch(0, n=40))
+    snap_dir = zero_snapshot(ddp, state, group.size, tmp_path, "m", 1)
+    store = SnapshotStore(snap_dir)
+    manifest = json.load(open(os.path.join(store.step_dir(1), MANIFEST_FILENAME)))
+    shard = manifest["plan"]["shard"]
+    assert shard["n_shards"] == group.size
+    assert len(shard["buckets"]) == ddp.plan.num_buckets
+    for b in shard["buckets"]:
+        assert b["numel"] == b["shard_numel"] * group.size
+    ddp.shutdown()
+
+
+def test_zero_resume_grows_gang(group, tmp_path):
+    """Odd -> even grow: a snapshot from a 5-way sharded gang resumes into
+    this 8-way one.  Params replicate bitwise, and the migrated pending
+    updated-parameter shards finalize to exactly the full parameters the old
+    gang would have finalized."""
+    small = new_group(list(range(5)), intra_size=1)
+    ddp5 = make_zero_ddp(small)
+    st5 = ddp5.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    for i in range(2):
+        st5, _ = ddp5.train_step(st5, make_batch(i, n=40))
+    snap_dir = zero_snapshot(ddp5, st5, 5, tmp_path, "w5", 2)
+
+    ddp8 = make_zero_ddp(group)
+    init8 = ddp8.init(init_mlp(jax.random.PRNGKey(3), LAYERS))
+    res = ElasticResumeCoordinator(snap_dir).resume(ddp8, init8)
+    assert res is not None and res.step == 2
+    assert res.old_world_size == 5 and res.new_world_size == group.size
+    for a, b in zip(jax.tree.leaves(res.state.params), jax.tree.leaves(st5.params)):
+        np.testing.assert_array_equal(np.asarray(a)[0], np.asarray(b)[0])
+
+    fin5 = ddp5.finalize_pending_updates(st5)
+    fin8 = ddp8.finalize_pending_updates(res.state)
+    for a, b in zip(jax.tree.leaves(fin8.params), jax.tree.leaves(fin5.params)):
+        np.testing.assert_array_equal(np.asarray(a)[0], np.asarray(b)[0])
+
+    state, loss = ddp8.train_step(res.state, make_batch(9, n=40))
+    assert np.isfinite(np.asarray(loss)).all()
+    assert int(np.asarray(state.step)[0]) == 3
+    ddp5.shutdown()
+    ddp8.shutdown()
+
+
+def test_zero_resume_shrink_roundtrip_bitwise(group, tmp_path):
+    """Even -> odd shrink, then grow back: 8 -> 5 -> 8.  Re-sharding is
+    element-value-preserving by slot name and alignment padding carries
+    exact zeros on both sides (zero grads keep zero moments; zero params get
+    zero updates), so the round-tripped TrainState — params, sharded
+    optimizer moments, pending shards, step — is bitwise-identical to the
+    original 8-way state, leaf for leaf."""
+    ddp8 = make_zero_ddp(group)
+    st8 = ddp8.init(init_mlp(jax.random.PRNGKey(1), LAYERS))
+    for i in range(2):
+        st8, _ = ddp8.train_step(st8, make_batch(i, n=40))
+    d8 = zero_snapshot(ddp8, st8, group.size, tmp_path, "w8", 2)
+
+    small = new_group(list(range(5)), intra_size=1)
+    # the shrunken engine cold-starts on a different (single-bucket) plan;
+    # the manifest's carried plan must win before any resharding happens
+    ddp5 = make_zero_ddp(small, bucket_size=1 << 22)
+    init5 = ddp5.init(init_mlp(jax.random.PRNGKey(4), LAYERS))
+    res5 = ElasticResumeCoordinator(d8).resume(ddp5, init5)
+    assert res5.old_world_size == group.size and res5.new_world_size == 5
+    assert res5.plan_source == "carried"
+    assert ddp5.plan.num_buckets == ddp8.plan.num_buckets
+    d5 = zero_snapshot(ddp5, res5.state, 5, tmp_path, "w5", 2)
+
+    ddp8b = make_zero_ddp(group)
+    init8b = ddp8b.init(init_mlp(jax.random.PRNGKey(5), LAYERS))
+    res8 = ElasticResumeCoordinator(d5).resume(ddp8b, init8b)
+    assert res8.old_world_size == 5 and res8.new_world_size == group.size
+    leaves_equal(res8.state, st8)
+    ddp8.shutdown()
+    ddp5.shutdown()
+    ddp8b.shutdown()

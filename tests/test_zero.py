@@ -285,3 +285,180 @@ def test_fsdp_scanned_layers(group):
         ref_p = optax.apply_updates(ref_p, upd)
     for a, b_ in zip(jax.tree.leaves(ref_p), jax.tree.leaves(p)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-4, atol=1e-5)
+
+
+# -- engine-native zero algorithm (bagua_tpu.sharded) -------------------------
+# The tests above exercise the deprecated contrib wrappers; from here down is
+# the engine-native three-leg exchange: per-bucket reduce-scatter, shard-only
+# optimizer update, all-gather deferred into the next step's forward.
+
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from bagua_tpu.bucket import BucketPlan  # noqa: E402
+from bagua_tpu.algorithms.bytegrad import ByteGradAlgorithm  # noqa: E402
+from bagua_tpu.communication import ALL_AXES, ReduceOp, allreduce_inplace  # noqa: E402
+from bagua_tpu.sharded import ZeroAlgorithm  # noqa: E402
+
+ZLAYERS = [10, 16, 4]  # 244 params; at 1<<9 bucket bytes: 3 f32 buckets,
+# the last one ([layer1.b, layer1.w], 68 elems) padded to 72 — the
+# non-divisible last-shard path rides every test below.
+ZSTEPS = 5
+
+
+def _zopt(name):
+    return optax.adam(1e-2) if name == "adam" else optax.sgd(1e-2, momentum=0.9)
+
+
+def _zbatches(steps=ZSTEPS, seed=1):
+    rng = np.random.RandomState(seed)
+    return [
+        (jnp.asarray(rng.randn(16, ZLAYERS[0]), np.float32),
+         jnp.asarray(rng.randn(16, ZLAYERS[-1]), np.float32))
+        for _ in range(steps)
+    ]
+
+
+def _run_engine(group, algo, opt_name, overlap, steps=ZSTEPS, rebucket_at=None):
+    ddp = DistributedDataParallel(
+        mse_loss, _zopt(opt_name), algo, process_group=group,
+        bucket_size_bytes=1 << 9, overlap=overlap,
+    )
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), ZLAYERS))
+    for i, b in enumerate(_zbatches(steps)):
+        if i == rebucket_at:
+            ddp.rebucket(BucketPlan.from_tree(
+                init_mlp(jax.random.PRNGKey(0), ZLAYERS),
+                bucket_size_bytes=1 << 22, align_elems=group.size,
+            ))
+        state, _ = ddp.train_step(state, b)
+    state = ddp.finalize_pending_updates(state)
+    return ddp, state
+
+
+def _plain_optax_reference(group, opt_name, steps=ZSTEPS):
+    """The unsharded reference trajectory: shard_map fwd/bwd + gradient
+    all-reduce, then a textbook optax update in its own jit.  This is the
+    trajectory the bitwise contract is against — the sharded path pins its
+    optimizer math to standalone-optax codegen (see sharded/updater.py)."""
+    opt = _zopt(opt_name)
+    params = init_mlp(jax.random.PRNGKey(0), ZLAYERS)
+    opt_state = opt.init(params)
+
+    def local_g(p, batch):
+        g = jax.grad(mse_loss)(p, batch)
+        return jax.tree.map(lambda l: allreduce_inplace(l, op=ReduceOp.AVG), g)
+
+    grad_fn = jax.jit(group.shard_map(
+        local_g, in_specs=(P(), P(ALL_AXES)), out_specs=P(),
+    ))
+
+    @jax.jit
+    def upd(p, g, s):
+        u, s2 = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s2
+
+    for b in _zbatches(steps):
+        params, opt_state = upd(params, grad_fn(params, b), opt_state)
+    return params
+
+
+def _params_bitwise(state, expect):
+    got = jax.tree.map(lambda l: np.asarray(l)[0], state.params)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(expect)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("overlap", [False, True], ids=["mono", "overlap"])
+@pytest.mark.parametrize("opt_name", ["adam", "sgdm"])
+def test_zero_engine_bitwise_matches_plain_optax(group, opt_name, overlap):
+    """The tentpole numerics contract: the sharded three-leg trajectory
+    (reduce-scatter → shard-only fused update → deferred all-gather) is
+    bitwise-identical to the plain-optax unsharded reference, monolithic and
+    overlapped, for elementwise optimizers — including the padded
+    non-divisible last bucket."""
+    ddp, state = _run_engine(group, ZeroAlgorithm(), opt_name, overlap)
+    assert ddp.plan.num_buckets > 1  # multi-bucket: shard math is non-trivial
+    # the last bucket is alignment-padded: 68 raw elems -> 72
+    raw = [sum(s.numel for s in spec.slots) for spec in ddp.plan.specs]
+    assert any(spec.numel > r for spec, r in zip(ddp.plan.specs, raw))
+    _params_bitwise(state, _plain_optax_reference(group, opt_name))
+    # ranks stay bitwise-synchronized (the all-gather is identical everywhere)
+    for leaf in jax.tree.leaves(jax.tree.map(np.asarray, state.params)):
+        for r in range(1, N):
+            np.testing.assert_array_equal(leaf[0], leaf[r])
+
+
+def test_zero_engine_vs_allreduce_engine(group):
+    """Engine vs engine: the allreduce path's optimizer math fuses into the
+    step program (per-op rounding), while the sharded path pins
+    standalone-optax codegen (FMA-contracted) — the trajectories agree to
+    1 ulp per step, not bitwise.  The bitwise contract lives in
+    test_zero_engine_bitwise_matches_plain_optax."""
+    _, z = _run_engine(group, ZeroAlgorithm(), "adam", overlap=True)
+    _, r = _run_engine(group, GradientAllReduceAlgorithm(), "adam", overlap=False)
+    for a, b in zip(jax.tree.leaves(z.params), jax.tree.leaves(r.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=1e-7
+        )
+
+
+@pytest.mark.parametrize("overlap", [False, True], ids=["mono", "overlap"])
+def test_zero_bytegrad_bitwise_matches_monolithic(group, overlap):
+    """ByteGrad composition: the compressed reduce-scatter (compress →
+    all-to-all → fused reduce → LOCAL decompress, no gather of the gradient
+    leg) lands on the exact trajectory of the monolithic flat ByteGrad
+    engine — each rank's reduced chunk is bitwise row-me of the reference
+    pipeline's output."""
+    _, ref = _run_engine(group, ByteGradAlgorithm(hierarchical=False), "adam", False)
+    _, got = _run_engine(
+        group, ZeroAlgorithm(compression="bytegrad"), "adam", overlap
+    )
+    for a, b in zip(jax.tree.leaves(got.params), jax.tree.leaves(ref.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_zero_opt_state_bytes_per_chip(group):
+    """The ZeRO-1 memory claim: per-chip Adam moment bytes are ~1/n of the
+    replicated engine's (alignment padding is the only slack)."""
+    zd, zs = _run_engine(group, ZeroAlgorithm(), "adam", False, steps=1)
+    rd, rs = _run_engine(group, GradientAllReduceAlgorithm(), "adam", False, steps=1)
+
+    def per_chip(state):
+        return sum(
+            l.size * l.dtype.itemsize // N for l in jax.tree.leaves(state.opt_state)
+        )
+
+    ratio = per_chip(zs) / per_chip(rs)
+    assert ratio <= 1 / N + 0.05, ratio
+
+
+def test_zero_rebucket_midtraining_bitwise(group):
+    """Satellite: a mid-training ``rebucket`` under the sharded algorithm
+    (overlap on) migrates optimizer shards + pending update shards to the
+    new layout element-value-preservingly — the continued trajectory is
+    bitwise-identical to an uninterrupted run, which is itself bitwise vs
+    the plain-optax reference."""
+    ddp, state = _run_engine(
+        group, ZeroAlgorithm(), "adam", overlap=True, rebucket_at=2
+    )
+    assert ddp.plan.num_buckets == 1  # the swap actually happened
+    assert ddp._sharded_updater.layout.buckets[0].shard_numel * N >= 244
+    _params_bitwise(state, _plain_optax_reference(group, "adam"))
+
+
+def test_fuse_optimizer_contrib_shim_deprecated():
+    """The contrib shim warns but stays bitwise-identical to the engine-native
+    fused optimizer it delegates to."""
+    from bagua_tpu.contrib import fuse_optimizer as shim_fn
+    from bagua_tpu.sharded import fuse_optimizer as native
+
+    params = init_mlp(jax.random.PRNGKey(7), [6, 8, 2])
+    grads = jax.tree.map(lambda p: jnp.ones_like(p) * 0.3, params)
+    with pytest.warns(DeprecationWarning, match="deprecated"):
+        fused = shim_fn(optax.adam(1e-2))
+    ref = native(optax.adam(1e-2))
+    fs, rs_ = fused.init(params), ref.init(params)
+    uf, _ = fused.update(grads, fs, params)
+    ur, _ = ref.update(grads, rs_, params)
+    for a, b in zip(jax.tree.leaves(uf), jax.tree.leaves(ur)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
