@@ -13,3 +13,9 @@ from bagua_tpu.kernels.flash_attention import (  # noqa: F401
     block_attention_pallas,
     merge_blocks,
 )
+from bagua_tpu.kernels.collective_matmul import (  # noqa: F401
+    ag_matmul,
+    get_collective_matmul,
+    matmul_rs,
+    matmul_tile_pallas,
+)

@@ -20,7 +20,9 @@ from bagua_tpu.observability.annotations import (
     EXCHANGE_PREFIX,
     STEP_PREFIX,
     bucket_scope,
+    mp_scope,
     parse_exchange_label,
+    parse_mp_label,
     parse_step_phase,
     step_scope,
 )
@@ -52,8 +54,10 @@ __all__ = [
     "EXCHANGE_PREFIX",
     "STEP_PREFIX",
     "bucket_scope",
+    "mp_scope",
     "step_scope",
     "parse_exchange_label",
+    "parse_mp_label",
     "parse_step_phase",
     # metrics
     "Counter",

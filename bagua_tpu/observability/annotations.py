@@ -11,8 +11,16 @@ collective span to its ``algo``/``bucket``/``phase`` (the transparent
 fine-grained tracking of T3, arXiv:2401.16677; the reference shipped the
 host-side analog as OTel spans in ``bagua-opentelemetry``):
 
-    bagua_ex/algo=gradient_allreduce/bucket=3/phase=overlap   (exchanges)
+    bagua_ex/algo=gradient_allreduce/bucket=3/phase=overlap   (bucket exchanges)
+    bagua_ex/axis=tp/phase=rs_ring                             (model-parallel)
     bagua_step/phase=optimizer                                 (step phases)
+
+The second form labels *model-parallel* exchanges — the tensor-parallel
+``psum``/ring ``ppermute``s and the MoE dispatch/combine all-to-alls — which
+have no bucket index: they are keyed by the logical parallelism axis (``tp``
+or ``ep``) plus a phase naming the exchange (``row_psum``, ``ag_ring``,
+``rs_ring``, ``row_allgather``, ``dispatch``, ``combine``).  The trace
+analyzer aggregates them into per-scope ``measured_overlap_frac`` rows.
 
 ``named_scope`` only decorates metadata — it never changes the traced
 computation, so annotated and unannotated steps are bitwise-identical and
@@ -36,6 +44,9 @@ _EXCHANGE_RE = re.compile(
     EXCHANGE_PREFIX + r"/algo=(?P<algo>[^/]+)/bucket=(?P<bucket>\d+)/phase=(?P<phase>[^/\"]+)"
 )
 _STEP_RE = re.compile(STEP_PREFIX + r"/phase=(?P<phase>[^/\"]+)")
+_MP_RE = re.compile(
+    EXCHANGE_PREFIX + r"/axis=(?P<axis>[^/=]+)/phase=(?P<phase>[^/\"]+)"
+)
 
 
 def bucket_scope(algo: str, bucket_idx, phase: str):
@@ -52,6 +63,29 @@ def step_scope(phase: str):
     (``fwd_bwd``, ``optimizer``, ``algo_start``, ``algo_end``,
     ``finalize``...)."""
     return jax.named_scope(f"{STEP_PREFIX}/phase={phase}")
+
+
+def mp_scope(axis: str, phase: str):
+    """Named scope labeling one model-parallel exchange.
+
+    ``axis`` is the *logical* parallelism scope — ``"tp"`` for tensor-parallel
+    exchanges, ``"ep"`` for expert-parallel ones — not the mesh axis name
+    (which is deployment-specific and may be a tuple).  ``phase`` names the
+    exchange within the scope (``row_psum``, ``ag_ring``, ``rs_ring``,
+    ``row_allgather``, ``col_allgather``, ``dispatch``, ``combine``).  Use as
+    a context manager around the collective, exactly like
+    :func:`bucket_scope`."""
+    return jax.named_scope(f"{EXCHANGE_PREFIX}/axis={axis}/phase={phase}")
+
+
+def parse_mp_label(op_name: str) -> Optional[Dict]:
+    """Extract ``{axis, phase}`` from an HLO ``op_name`` carrying a
+    :func:`mp_scope` frame; None for unlabeled ops (bucket-exchange labels use
+    ``algo=``/``bucket=`` fields and never match)."""
+    m = _MP_RE.search(op_name or "")
+    if not m:
+        return None
+    return {"axis": m.group("axis"), "phase": m.group("phase")}
 
 
 def parse_exchange_label(op_name: str) -> Optional[Dict]:

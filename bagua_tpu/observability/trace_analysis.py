@@ -39,7 +39,7 @@ import os
 import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from bagua_tpu.observability.annotations import parse_exchange_label
+from bagua_tpu.observability.annotations import parse_exchange_label, parse_mp_label
 
 __all__ = [
     "COLLECTIVE_OPS",
@@ -200,8 +200,11 @@ def analyze_trace(
             module named in ``hlo_text``; None + no hlo_text = all modules).
 
     Returns a dict with the aggregate ``measured_overlap_frac``, a
-    ``per_bucket`` list (one row per labeled ``(algo, bucket)``), and an
-    ``unattributed`` bucket for collective spans without a label.
+    ``per_bucket`` list (one row per labeled ``(algo, bucket)``), a
+    ``per_scope`` list (one row per model-parallel scope axis — ``tp``/``ep``
+    exchanges labeled via :func:`~bagua_tpu.observability.annotations.mp_scope`,
+    each row carrying its own ``measured_overlap_frac``), and an
+    ``unattributed`` bucket for collective spans without any label.
     """
     events = load_trace_events(log_dir)
     labels: Dict[str, str] = {}
@@ -222,12 +225,33 @@ def analyze_trace(
     starts = [s for s, _ in merged]
 
     per_key: Dict[Tuple, Dict] = {}
+    per_scope_key: Dict[str, Dict] = {}
     total_us = hidden_us = 0.0
     for e in collectives:
         hid = _covered(e["ts"], e["ts"] + e["dur"], merged, starts)
         total_us += e["dur"]
         hidden_us += hid
-        lab = parse_exchange_label(labels.get(e["hlo_op"], ""))
+        op_name = labels.get(e["hlo_op"], "")
+        lab = parse_exchange_label(op_name)
+        mp = None if lab else parse_mp_label(op_name)
+        if mp is not None:
+            srow = per_scope_key.setdefault(
+                mp["axis"],
+                {
+                    "axis": mp["axis"],
+                    "phases": set(),
+                    "hlo_ops": set(),
+                    "spans": 0,
+                    "collective_us": 0.0,
+                    "hidden_us": 0.0,
+                },
+            )
+            srow["phases"].add(mp["phase"])
+            srow["hlo_ops"].add(e["hlo_op"])
+            srow["spans"] += 1
+            srow["collective_us"] += e["dur"]
+            srow["hidden_us"] += hid
+            continue
         key = (lab["algo"], lab["bucket"]) if lab else None
         row = per_key.setdefault(
             key,
@@ -261,9 +285,27 @@ def analyze_trace(
             if row["collective_us"] else 0.0,
         }
 
+    def finish_scope(row):
+        return {
+            "axis": row["axis"],
+            "phases": sorted(row["phases"]),
+            "hlo_ops": sorted(row["hlo_ops"]),
+            "spans": row["spans"],
+            "collective_ms": round(row["collective_us"] / 1e3, 3),
+            "hidden_ms": round(row["hidden_us"] / 1e3, 3),
+            "measured_overlap_frac": round(
+                row["hidden_us"] / row["collective_us"], 4
+            )
+            if row["collective_us"] else 0.0,
+        }
+
     per_bucket = sorted(
         (finish(r) for k, r in per_key.items() if k is not None),
         key=lambda r: (r["algo"], r["bucket"]),
+    )
+    per_scope = sorted(
+        (finish_scope(r) for r in per_scope_key.values()),
+        key=lambda r: r["axis"],
     )
     unattributed = next(
         (finish(r) for k, r in per_key.items() if k is None), None
@@ -276,5 +318,6 @@ def analyze_trace(
         "hidden_ms": round(hidden_us / 1e3, 3),
         "measured_overlap_frac": round(hidden_us / total_us, 4) if total_us else 0.0,
         "per_bucket": per_bucket,
+        "per_scope": per_scope,
         "unattributed": unattributed,
     }

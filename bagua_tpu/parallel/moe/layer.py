@@ -22,11 +22,18 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from bagua_tpu.observability.annotations import mp_scope
 from bagua_tpu.parallel.moe.routing import Routing, route_top1, route_top2
 
 
-def _bound_axes(axis_name) -> Tuple[str, ...]:
-    """The subset of ``axis_name`` actually bound by an enclosing shard_map."""
+def _bound_axes(axis_name, *, expect_any: bool = False) -> Tuple[str, ...]:
+    """The subset of ``axis_name`` actually bound by an enclosing shard_map.
+
+    ``expect_any=True`` distinguishes "axes legitimately unbound" (init time,
+    single-rank) from a typo'd axis name: when *none* of the declared names
+    resolve it raises instead of silently degrading to a single-rank layout —
+    a misspelled ``ep_axis`` would otherwise skip the all-to-alls and train
+    each rank on its local experts only."""
     if axis_name is None:
         return ()
     axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
@@ -37,6 +44,13 @@ def _bound_axes(axis_name) -> Tuple[str, ...]:
             bound.append(a)
         except NameError:
             pass
+    if expect_any and axes and not bound:
+        raise ValueError(
+            f"none of the declared expert-parallel axes {axes} are bound by an "
+            "enclosing shard_map — check the ep_axis spelling against the mesh "
+            "axis names (a typo here would silently degrade to single-rank "
+            "expert compute)"
+        )
     return tuple(bound)
 
 
@@ -104,7 +118,17 @@ class Experts(nn.Module):
 
 
 class ExpertParallelFFN(nn.Module):
-    """Route tokens to experts sharded over the ``ep_axis`` mesh axes."""
+    """Route tokens to experts sharded over the ``ep_axis`` mesh axes.
+
+    ``a2a_chunks > 1`` enables the fused computation-collective schedule: the
+    capacity axis is split into chunks and each chunk's dispatch all-to-all →
+    expert FFN → combine all-to-all is issued independently (the loop is
+    unrolled, so XLA's scheduler overlaps chunk *j+1*'s in-flight all-to-all
+    with chunk *j*'s expert GEMMs — the same wire-under-compute decomposition
+    :mod:`bagua_tpu.kernels.collective_matmul` applies to tensor parallelism).
+    The expert FFN is position-wise, so chunking the token axis is exact; the
+    requested chunk count is clamped to the nearest divisor of the capacity.
+    """
 
     num_experts: int
     hidden_dim: int
@@ -115,6 +139,13 @@ class ExpertParallelFFN(nn.Module):
     noisy_gate_policy: Optional[str] = None
     ep_size: int = 1
     ep_axis: Union[str, Tuple[str, ...], None] = ("inter", "intra")
+    a2a_chunks: int = 1
+
+    def _resolve_chunks(self, capacity: int) -> int:
+        c = max(1, min(int(self.a2a_chunks), capacity))
+        while capacity % c:
+            c -= 1
+        return c
 
     @nn.compact
     def __call__(self, x, train: bool = True, used_token=None, rng=None):
@@ -129,7 +160,11 @@ class ExpertParallelFFN(nn.Module):
                 f"num_experts ({self.num_experts}) must divide evenly by "
                 f"ep_size ({self.ep_size})"
             )
-        ep_axes = _bound_axes(self.ep_axis) if self.ep_size > 1 else ()
+        ep_axes = (
+            _bound_axes(self.ep_axis, expect_any=not self.is_initializing())
+            if self.ep_size > 1
+            else ()
+        )
         if self.ep_size > 1 and not self.is_initializing():
             bound = 1
             for a in ep_axes:
@@ -151,33 +186,59 @@ class ExpertParallelFFN(nn.Module):
             name="gate",
         )(tokens, train=train, used_token=used_token, rng=rng)
 
+        experts = Experts(
+            hidden_dim=self.hidden_dim,
+            num_local_experts=local_experts,
+            name="experts",
+        )
+
         # (S,E,C) x (S,M) -> (E,C,M), grouped by owning rank
         outbound = jnp.einsum(
             "sec,sm->ecm", routing.dispatch_mask.astype(tokens.dtype), tokens
         ).reshape(self.ep_size, local_experts, -1, model_dim)
-        if ep_axes:
-            # chunk g of every rank's tokens travels to the rank owning
-            # expert group g (reference dist.all_to_all_single,
-            # sharded_moe.py:77-91)
-            outbound = jax.lax.all_to_all(
-                outbound, ep_axes, split_axis=0, concat_axis=0, tiled=True
-            ).reshape(self.ep_size, local_experts, -1, model_dim)
-        expert_in = jnp.moveaxis(outbound, 0, 1).reshape(local_experts, -1, model_dim)
+        capacity = outbound.shape[2]
 
-        expert_out = Experts(
-            hidden_dim=self.hidden_dim,
-            num_local_experts=local_experts,
-            name="experts",
-        )(expert_in)
-
-        inbound = jnp.moveaxis(
-            expert_out.reshape(local_experts, self.ep_size, -1, model_dim), 0, 1
-        )
-        if ep_axes:
-            inbound = jax.lax.all_to_all(
-                inbound, ep_axes, split_axis=0, concat_axis=0, tiled=True
+        def exchange(ob):
+            # one dispatch → expert compute → combine round over a slice of
+            # the capacity axis; ob: (ep_size, local_experts, c, model_dim)
+            c = ob.shape[2]
+            if ep_axes:
+                # chunk g of every rank's tokens travels to the rank owning
+                # expert group g (reference dist.all_to_all_single,
+                # sharded_moe.py:77-91)
+                with mp_scope("ep", "dispatch"):
+                    ob = jax.lax.all_to_all(
+                        ob, ep_axes, split_axis=0, concat_axis=0, tiled=True
+                    )
+                ob = ob.reshape(self.ep_size, local_experts, c, model_dim)
+            expert_in = jnp.moveaxis(ob, 0, 1).reshape(local_experts, -1, model_dim)
+            expert_out = experts(expert_in)
+            ib = jnp.moveaxis(
+                expert_out.reshape(local_experts, self.ep_size, c, model_dim), 0, 1
             )
-        inbound = inbound.reshape(self.num_experts, -1, model_dim)
+            if ep_axes:
+                with mp_scope("ep", "combine"):
+                    ib = jax.lax.all_to_all(
+                        ib, ep_axes, split_axis=0, concat_axis=0, tiled=True
+                    )
+            return ib.reshape(self.num_experts, c, model_dim)
+
+        chunks = self._resolve_chunks(capacity) if (ep_axes and capacity) else 1
+        if chunks > 1:
+            # unrolled over capacity chunks: chunk j+1's all-to-all becomes
+            # issuable while chunk j's expert GEMMs are still executing (the
+            # expert FFN is position-wise, so the chunked result is exact; the
+            # single `experts` instance keeps the parameters shared)
+            cblk = capacity // chunks
+            inbound = jnp.concatenate(
+                [
+                    exchange(outbound[:, :, i * cblk:(i + 1) * cblk])
+                    for i in range(chunks)
+                ],
+                axis=1,
+            )
+        else:
+            inbound = exchange(outbound)
 
         out = jnp.einsum(
             "sec,ecm->sm", routing.combine_weights.astype(tokens.dtype), inbound
@@ -205,6 +266,7 @@ class MoE(nn.Module):
     noisy_gate_policy: Optional[str] = None
     ep_size: int = 1
     ep_axis: Union[str, Tuple[str, ...], None] = ("inter", "intra")
+    a2a_chunks: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = True, used_token=None, rng=None):
@@ -221,5 +283,6 @@ class MoE(nn.Module):
             noisy_gate_policy=self.noisy_gate_policy,
             ep_size=self.ep_size,
             ep_axis=self.ep_axis,
+            a2a_chunks=self.a2a_chunks,
             name="moe_layer",
         )(x, train=train, used_token=used_token, rng=rng)

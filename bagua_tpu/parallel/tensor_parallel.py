@@ -13,6 +13,21 @@ A Column→(nonlinearity)→Row pair therefore costs exactly one allreduce
 forward (and one for the gradient of the input, which ``psum``'s transpose
 rule inserts automatically under autodiff).
 
+The opt-in ``fused`` knob replaces those exposed collectives with the
+computation-collective rings of :mod:`bagua_tpu.kernels.collective_matmul`:
+the Row forward becomes :func:`~bagua_tpu.kernels.collective_matmul.matmul_rs`
+(ring-accumulated partial products — **zero** standalone ``psum``; a tiled
+``all_gather`` restores the replicated output unless ``scatter_output``), and
+a row-sharded Column input (``gather_input``, the sequence-parallel layout)
+becomes :func:`~bagua_tpu.kernels.collective_matmul.ag_matmul`.  ``"auto"``
+enables the ring wherever its divisibility constraint holds and silently
+falls back to the ``psum`` path otherwise; ``True`` makes an impossible ring
+an error.  Both values resolve the tile GEMM through the evidence-gated
+``get_collective_matmul`` dispatch, so the Pallas kernel only engages on
+validated hardware — the ring (and the overlap it buys XLA's scheduler) is
+the same either way, and all collectives carry
+``bagua_ex/axis=tp/phase=...`` labels for the trace analyzer.
+
 ``tp_size`` is static (it fixes parameter shapes so ``init`` can run outside
 ``shard_map``); the bound axis is checked at apply time.
 """
@@ -22,6 +37,9 @@ from typing import Any, Optional, Tuple, Union
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+
+from bagua_tpu.kernels.collective_matmul import get_collective_matmul
+from bagua_tpu.observability.annotations import mp_scope
 
 
 def _check_axis(tp_size: int, axis_name, initializing: bool):
@@ -35,15 +53,43 @@ def _check_axis(tp_size: int, axis_name, initializing: bool):
         raise ValueError(f"tp_size={tp_size} but bound axes {axes} have size {n}")
 
 
+def _single_axis(axis_name) -> str:
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    if len(axes) != 1:
+        raise ValueError(
+            f"fused collective matmul needs a single mesh axis, got {axes}"
+        )
+    return axes[0]
+
+
+def _resolve_fused(fused, tp_size: int, initializing: bool) -> bool:
+    """Tri-state ``fused`` knob: ``False`` (default) keeps the classic
+    collectives, ``True``/``"auto"`` enable the ring decomposition (``"auto"``
+    additionally falls back per call when a ring constraint doesn't hold;
+    ``True`` raises instead).  Inactive at init and at ``tp_size == 1``."""
+    if fused not in (False, True, "auto"):
+        raise ValueError(f"fused must be False, True or 'auto', got {fused!r}")
+    if tp_size == 1 or initializing:
+        return False
+    return bool(fused)
+
+
 class ColumnParallelDense(nn.Module):
     """y_local = x @ W[:, rank-slice] (+ b slice).  Output dim is
-    ``features // tp_size`` per rank."""
+    ``features // tp_size`` per rank.
+
+    ``gather_input=True`` consumes a *row-sharded* ``x`` (the
+    sequence-parallel layout: each rank holds its block of the tokens) and
+    gathers it on the fly — via :func:`ag_matmul`'s compute-overlapped ring
+    when ``fused``, or a plain ``all_gather`` + dot otherwise."""
 
     features: int
     tp_size: int = 1
     axis_name: Union[str, Tuple[str, ...]] = "tp"
     use_bias: bool = True
     dtype: Any = jnp.float32
+    fused: Union[bool, str] = False
+    gather_input: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -56,7 +102,19 @@ class ColumnParallelDense(nn.Module):
         w = self.param(
             "kernel", nn.initializers.lecun_normal(), (x.shape[-1], local), self.dtype
         )
-        y = x.astype(self.dtype) @ w
+        use_fused = _resolve_fused(self.fused, self.tp_size, self.is_initializing())
+        if self.gather_input and self.tp_size > 1 and not self.is_initializing():
+            axis = _single_axis(self.axis_name)
+            x2 = x.astype(self.dtype).reshape(-1, x.shape[-1])
+            if use_fused:
+                ag_mm, _ = get_collective_matmul()
+                y = ag_mm(x2, w, axis, axis_tag="tp")
+            else:
+                with mp_scope("tp", "col_allgather"):
+                    xg = jax.lax.all_gather(x2, axis, axis=0, tiled=True)
+                y = xg @ w
+        else:
+            y = x.astype(self.dtype) @ w
         if self.use_bias:
             y = y + self.param("bias", nn.initializers.zeros, (local,), self.dtype)
         return y
@@ -64,13 +122,24 @@ class ColumnParallelDense(nn.Module):
 
 class RowParallelDense(nn.Module):
     """y = psum_tp(x_local @ W[rank-slice, :]) (+ b).  Input dim is the
-    sliced hidden; output is replicated across the ``tp`` axis."""
+    sliced hidden; output is replicated across the ``tp`` axis.
+
+    When ``fused``, the GEMM+psum is replaced by the :func:`matmul_rs` ring:
+    each ring step's partial product accumulates into the travelling shard,
+    so **no standalone psum/all-reduce is emitted** and all but one transfer
+    hide under tile compute.  The replicated-output contract is restored by a
+    tiled ``all_gather`` of the row blocks; ``scatter_output=True`` skips it
+    and returns this rank's ``(tokens // tp_size, features)`` row shard (the
+    sequence-parallel layout — feed it to the next layer's
+    ``gather_input``)."""
 
     features: int
     tp_size: int = 1
     axis_name: Union[str, Tuple[str, ...]] = "tp"
     use_bias: bool = True
     dtype: Any = jnp.float32
+    fused: Union[bool, str] = False
+    scatter_output: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -78,16 +147,56 @@ class RowParallelDense(nn.Module):
         w = self.param(
             "kernel", nn.initializers.lecun_normal(), (x.shape[-1], self.features), self.dtype
         )
-        y = x.astype(self.dtype) @ w
-        if self.tp_size > 1 and not self.is_initializing():
-            y = jax.lax.psum(y, self.axis_name)
+        use_fused = _resolve_fused(self.fused, self.tp_size, self.is_initializing())
+        lead = x.shape[:-1]
+        tokens = 1
+        for d in lead:
+            tokens *= d
+        if use_fused and tokens % self.tp_size != 0:
+            if self.fused == "auto":
+                use_fused = False
+            else:
+                raise ValueError(
+                    f"fused RowParallelDense needs the token count ({tokens}) "
+                    f"to divide by tp_size ({self.tp_size}); use fused='auto' "
+                    "to fall back to the psum path"
+                )
+        if use_fused:
+            axis = _single_axis(self.axis_name)
+            x2 = x.astype(self.dtype).reshape(tokens, x.shape[-1])
+            _, mm_rs = get_collective_matmul()
+            y = mm_rs(x2, w, axis, axis_tag="tp")  # this rank's row block
+            if not self.scatter_output:
+                with mp_scope("tp", "row_allgather"):
+                    y = jax.lax.all_gather(y, axis, axis=0, tiled=True)
+                y = y.reshape(lead + (self.features,))
+        else:
+            y = x.astype(self.dtype) @ w
+            if self.tp_size > 1 and not self.is_initializing():
+                with mp_scope("tp", "row_psum"):
+                    y = jax.lax.psum(y, self.axis_name)
+            if self.scatter_output and self.tp_size > 1 and not self.is_initializing():
+                if tokens % self.tp_size != 0:
+                    raise ValueError(
+                        f"scatter_output needs the token count ({tokens}) to "
+                        f"divide by tp_size ({self.tp_size})"
+                    )
+                axis = _single_axis(self.axis_name)
+                y = y.reshape(tokens, self.features)
+                blk = tokens // self.tp_size
+                y = jax.lax.dynamic_slice_in_dim(
+                    y, jax.lax.axis_index(axis) * blk, blk, axis=0
+                )
         if self.use_bias:
             y = y + self.param("bias", nn.initializers.zeros, (self.features,), self.dtype)
         return y
 
 
 class ParallelMLP(nn.Module):
-    """Column→activation→Row FFN: one forward allreduce total."""
+    """Column→activation→Row FFN: one forward allreduce total — or, with
+    ``fused``, zero: the Row projection runs the ``matmul_rs`` ring (partial
+    products accumulated across ``ppermute`` steps) and only the concluding
+    row-block ``all_gather`` touches the wire exposed."""
 
     hidden_features: int
     out_features: int
@@ -95,6 +204,7 @@ class ParallelMLP(nn.Module):
     axis_name: Union[str, Tuple[str, ...]] = "tp"
     activation: str = "gelu"
     dtype: Any = jnp.float32
+    fused: Union[bool, str] = False
 
     @nn.compact
     def __call__(self, x):
@@ -103,5 +213,6 @@ class ParallelMLP(nn.Module):
         )(x)
         h = getattr(jax.nn, self.activation)(h)
         return RowParallelDense(
-            self.out_features, self.tp_size, self.axis_name, dtype=self.dtype
+            self.out_features, self.tp_size, self.axis_name, dtype=self.dtype,
+            fused=self.fused,
         )(h)

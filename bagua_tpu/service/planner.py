@@ -68,9 +68,11 @@ class WireSample:
     ``leg`` tags the wire path: ``"flat"`` (single-level exchange),
     ``"intra"`` (hierarchical intra-axis reduce), ``"inter"``
     (hierarchical cross-axis exchange), ``"rs"`` (sharded reduce-scatter,
-    the ``zero`` algorithm's in-backward leg) or ``"ag"`` (the deferred
-    parameter all-gather riding the next step's forward).  ``hidden_frac``
-    is the span's measured overlap fraction from the device trace, if
+    the ``zero`` algorithm's in-backward leg), ``"ag"`` (the deferred
+    parameter all-gather riding the next step's forward) or ``"pp"`` (one
+    neighbor ``ppermute`` hop of a fused collective-matmul ring — see
+    :mod:`bagua_tpu.kernels.collective_matmul`).  ``hidden_frac`` is the
+    span's measured overlap fraction from the device trace, if
     attributed."""
 
     nbytes: float
@@ -103,6 +105,11 @@ DEFAULT_INTER = AlphaBeta(alpha=200e-6, beta=25e9)
 # effective bandwidth prior sits above the flat allreduce prior.
 DEFAULT_RS = AlphaBeta(alpha=100e-6, beta=80e9)
 DEFAULT_AG = AlphaBeta(alpha=100e-6, beta=80e9)
+# One ring hop of a fused collective matmul: a single neighbor-to-neighbor
+# ppermute over ICI — no reduction tree, no cross-rank synchronization beyond
+# the neighbor, so the launch latency prior sits well below a full collective
+# and the bandwidth prior at the per-link ICI rate.
+DEFAULT_PP = AlphaBeta(alpha=20e-6, beta=90e9)
 
 
 def fit_alpha_beta(
@@ -156,6 +163,7 @@ class CostModel:
         intra_size: int = 1,
         rs: AlphaBeta = DEFAULT_RS,
         ag: AlphaBeta = DEFAULT_AG,
+        pp: AlphaBeta = DEFAULT_PP,
     ):
         self.flat = flat
         self.intra = intra
@@ -163,6 +171,7 @@ class CostModel:
         self.intra_size = max(1, int(intra_size))
         self.rs = rs
         self.ag = ag
+        self.pp = pp
 
     @classmethod
     def from_samples(
@@ -178,6 +187,7 @@ class CostModel:
             intra_size=intra_size,
             rs=fit_alpha_beta(by_leg.get("rs", []), DEFAULT_RS),
             ag=fit_alpha_beta(by_leg.get("ag", []), DEFAULT_AG),
+            pp=fit_alpha_beta(by_leg.get("pp", []), DEFAULT_PP),
         )
 
     def bucket_wire_time(
@@ -199,6 +209,20 @@ class CostModel:
         bucket's full payload (the sharded pattern's second leg)."""
         return self.ag.predict(nbytes)
 
+    def ring_matmul_wire_time(self, nbytes: float, ring_size: int) -> float:
+        """Total wire time of one fused collective-matmul ring
+        (:func:`~bagua_tpu.kernels.collective_matmul.ag_matmul` /
+        :func:`~bagua_tpu.kernels.collective_matmul.matmul_rs` over a
+        ``ring_size`` axis): ``ring_size - 1`` neighbor ``ppermute`` hops,
+        each carrying the per-rank shard (``nbytes / ring_size``).  This is
+        the quantity the ring can hide under tile compute — compare it
+        against ``flat.predict(nbytes)`` (the exposed psum it replaces) to
+        decide whether fusing pays at a given payload size."""
+        n = int(ring_size)
+        if n <= 1:
+            return 0.0
+        return (n - 1) * self.pp.predict(nbytes / n)
+
     def describe(self) -> Dict:
         return {
             leg: {
@@ -212,6 +236,7 @@ class CostModel:
                 ("inter", self.inter),
                 ("rs", self.rs),
                 ("ag", self.ag),
+                ("pp", self.pp),
             )
         }
 

@@ -44,6 +44,68 @@ SEQ = 1024
 PER_CHIP_BATCH = 4
 
 
+def fused_tp_row(cfg, deadline: float):
+    """Fused-collective-matmul row: the Llama FFN shape as a tensor-parallel
+    Column->Row pair over every local device, ring-fused (matmul_rs, zero
+    standalone psum) vs the classic psum path.  Emitted as its own JSON line
+    before the authoritative tokens/s line; skipped on a single device (no
+    ring) or when the FFN width doesn't divide the device count."""
+    import json as _json
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from bagua_tpu.parallel.tensor_parallel import ParallelMLP
+
+    devs = jax.devices()
+    tp = len(devs)
+    tokens = 1024
+    if (tp < 2 or cfg.intermediate_size % tp or tokens % tp
+            or time.perf_counter() > deadline - 60.0):
+        HARNESS.note("fused-tp row skipped (single device, indivisible width, "
+                     "or out of budget)")
+        return
+    mesh = Mesh(np.array(devs), ("tp",))
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(tokens, cfg.hidden_size).astype(np.float32))
+
+    def step_ms(fused):
+        mlp = ParallelMLP(
+            hidden_features=cfg.intermediate_size, out_features=cfg.hidden_size,
+            tp_size=tp, axis_name="tp", fused=fused,
+        )
+        per_rank = [mlp.init(jax.random.PRNGKey(r), x)["params"] for r in range(tp)]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_rank)
+        fn = jax.jit(
+            jax.shard_map(
+                lambda p, xx: mlp.apply(
+                    {"params": jax.tree.map(lambda q: q[0], p)}, xx
+                ),
+                mesh=mesh, in_specs=(P("tp"), P()), out_specs=P(),
+                check_vma=False,
+            )
+        )
+        fn(stacked, x).block_until_ready()  # compile outside the timed loop
+        iters = 10
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(stacked, x)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    psum, ring = step_ms(False), step_ms("auto")
+    print(_json.dumps({
+        "metric": "llama_fused_tp_ffn_ms",
+        "value": round(ring, 3),
+        "unit": "ms/step (tp-sharded FFN forward)",
+        "psum_path_ms": round(psum, 3),
+        "speedup": round(psum / ring, 3) if ring else None,
+        "tp_size": tp,
+        "ffn": f"{cfg.hidden_size}->{cfg.intermediate_size}->{cfg.hidden_size}",
+        "provisional": True,  # never the authoritative last line
+    }), flush=True)
+
+
 def main():
     import bagua_tpu
     from bagua_tpu.algorithms import build_algorithm
@@ -162,6 +224,7 @@ def main():
     HARNESS.note(f"{n_iters} steps in {elapsed:.2f}s; "
                  f"host overhead {ddp.host_overhead_snapshot()}")
     ddp.shutdown()
+    fused_tp_row(cfg, deadline)
     _emit(bs * seq * n_iters / elapsed / n)
 
 

@@ -35,6 +35,64 @@ HIDDEN, LAYERS, SEQ, EXPERTS, TOP_K = 512, 8, 128, 8, 2
 VOCAB = 8192
 
 
+def fused_a2a_row(hidden: int, deadline: float):
+    """Fused-collective-matmul row: step time of the ep-sharded MoE block
+    with the chunked (overlapped) all-to-all schedule vs the monolithic one,
+    over every local device.  Emitted as its own JSON line BEFORE the
+    authoritative throughput line (last-line protocol); a single-device
+    session skips it — there is no all-to-all to overlap."""
+    import json as _json
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from bagua_tpu.parallel.moe import MoE
+
+    devs = jax.devices()
+    n_dev = len(devs)
+    if n_dev < 2 or time.perf_counter() > deadline - 60.0:
+        HARNESS.note("fused-a2a row skipped (single device or out of budget)")
+        return
+    mesh = Mesh(np.array(devs), ("ep",))
+    num_experts = n_dev * max(1, EXPERTS // n_dev)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(512 * n_dev, hidden).astype(np.float32))
+
+    def step_ms(chunks):
+        moe = MoE(
+            hidden_size=hidden, num_experts=num_experts, k=TOP_K,
+            capacity_factor=1.25, ep_size=n_dev, ep_axis="ep",
+            a2a_chunks=chunks,
+        )
+        params = moe.init(jax.random.PRNGKey(0), x[: 512])["params"]
+        fn = jax.jit(
+            jax.shard_map(
+                lambda xx: moe.apply({"params": params}, xx)[0],
+                mesh=mesh, in_specs=P("ep", None), out_specs=P("ep", None),
+                check_vma=False,
+            )
+        )
+        fn(x).block_until_ready()  # compile outside the timed loop
+        iters = 10
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(x)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    mono, chunked = step_ms(1), step_ms(4)
+    print(_json.dumps({
+        "metric": "moe_fused_a2a_step_ms",
+        "value": round(chunked, 3),
+        "unit": "ms/step (ep-sharded MoE forward)",
+        "a2a_chunks": 4,
+        "unchunked_ms": round(mono, 3),
+        "speedup": round(mono / chunked, 3) if chunked else None,
+        "ep_size": n_dev,
+        "provisional": True,  # never the authoritative last line
+    }), flush=True)
+
+
 def main():
     import bagua_tpu
     from bagua_tpu.algorithms import build_algorithm
@@ -127,6 +185,7 @@ def main():
         }
         if smoke:
             extra["config"] = "SMOKE " + extra["config"]
+        fused_a2a_row(hidden, deadline)
         HARNESS.emit(value, extra=extra)
     finally:
         ddp.shutdown()

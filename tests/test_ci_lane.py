@@ -183,3 +183,45 @@ def test_perf_audit_quick_zero_sharded_census(tmp_path):
         # the memory claim: sharded Adam moments at ~1/n per chip
         ratio = row["opt_state_bytes_per_chip"] / base["opt_state_bytes_per_chip"]
         assert ratio <= 0.2, ratio
+
+
+def test_perf_audit_quick_tp_collective_matmul(tmp_path):
+    """Tier-1 lane for the collective-matmul gates: fused-vs-oracle bitwise
+    parity (interpret mode), the zero-all-reduce census of the fused
+    RowParallel forward, and the per-scope measured_overlap_frac rows."""
+    out = tmp_path / "audit_tp"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "ci", "perf_audit.py"),
+            "--quick", "--model=tp", "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (
+        f"perf_audit --quick --model=tp failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    assert "tp collective-matmul census assertion passed" in proc.stderr
+    assert "tp fused-vs-oracle parity passed" in proc.stderr
+    assert "measured_overlap_frac reported" in proc.stderr
+
+    with open(str(out) + ".json") as f:
+        audit = json.load(f)
+    # fused RowParallel forward: ZERO standalone psum/all-reduce
+    assert "all-reduce" not in audit["census"]["fused_fwd"]
+    assert "all-reduce" not in audit["census"]["fused_fwd_bwd"]
+    assert audit["census"]["fused_fwd"]["collective-permute"]["count"] == 7
+    # unfused Megatron pair: exactly one fwd + one bwd all-reduce
+    assert audit["census"]["unfused_fwd"]["all-reduce"]["count"] == 1
+    assert audit["census"]["unfused_fwd_bwd"]["all-reduce"]["count"] == 2
+    # bitwise parity held for every swept config (incl. edge tiles)
+    assert audit["collective_matmul_parity"], "empty parity sweep"
+    for row in audit["collective_matmul_parity"]:
+        assert row["ag_bitwise"] and row["rs_bitwise"], row
+    # per-scope overlap attribution for both parallelism scopes
+    scopes = audit["trace"]["per_scope"]
+    for axis in ("tp", "ep"):
+        assert axis in scopes, scopes
+        assert 0.0 <= scopes[axis]["measured_overlap_frac"] <= 1.0

@@ -313,3 +313,85 @@ def test_split_moe_params():
     assert expert and non_expert
     assert all("experts" in k for k in expert)
     assert all("experts" not in k for k in non_expert)
+
+
+# ---------------------------------------------------------------------------
+# Chunked (overlapped) all-to-all schedule
+# ---------------------------------------------------------------------------
+
+
+def _ep_mesh(n=N):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:n]), ("ep",))
+
+
+def _run_moe_sharded(moe, params, x):
+    fn = jax.jit(
+        jax.shard_map(
+            lambda xx: moe.apply({"params": params}, xx)[0],
+            mesh=_ep_mesh(),
+            in_specs=P("ep", None),
+            out_specs=P("ep", None),
+            check_vma=False,
+        )
+    )
+    return np.asarray(fn(x))
+
+
+@pytest.mark.parametrize("chunks", [2, 4])
+def test_a2a_chunks_bitwise_matches_unchunked(chunks):
+    """The chunked dispatch->expert->combine schedule is EXACT: the expert
+    FFN is position-wise, so splitting the capacity axis changes the overlap
+    structure but not one bit of the result."""
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(N * 16, MODEL_DIM).astype(np.float32))
+
+    def build(c):
+        moe = MoE(
+            hidden_size=MODEL_DIM * 2, num_experts=NUM_EXPERTS, ep_size=N,
+            ep_axis="ep", capacity_factor=2.0, a2a_chunks=c,
+        )
+        params = moe.init(jax.random.PRNGKey(0), x[:16])["params"]
+        return moe, params
+
+    moe1, params1 = build(1)
+    moec, paramsc = build(chunks)
+    # shared Experts instance => identical parameter structure either way
+    assert jax.tree.map(jnp.shape, params1) == jax.tree.map(jnp.shape, paramsc)
+    ref = _run_moe_sharded(moe1, params1, x)
+    got = _run_moe_sharded(moec, params1, x)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_a2a_chunks_clamps_to_capacity_divisor():
+    from bagua_tpu.parallel.moe.layer import ExpertParallelFFN
+
+    ffn = ExpertParallelFFN(num_experts=8, hidden_dim=16, a2a_chunks=5)
+    assert ffn._resolve_chunks(8) == 4  # nearest divisor <= requested
+    assert ffn._resolve_chunks(7) == 1
+    big = ExpertParallelFFN(num_experts=8, hidden_dim=16, a2a_chunks=64)
+    assert big._resolve_chunks(8) == 8  # never exceeds the capacity
+
+
+def test_typod_ep_axis_raises_clear_error():
+    """A misspelled ep_axis must fail loudly, not silently degrade to
+    single-rank expert compute (the all-to-alls would just vanish)."""
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(N * 8, MODEL_DIM).astype(np.float32))
+    moe = MoE(
+        hidden_size=MODEL_DIM * 2, num_experts=NUM_EXPERTS, ep_size=N,
+        ep_axis="exprt",  # typo: the mesh binds "ep"
+        capacity_factor=2.0,
+    )
+    params = moe.init(jax.random.PRNGKey(0), x[:8])["params"]
+    with pytest.raises(ValueError, match="none of the declared expert-parallel axes"):
+        jax.jit(
+            jax.shard_map(
+                lambda xx: moe.apply({"params": params}, xx)[0],
+                mesh=_ep_mesh(),
+                in_specs=P("ep", None),
+                out_specs=P("ep", None),
+                check_vma=False,
+            )
+        )(x)

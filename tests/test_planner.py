@@ -16,6 +16,8 @@ import itertools
 import json
 import os
 
+import numpy as np
+
 import pytest
 
 from bagua_tpu.defs import TensorDeclaration, dtype_itemsize
@@ -520,3 +522,40 @@ def test_planner_sharded_wire_pattern_prices_rs_leg():
     res = sh.plan()
     assert res.n_buckets >= 1
     assert res.total_wire_s < ar.plan().total_wire_s
+
+
+# -- ppermute (collective-matmul ring) leg ----------------------------------
+
+
+def test_cost_model_fits_pp_leg_from_samples():
+    from bagua_tpu.service.planner import DEFAULT_PP
+
+    pp = AlphaBeta(alpha=15e-6, beta=120e9)
+    samples = [
+        WireSample(nbytes=n, seconds=pp.predict(n), leg="pp")
+        for n in (1 << 20, 1 << 22, 1 << 24)
+    ]
+    cm = CostModel.from_samples(samples)
+    np.testing.assert_allclose(cm.pp.alpha, pp.alpha, rtol=1e-6)
+    np.testing.assert_allclose(cm.pp.beta, pp.beta, rtol=1e-6)
+    # other legs untouched by pp samples; no pp samples -> the prior
+    assert CostModel.from_samples([]).pp is DEFAULT_PP
+    assert cm.flat is not None
+
+
+def test_ring_matmul_wire_time():
+    pp = AlphaBeta(alpha=10e-6, beta=100e9)
+    cm = CostModel(pp=pp)
+    nbytes, ring = 64 << 20, 8
+    # ring_size - 1 neighbor hops, each carrying the per-rank shard
+    expect = (ring - 1) * pp.predict(nbytes / ring)
+    np.testing.assert_allclose(cm.ring_matmul_wire_time(nbytes, ring), expect)
+    # degenerate rings cost nothing
+    assert cm.ring_matmul_wire_time(nbytes, 1) == 0.0
+    assert cm.ring_matmul_wire_time(nbytes, 0) == 0.0
+
+
+def test_describe_includes_pp_row():
+    rows = CostModel().describe()
+    assert "pp" in rows
+    assert set(rows["pp"]) == {"alpha_us", "beta_gbps", "n_samples"}

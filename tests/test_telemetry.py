@@ -528,3 +528,97 @@ def test_rebucket_emits_telemetry_from_engine(group, tmp_path):
     tel.close()
     events = [json.loads(l) for l in open(path) if l.strip()]
     assert any(e["event"] == "rebucket" and e["plan_version"] == 1 for e in events)
+
+
+# -- model-parallel scope grammar + per-scope trace attribution ---------------
+
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+
+def test_parse_mp_label_roundtrip():
+    from bagua_tpu.observability import mp_scope, parse_mp_label
+
+    lab = parse_mp_label("jit(f)/bagua_ex/axis=tp/phase=rs_ring/collective-permute")
+    assert lab == {"axis": "tp", "phase": "rs_ring"}
+    # the two grammars never cross-match: algo=/bucket= vs axis=
+    assert parse_mp_label(
+        "jit(step)/bagua_ex/algo=bytegrad/bucket=12/phase=mono/convert"
+    ) is None
+    assert parse_exchange_label("jit(f)/bagua_ex/axis=tp/phase=rs_ring/x") is None
+    assert parse_mp_label("") is None and parse_mp_label(None) is None
+    # the scope emits what the parser reads
+    with mp_scope("ep", "dispatch"):
+        pass
+
+
+def test_fused_tp_hlo_carries_mp_labels():
+    """The fused RowParallel ring's collectives carry axis=tp labels in the
+    compiled HLO (rs_ring on the ppermutes, row_allgather on the gather)."""
+    from jax.sharding import Mesh
+    from bagua_tpu.observability import parse_mp_label
+    from bagua_tpu.parallel.tensor_parallel import ParallelMLP
+
+    tp = 8
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(8, 12).astype(np.float32))
+    mlp = ParallelMLP(hidden_features=16, out_features=8, tp_size=tp, fused="auto")
+    per_rank = [mlp.init(jax.random.PRNGKey(r), x)["params"] for r in range(tp)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_rank)
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+    hlo = (
+        jax.jit(
+            jax.shard_map(
+                lambda p, xx: mlp.apply(
+                    {"params": jax.tree.map(lambda q: q[0], p)}, xx
+                ),
+                mesh=mesh, in_specs=(P("tp"), P()), out_specs=P(),
+                check_vma=False,
+            )
+        )
+        .lower(stacked, x)
+        .compile()
+        .as_text()
+    )
+    mp = [lab for lab in map(parse_mp_label, op_name_labels(hlo)) if lab]
+    assert mp, "no model-parallel labels in compiled fused HLO"
+    assert {m["axis"] for m in mp} == {"tp"}
+    assert {"rs_ring", "row_allgather"} <= {m["phase"] for m in mp}
+
+
+def test_trace_analyzer_per_scope_rows(tmp_path):
+    """analyze_trace attributes mp-labeled collectives into per_scope rows
+    with their own measured_overlap_frac (the tp/ep scope report)."""
+    from jax.sharding import Mesh
+    from bagua_tpu.parallel.tensor_parallel import ParallelMLP
+
+    tp = 8
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(16, 16).astype(np.float32))
+    mlp = ParallelMLP(hidden_features=32, out_features=16, tp_size=tp, fused="auto")
+    per_rank = [mlp.init(jax.random.PRNGKey(r), x)["params"] for r in range(tp)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_rank)
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+    step = jax.jit(
+        jax.shard_map(
+            lambda p, xx: mlp.apply({"params": jax.tree.map(lambda q: q[0], p)}, xx),
+            mesh=mesh, in_specs=(P("tp"), P()), out_specs=P(), check_vma=False,
+        )
+    )
+    compiled = step.lower(stacked, x).compile()
+    compiled(stacked, x).block_until_ready()  # warm outside the capture
+
+    prof_dir = str(tmp_path / "trace")
+    with ProfilerSession(prof_dir):
+        for _ in range(3):
+            compiled(stacked, x).block_until_ready()
+
+    report = analyze_trace(prof_dir, hlo_text=compiled.as_text())
+    rows = {r["axis"]: r for r in report["per_scope"]}
+    assert "tp" in rows, report
+    row = rows["tp"]
+    assert {"rs_ring", "row_allgather"} <= set(row["phases"])
+    assert row["spans"] > 0 and row["collective_ms"] > 0
+    assert 0.0 <= row["measured_overlap_frac"] <= 1.0
+    assert any(op.startswith("collective-permute") for op in row["hlo_ops"])
+    # the mp-labeled collectives are not double-counted as bucket exchanges
+    assert report["per_bucket"] == []
