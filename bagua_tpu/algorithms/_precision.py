@@ -1,0 +1,136 @@
+"""Shared ``wire_precision`` plumbing for the gradient-exchange algorithms.
+
+Both the full-precision allreduce engine and the zero (reduce-scatter)
+engine expose ``wire_precision="f32"|"int8"|"int4"|"auto"``: the quantized
+settings route each bucket's padded flat buffer through the in-collective
+blockwise ring (:mod:`bagua_tpu.kernels.quantized_ring`) instead of the
+plain collective.  This mixin centralizes the pieces that are identical on
+both sides:
+
+* validation + one-time ring-hop resolution (the evidence-gated Pallas
+  dispatch must run at construction, never inside a trace);
+* the per-bucket precision resolution — explicit per-bucket plan
+  (``bucket_precision``, set by the service planner under ``"auto"``) >
+  the uniform ``wire_precision`` > ``"f32"`` for non-float buckets;
+* the error-feedback policy: ``"int4"`` (and ``"auto"``, which may resolve
+  to int4 per bucket) carries a persistent f32 residual per bucket in the
+  algorithm state, which makes the algorithm *hold bucketized state* —
+  overlap and mid-training re-bucketing are disabled for those settings
+  (the residual cannot ride the stateless per-bucket backward hook);
+* the modelled per-precision wire-byte accounting the telemetry counters
+  are fed from.
+
+``"auto"`` with no adopted plan resolves every bucket to f32 — the engine
+never quantizes until the planner's guardrail-gated choice lands.
+"""
+
+from typing import List, Optional, Sequence
+
+from bagua_tpu.kernels.quantized_ring import (
+    WIRE_PRECISIONS,
+    get_ring_hop,
+    ring_wire_bytes,
+)
+
+#: bagua datatype names eligible for blockwise quantization (the ring
+#: operates in f32; non-float buckets always take the exact path)
+FLOAT_DTYPES = ("f32", "f16", "bf16")
+
+VALID_WIRE_PRECISIONS = WIRE_PRECISIONS + ("auto",)
+
+#: bits on the wire per quantized precision
+PRECISION_BITS = {"int8": 8, "int4": 4}
+
+
+class WirePrecisionMixin:
+    """Per-bucket wire-precision resolution + error-feedback policy.
+
+    Classes mixing this in call :meth:`_init_wire_precision` from their
+    ``__init__`` and read :meth:`_precision_for_bucket` /
+    :meth:`bucket_precisions` inside their exchange."""
+
+    def _init_wire_precision(self, wire_precision: str, use_pallas=None) -> None:
+        if wire_precision not in VALID_WIRE_PRECISIONS:
+            raise ValueError(
+                f"wire_precision must be one of {VALID_WIRE_PRECISIONS}, "
+                f"got {wire_precision!r}"
+            )
+        self.wire_precision = wire_precision
+        #: planner-chosen per-bucket precision (aligned with plan.specs);
+        #: only consulted under wire_precision="auto"
+        self.bucket_precision: Optional[List[str]] = None
+        # Resolve the fused hop once at construction — resolve_use_pallas
+        # reads the evidence file and must never run inside a trace.
+        self._ring_hops = (
+            {b: get_ring_hop(b, use_pallas) for b in (8, 4)}
+            if wire_precision != "f32"
+            else {}
+        )
+
+    @property
+    def holds_bucketized_state(self) -> bool:
+        """The int4 error-feedback residual is genuinely per-bucket state:
+        re-bucketing would desync it and the stateless overlap hook cannot
+        thread it, so those paths are fenced off (``"auto"`` may resolve to
+        int4 at any time, so it is fenced too)."""
+        return self._ef_enabled()
+
+    def _ef_enabled(self) -> bool:
+        return self.wire_precision in ("int4", "auto")
+
+    def _precision_for_bucket(self, bucket_idx: int, spec) -> str:
+        if spec.dtype not in FLOAT_DTYPES:
+            return "f32"
+        if self.wire_precision == "auto":
+            if self.bucket_precision is None:
+                return "f32"  # no plan adopted yet: never quantize silently
+            return self.bucket_precision[bucket_idx]
+        return self.wire_precision
+
+    def bucket_precisions(self, plan) -> List[str]:
+        """Resolved wire precision per bucket — what the traced step uses."""
+        return [
+            self._precision_for_bucket(i, spec) for i, spec in enumerate(plan.specs)
+        ]
+
+    def set_bucket_precision(self, precisions: Optional[Sequence[str]]) -> None:
+        """Adopt a planner-chosen per-bucket precision plan (``None`` clears
+        it).  Requires ``wire_precision="auto"`` — a user-pinned uniform
+        precision is never silently overridden."""
+        if precisions is None:
+            self.bucket_precision = None
+            return
+        if self.wire_precision != "auto":
+            raise ValueError(
+                "per-bucket precision plans require wire_precision='auto' "
+                f"(this algorithm is pinned to {self.wire_precision!r})"
+            )
+        precisions = list(precisions)
+        bad = sorted(set(p for p in precisions if p not in WIRE_PRECISIONS))
+        if bad:
+            raise ValueError(
+                f"unknown wire precisions {bad}; valid: {WIRE_PRECISIONS}"
+            )
+        plan = getattr(self, "_bound_plan", None)
+        if plan is not None and len(precisions) != len(plan.specs):
+            raise ValueError(
+                f"precision plan has {len(precisions)} entries for "
+                f"{len(plan.specs)} buckets"
+            )
+        self.bucket_precision = precisions
+
+    def wire_bytes_by_precision(self, plan) -> dict:
+        """Modelled wire bytes one rank moves per step, keyed by precision —
+        ring model throughout: an N-byte f32 bucket's allreduce moves
+        ``2*N*(n-1)/n``; a quantized bucket moves the compressed payload +
+        the per-block (min, max) sidecar on each of its ``2*(n-1)`` hops
+        (:func:`~bagua_tpu.kernels.quantized_ring.ring_wire_bytes`)."""
+        n = self.process_group.size
+        out: dict = {}
+        for spec, prec in zip(plan.specs, self.bucket_precisions(plan)):
+            if prec == "f32":
+                nb = 2 * spec.nbytes * (n - 1) // n
+            else:
+                nb = ring_wire_bytes(spec.numel, n, PRECISION_BITS[prec])
+            out[prec] = out.get(prec, 0) + nb
+        return out

@@ -27,21 +27,41 @@ reduction accumulates in the wire dtype (that IS the bandwidth saving);
 gradients are cast back to their original dtype afterwards.  Sits between
 ``gradient_allreduce`` (exact) and ``bytegrad`` (u8) on the
 accuracy/bandwidth curve.
+
+``wire_precision`` (the in-collective quantization rung below both): route
+a bucket's padded flat buffer through the blockwise-quantized ring
+(:mod:`bagua_tpu.kernels.quantized_ring`) — every hop ships int8 or packed
+int4 levels plus an 8-byte/block (min, max) sidecar, and each receiving
+rank dequantizes, reduces and requantizes in one fused kernel.  ``"int4"``
+additionally carries a persistent per-bucket error-feedback residual in
+the algorithm state: the requantization error of this step's hops re-enters
+the next step's gradient, so the quantization noise telescopes instead of
+accumulating.  ``"auto"`` defers the choice to the service planner's
+per-bucket precision plan (``set_bucket_precision``), resolving to f32
+until one is adopted.  Mutually exclusive with ``wire_dtype``; under
+``hierarchical=True`` only the inter-node hops quantize (intra-node stays
+an exact f32 sum).  int4/auto disable overlap and re-bucketing — the
+residual is per-bucket state the stateless backward hook cannot thread.
 """
 
 import jax
 import jax.numpy as jnp
 
+from bagua_tpu.algorithms._precision import WirePrecisionMixin
 from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
 from bagua_tpu.bucket import flatten_bucket_leaves, split_bucket_flat
 from bagua_tpu.communication import (
+    INTER_AXIS,
+    INTRA_AXIS,
     ReduceOp,
     allreduce_inplace,
+    axis_size,
     hierarchical_allreduce_inplace,
 )
+from bagua_tpu.kernels.quantized_ring import quantized_ring_allreduce
 
 
-class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
+class GradientAllReduceAlgorithmImpl(WirePrecisionMixin, AlgorithmImpl):
     supports_overlap = True
     algo_name = "gradient_allreduce"
 
@@ -52,6 +72,8 @@ class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
         average: bool = True,
         fuse: str = "tuple",
         wire_dtype=None,
+        wire_precision: str = "f32",
+        use_pallas=None,
     ):
         super().__init__(process_group, hierarchical=hierarchical)
         self.average = average
@@ -59,6 +81,12 @@ class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
             raise ValueError(f"fuse must be 'tuple' or 'flat', got {fuse!r}")
         self.fuse = fuse
         self.wire_dtype = None if wire_dtype is None else jnp.dtype(wire_dtype)
+        if wire_precision != "f32" and self.wire_dtype is not None:
+            raise ValueError(
+                "wire_dtype and a quantized wire_precision are mutually "
+                "exclusive — pick one compression rung"
+            )
+        self._init_wire_precision(wire_precision, use_pallas)
 
     def _to_wire(self, tree):
         if self.wire_dtype is None:
@@ -74,27 +102,103 @@ class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
             return tree
         return jax.tree.map(lambda l, ref: l.astype(ref.dtype), tree, like)
 
+    def init_state(self, params):
+        """Error-feedback residuals: one f32 flat buffer per bucket when the
+        precision may resolve to int4 (allocated unconditionally for
+        ``"auto"`` so the state layout never depends on the adopted plan —
+        f32/int8 buckets simply carry zeros through)."""
+        if not self._ef_enabled():
+            return {}
+        return {
+            "qr_residual": tuple(
+                jnp.zeros((spec.numel,), jnp.float32)
+                for spec in self._bound_plan.specs
+            )
+        }
+
+    def _quantized_bucket_allreduce(self, leaves, spec, precision, residual):
+        """All-reduce one bucket's padded flat buffer through the blockwise
+        ring; returns ``(flat_out, new_residual)`` (``new_residual`` is None
+        when error feedback is off for this bucket).
+
+        Error feedback is sum-space algebra: the ring accumulates *sums* and
+        divides once at the end, so a hop's requantization error ``e`` makes
+        the average short by ``e/n`` — adding ``e`` to the next step's local
+        gradient restores exactly that."""
+        bits = 8 if precision == "int8" else 4
+        hop = self._ring_hops[bits]
+        flat = flatten_bucket_leaves(leaves, spec)
+        x = flat.astype(jnp.float32)
+        if residual is not None:
+            x = x + residual
+        if self.hierarchical:
+            # Quantize only the slow leg: exact f32 SUM inside the node, then
+            # the quantized ring across nodes.  Every rank of an intra group
+            # holds the identical inter-ring error, so the residual is scaled
+            # by 1/intra_size — the next step's intra sum multiplies it back.
+            x = allreduce_inplace(x, op=ReduceOp.SUM, axis=INTRA_AXIS)
+            out, err = quantized_ring_allreduce(
+                x, INTER_AXIS, bits=bits, average=False, hop=hop
+            )
+            if self.average:
+                out = out / axis_size()
+            if residual is not None:
+                err = err / axis_size(INTRA_AXIS)
+        else:
+            out, err = quantized_ring_allreduce(
+                x, bits=bits, average=self.average, hop=hop
+            )
+        return out.astype(flat.dtype), (err if residual is not None else None)
+
     def transform_gradients(self, grads, params, state, ctx: StepContext):
         op = ReduceOp.AVG if self.average else ReduceOp.SUM
         reduce = hierarchical_allreduce_inplace if self.hierarchical else allreduce_inplace
-        if self.fuse == "tuple":
-            # Variadic fusion: one psum per bucket over the bucket's leaves —
-            # a single variadic all-reduce on the wire (the same fusion the
-            # flat buffer gives) with zero concat/slice HBM traffic.  psum is
-            # elementwise, so the result is bitwise-identical to the flat
-            # path (alignment padding reduces to zeros either way).
-            groups = ctx.plan.group_leaves(grads)
-            reduced = []
-            for i, g in enumerate(groups):
+        precisions = self.bucket_precisions(ctx.plan)
+        if all(p == "f32" for p in precisions):
+            if self.fuse == "tuple":
+                # Variadic fusion: one psum per bucket over the bucket's
+                # leaves — a single variadic all-reduce on the wire (the same
+                # fusion the flat buffer gives) with zero concat/slice HBM
+                # traffic.  psum is elementwise, so the result is
+                # bitwise-identical to the flat path (alignment padding
+                # reduces to zeros either way).
+                groups = ctx.plan.group_leaves(grads)
+                reduced = []
+                for i, g in enumerate(groups):
+                    with self.annotate(i, "mono"):
+                        reduced.append(self._from_wire(reduce(self._to_wire(g), op=op), g))
+                return ctx.plan.ungroup_leaves(reduced, grads), params, state
+            flats = ctx.plan.bucketize(grads)
+            out = []
+            for i, flat in enumerate(flats):
                 with self.annotate(i, "mono"):
-                    reduced.append(self._from_wire(reduce(self._to_wire(g), op=op), g))
-            return ctx.plan.ungroup_leaves(reduced, grads), params, state
-        flats = ctx.plan.bucketize(grads)
-        out = []
-        for i, flat in enumerate(flats):
+                    out.append(self._from_wire(reduce(self._to_wire(flat), op=op), flat))
+            return ctx.plan.debucketize(out, grads), params, state
+        # Quantized (possibly mixed-precision) path: quantized buckets ride
+        # the blockwise ring on their flat buffer; f32 buckets keep their
+        # exact program.  int4 buckets thread the error-feedback residual
+        # through the algorithm state.
+        groups = ctx.plan.group_leaves(grads)
+        resid = list(state["qr_residual"]) if "qr_residual" in state else None
+        new_groups = []
+        for i, spec in enumerate(ctx.plan.specs):
+            leaves = [groups[i][s.name] for s in spec.slots]
+            prec = precisions[i]
             with self.annotate(i, "mono"):
-                out.append(self._from_wire(reduce(self._to_wire(flat), op=op), flat))
-        return ctx.plan.debucketize(out, grads), params, state
+                if prec == "f32":
+                    g = groups[i]
+                    new_groups.append(self._from_wire(reduce(self._to_wire(g), op=op), g))
+                    continue
+                r = resid[i] if (resid is not None and prec == "int4") else None
+                out_flat, new_r = self._quantized_bucket_allreduce(leaves, spec, prec, r)
+                if new_r is not None:
+                    resid[i] = new_r
+                red = split_bucket_flat(out_flat, spec)
+            new_groups.append({s.name: l for s, l in zip(spec.slots, red)})
+        grads = ctx.plan.ungroup_leaves(new_groups, grads)
+        if resid is not None:
+            state = {**state, "qr_residual": tuple(resid)}
+        return grads, params, state
 
     def overlap_exchange(
         self, bucket_idx: int, grads, ctx: StepContext, params_leaves=None
@@ -104,11 +208,19 @@ class GradientAllReduceAlgorithmImpl(AlgorithmImpl):
         # transform_gradients — tuple fuse emits one variadic all-reduce over
         # the leaves, flat fuse materializes the padded bucket buffer first —
         # but anchored at the ops producing this bucket's cotangents instead
-        # of after the whole backward.
+        # of after the whole backward.  int8 buckets run the quantized ring
+        # here too (stateless, so overlap stays bitwise vs monolithic); int4
+        # never reaches this hook (holds_bucketized_state fences it off).
         spec = ctx.plan.specs[bucket_idx]
+        prec = self._precision_for_bucket(bucket_idx, spec)
         op = ReduceOp.AVG if self.average else ReduceOp.SUM
         reduce = hierarchical_allreduce_inplace if self.hierarchical else allreduce_inplace
         with self.annotate(bucket_idx, "overlap"):
+            if prec != "f32":
+                out_flat, _ = self._quantized_bucket_allreduce(
+                    list(grads), spec, prec, None
+                )
+                return split_bucket_flat(out_flat, spec)
             if self.fuse == "tuple":
                 grads = list(grads)
                 return self._from_wire(reduce(self._to_wire(grads), op=op), grads)
@@ -124,11 +236,15 @@ class GradientAllReduceAlgorithm(Algorithm):
         average: bool = True,
         fuse: str = "tuple",
         wire_dtype=None,
+        wire_precision: str = "f32",
+        use_pallas=None,
     ):
         self.hierarchical = hierarchical
         self.average = average
         self.fuse = fuse
         self.wire_dtype = wire_dtype
+        self.wire_precision = wire_precision
+        self.use_pallas = use_pallas
 
     def reify(self, process_group) -> GradientAllReduceAlgorithmImpl:
         return GradientAllReduceAlgorithmImpl(
@@ -137,4 +253,6 @@ class GradientAllReduceAlgorithm(Algorithm):
             average=self.average,
             fuse=self.fuse,
             wire_dtype=self.wire_dtype,
+            wire_precision=self.wire_precision,
+            use_pallas=self.use_pallas,
         )

@@ -317,6 +317,39 @@ class DistributedDataParallel:
                 predicted_exposed_ms=predicted_exposed_ms,
             )
 
+    # -- per-bucket wire precision (planner-chosen) --------------------------
+
+    def apply_precision_plan(self, precisions, reason: str = "planner") -> bool:
+        """Adopt a per-bucket wire-precision plan (the output of
+        ``BucketPlanner.plan_precision`` under ``wire_precision="auto"``):
+        swaps ``impl.bucket_precision``, re-jits the step, and emits a
+        schema-validated ``precision_switch`` telemetry event.  Returns True
+        when the resolved per-bucket precisions actually changed (a no-op
+        plan keeps the compiled step).  Algorithms without the
+        ``wire_precision`` knob reject with AttributeError — the caller opted
+        into a dimension this algorithm does not have."""
+        impl = self.impl
+        if not hasattr(impl, "set_bucket_precision"):
+            raise AttributeError(
+                f"{type(impl).__name__} has no wire_precision knob; "
+                "precision plans apply to gradient_allreduce and zero"
+            )
+        old = impl.bucket_precisions(self.plan) if self.plan is not None else None
+        impl.set_bucket_precision(precisions)
+        new = impl.bucket_precisions(self.plan) if self.plan is not None else None
+        if new == old:
+            return False
+        self._step_fns = {}
+        if self.telemetry is not None:
+            self.telemetry.on_precision_switch(
+                step=self._host_step if self._host_step is not None else 0,
+                plan_version=self.plan_version,
+                old_precisions=old or [],
+                new_precisions=new or [],
+                reason=reason,
+            )
+        return True
+
     # -- plan carry-over (elastic resume) -----------------------------------
 
     def export_plan_payload(self) -> Optional[dict]:
@@ -586,6 +619,9 @@ class DistributedDataParallel:
                 n = self.group.size
                 leg = self.plan.total_bytes() * (n - 1) // n
                 wire_by_leg = {"rs": leg, "ag": leg}
+            wire_by_precision = None
+            if self.plan is not None and hasattr(self.impl, "wire_bytes_by_precision"):
+                wire_by_precision = self.impl.wire_bytes_by_precision(self.plan)
             tel.on_step(
                 step=self._host_step - 1,
                 wall_s=wall,
@@ -594,6 +630,7 @@ class DistributedDataParallel:
                 variant=variant,
                 host_overhead=step_ov,
                 wire_bytes_by_leg=wire_by_leg,
+                wire_bytes_by_precision=wire_by_precision,
             )
         return new_state, losses
 

@@ -75,12 +75,25 @@ def _axis_meta(axis_name) -> Tuple[str, int]:
     return axes[0], jax.lax.axis_size(axes[0])
 
 
+def _ring_arcs(ring: str, n: int) -> Tuple[int, int]:
+    """Hop counts per direction.  ``"uni"`` walks the full ``n - 1``-hop ring
+    one way; ``"bidir"`` splits it into two counter-rotating arcs of
+    ``ceil((n-1)/2)`` / ``floor((n-1)/2)`` hops — both directions of every
+    ICI link carry traffic at once, so the wall-clock hop depth halves."""
+    if ring == "uni":
+        return n - 1, 0
+    if ring == "bidir":
+        return -(-(n - 1) // 2), (n - 1) // 2
+    raise ValueError(f"ring must be 'uni' or 'bidir', got {ring!r}")
+
+
 # ---------------------------------------------------------------------------
 # Ring primitives (jnp composition = the bitwise oracle)
 # ---------------------------------------------------------------------------
 
 
-def ag_matmul(x_shard, w_local, axis_name, *, dot=None, axis_tag=None):
+def ag_matmul(x_shard, w_local, axis_name, *, dot=None, axis_tag=None,
+              ring="uni"):
     """All-gather matmul: ``all_gather(x_shard) @ w_local``, ring-overlapped.
 
     ``x_shard`` is this rank's ``(m_shard, k)`` row block of the activations,
@@ -92,21 +105,33 @@ def ag_matmul(x_shard, w_local, axis_name, *, dot=None, axis_tag=None):
 
     ``dot`` is the per-step tile GEMM (default ``jnp.dot`` — the oracle);
     ``axis_tag`` labels the ring's ``ppermute``s for the trace analyzer
-    (``bagua_ex/axis=<tag>/phase=ag_ring``).
+    (``bagua_ex/axis=<tag>/phase=ag_ring``).  ``ring="bidir"`` runs two
+    counter-rotating arcs so each direction forwards only half the blocks
+    (~half the hop depth on a bidirectional torus link); every block is still
+    multiplied whole by the same ``dot``, so the output is BITWISE the
+    unidirectional ring's.
     """
     dot = dot or jnp.dot
     axis, n = _axis_meta(axis_name)
+    kf, kb = _ring_arcs(ring, n)
     if n == 1:
         return dot(x_shard, w_local)
     idx = jax.lax.axis_index(axis)
     fwd = [(i, (i + 1) % n) for i in range(n)]
-    buf = x_shard
-    parts = []
-    for t in range(n):
-        parts.append(dot(buf, w_local))
-        if t != n - 1:
+    back = [(i, (i - 1) % n) for i in range(n)]
+    # parts[t] holds the product of the block from source rank (idx - t) mod n:
+    # the forward arc fills t = 1..kf, the backward arc fills n-1 down to n-kb.
+    parts = [None] * n
+    parts[0] = dot(x_shard, w_local)
+    fbuf = bbuf = x_shard
+    for t in range(1, kf + 1):
+        with _scope(axis_tag, "ag_ring"):
+            fbuf = jax.lax.ppermute(fbuf, axis, fwd)
+        parts[t] = dot(fbuf, w_local)
+        if t <= kb:
             with _scope(axis_tag, "ag_ring"):
-                buf = jax.lax.ppermute(buf, axis, fwd)
+                bbuf = jax.lax.ppermute(bbuf, axis, back)
+            parts[n - t] = dot(bbuf, w_local)
     # part t came from source rank (idx - t) mod n; reorder so block s of the
     # output is source rank s: out[s] = parts[(idx - s) mod n].
     stacked = jnp.stack(parts)
@@ -114,7 +139,8 @@ def ag_matmul(x_shard, w_local, axis_name, *, dot=None, axis_tag=None):
     return stacked.reshape(n * x_shard.shape[0], w_local.shape[-1])
 
 
-def matmul_rs(x_local, w_local, axis_name, *, dot=None, axis_tag=None):
+def matmul_rs(x_local, w_local, axis_name, *, dot=None, axis_tag=None,
+              ring="uni"):
     """Matmul reduce-scatter: rank ``r``'s row block of ``psum(x @ w)``.
 
     ``x_local`` is the ``(m, k_local)`` activation with the contraction dim
@@ -128,11 +154,20 @@ def matmul_rs(x_local, w_local, axis_name, *, dot=None, axis_tag=None):
     the fully-summed product (an ``all_gather`` restores the replicated
     layout when the consumer needs it).
 
+    ``ring="bidir"`` splits each destination's accumulation into two
+    counter-rotating arcs (sources ``d+1..d+⌈(n-1)/2⌉`` arrive on the
+    backward chain, ``d-⌊(n-1)/2⌋..d-1`` on the forward chain) combined at
+    the destination — ~half the hop depth, same partial products.  The serial
+    sum ORDER differs from the unidirectional walk, so outputs agree to f32
+    rounding (bitwise only when the summation is exact, e.g. integer-valued
+    operands — how the parity test pins it).
+
     ``m`` must divide by the ring size; callers with indivisible token counts
     fall back to the ``psum`` path (see ``RowParallelDense``).
     """
     dot = dot or jnp.dot
     axis, n = _axis_meta(axis_name)
+    ka, kb = _ring_arcs(ring, n)
     if n == 1:
         return dot(x_local, w_local)
     m = x_local.shape[0]
@@ -142,20 +177,48 @@ def matmul_rs(x_local, w_local, axis_name, *, dot=None, axis_tag=None):
         )
     idx = jax.lax.axis_index(axis)
     blk = m // n
+    fwd = [(i, (i + 1) % n) for i in range(n)]
     back = [(i, (i - 1) % n) for i in range(n)]
-    acc = None
-    for t in range(n):
-        d = (idx + 1 + t) % n
-        part = dot(jax.lax.dynamic_slice_in_dim(x_local, d * blk, blk, axis=0), w_local)
-        if acc is None:
-            acc = part
-        else:
-            with _scope(axis_tag, "rs_ring"):
-                acc = jax.lax.ppermute(acc, axis, back)
-            # arrival order is fixed by the ring, so the serial sum order is
-            # identical for every dot implementation — bitwise parity holds.
-            acc = acc + part
-    return acc
+
+    def part(d):
+        return dot(
+            jax.lax.dynamic_slice_in_dim(x_local, d * blk, blk, axis=0), w_local
+        )
+
+    if ring == "uni":
+        acc = None
+        for t in range(n):
+            d = (idx + 1 + t) % n
+            if acc is None:
+                acc = part(d)
+            else:
+                with _scope(axis_tag, "rs_ring"):
+                    acc = jax.lax.ppermute(acc, axis, back)
+                # arrival order is fixed by the ring, so the serial sum order
+                # is identical for every dot implementation — bitwise parity
+                # holds.
+                acc = acc + part(d)
+        return acc
+    # Backward chain: born at rank d + ka, adds every rank down to (and
+    # including) the destination — sources d+ka .. d+1 plus d's own part.
+    acc_a = part((idx - ka) % n)
+    for t in range(1, ka + 1):
+        with _scope(axis_tag, "rs_ring"):
+            acc_a = jax.lax.ppermute(acc_a, axis, back)
+        acc_a = acc_a + part((idx - ka + t) % n)
+    if kb == 0:
+        return acc_a
+    # Forward chain: born at rank d - kb, adds through d-1, then one last hop
+    # delivers it — sources d-kb .. d-1 (the destination's part already rode
+    # the backward chain).
+    acc_b = part((idx + kb) % n)
+    for t in range(1, kb):
+        with _scope(axis_tag, "rs_ring"):
+            acc_b = jax.lax.ppermute(acc_b, axis, fwd)
+        acc_b = acc_b + part((idx + kb - t) % n)
+    with _scope(axis_tag, "rs_ring"):
+        acc_b = jax.lax.ppermute(acc_b, axis, fwd)
+    return acc_a + acc_b
 
 
 # ---------------------------------------------------------------------------

@@ -4,7 +4,8 @@ TPU-native reimplementation of the reference's CUDA MinMaxUInt8 scheme
 (``kernels/bagua_kernels.cu:404-572``; pure-torch oracle
 ``tests/internal/compressor.py:4-33``).  Semantics, per chunk:
 
-    scale       = 255 / (max - min + 1e-7)
+    scale       = 255 / (max - min + 1e-7)      (denominator bounded; see
+                                                 :func:`_safe_scale`)
     upper_bound = rint(max * scale)
     lower_bound = upper_bound - 255
     q           = clip(rint(x * scale), -inf, upper_bound) - lower_bound   (uint8)
@@ -32,6 +33,9 @@ import jax.numpy as jnp
 
 EPS = 1e-7
 LEVELS = 255.0
+# Degenerate-range guard terms (see _safe_scale).
+REL_EPS = 1e-35
+F32_MAX = 3.4028235e38
 
 
 # ---------------------------------------------------------------------------
@@ -39,8 +43,30 @@ LEVELS = 255.0
 # ---------------------------------------------------------------------------
 
 
+def _safe_scale(mn, mx, levels=LEVELS):
+    """Per-chunk scale with a bounded denominator.
+
+    The unguarded ``levels / (mx - mn + EPS)`` breaks down twice at the
+    extremes: a near-constant chunk at huge magnitude gets a scale so large
+    that ``round(mx * scale)`` overflows to inf and ``q`` fills with NaN
+    (|mx| >~ 1e29), and a range that itself overflows f32 (``mx - mn`` = inf)
+    drives scale to exact zero so decompress divides by it.  Both are cured
+    arithmetically — no branch, because a select on the decompress output
+    changes how XLA lowers the division per fusion context and breaks the
+    cross-engine bitwise wire contract (``tests/test_zero.py``):
+
+    * ``REL_EPS * amax`` bounds ``|mx| * scale`` by ``levels / REL_EPS``
+      (~2.6e37 for uint8), keeping the bound representable;
+    * the ``F32_MAX`` clamp keeps the denominator finite, so scale > 0.
+
+    For any chunk outside those regimes both terms vanish in f32 rounding
+    and the result is bitwise-identical to the unguarded scale."""
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return levels / jnp.minimum(mx - mn + EPS + REL_EPS * amax, F32_MAX)
+
+
 def _quantize(x, mn, mx):
-    scale = LEVELS / (mx - mn + EPS)
+    scale = _safe_scale(mn, mx)
     upper = jnp.round(mx * scale)
     lower = upper - LEVELS
     level = jnp.minimum(jnp.round(x * scale), upper)
@@ -67,9 +93,8 @@ def decompress_minmax_uint8(
     """Inverse of :func:`compress_minmax_uint8` (lossy)."""
     mn = minmax[:, 0:1]
     mx = minmax[:, 1:2]
-    scale = LEVELS / (mx - mn + EPS)
-    upper = jnp.round(mx * scale)
-    lower = upper - LEVELS
+    scale = _safe_scale(mn, mx)
+    lower = jnp.round(mx * scale) - LEVELS
     return ((q.astype(jnp.float32) + lower) / scale).astype(out_dtype)
 
 
@@ -123,7 +148,7 @@ def _compress_kernel(x_ref, q_ref, mm_ref):
     x = x_ref[...].astype(jnp.float32)  # (bc, rows, 128)
     mn = jnp.min(x, axis=(1, 2))        # per-chunk reductions, (bc,)
     mx = jnp.max(x, axis=(1, 2))
-    scale = (LEVELS / (mx - mn + EPS))[:, None, None]
+    scale = _safe_scale(mn, mx)[:, None, None]
     upper = jnp.round(mx[:, None, None] * scale)
     lower = upper - LEVELS
     level = jnp.minimum(jnp.round(x * scale), upper)
@@ -137,9 +162,8 @@ def _decompress_kernel(q_ref, mm_ref, x_ref):
     mm = mm_ref[...]                     # (bc, 1, 2)
     mn = mm[:, :, 0:1]                   # (bc, 1, 1)
     mx = mm[:, :, 1:2]
-    scale = LEVELS / (mx - mn + EPS)
-    upper = jnp.round(mx * scale)
-    lower = upper - LEVELS
+    scale = _safe_scale(mn, mx)
+    lower = jnp.round(mx * scale) - LEVELS
     q = q_ref[...].astype(jnp.int32).astype(jnp.float32)
     x_ref[...] = ((q + lower) / scale).astype(x_ref.dtype)
 
@@ -247,9 +271,8 @@ def _fused_reduce_kernel(q_ref, mm_ref, qo_ref, mmo_ref, *, n, average):
     mm = mm_ref[...]                     # (n, 1, 2)
     mn = mm[:, :, 0:1]                   # (n, 1, 1)
     mx = mm[:, :, 1:2]
-    scale = LEVELS / (mx - mn + EPS)
-    upper = jnp.round(mx * scale)
-    lower = upper - LEVELS
+    scale = _safe_scale(mn, mx)
+    lower = jnp.round(mx * scale) - LEVELS
     q = q_ref[...].astype(jnp.int32).astype(jnp.float32)
     x = (q + lower) / scale
     # float32 tree-sum over peers, then requantize the reduced chunk — one
@@ -259,7 +282,7 @@ def _fused_reduce_kernel(q_ref, mm_ref, qo_ref, mmo_ref, *, n, average):
         red = red / n                    # division, matching the jnp oracle
     mn2 = jnp.min(red)
     mx2 = jnp.max(red)
-    scale2 = LEVELS / (mx2 - mn2 + EPS)
+    scale2 = _safe_scale(mn2, mx2)
     upper2 = jnp.round(mx2 * scale2)
     lower2 = upper2 - LEVELS
     level = jnp.minimum(jnp.round(red * scale2), upper2)

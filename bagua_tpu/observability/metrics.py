@@ -207,6 +207,15 @@ EVENT_PAYLOAD_FIELDS = {
         "plan_source": str,
         "lost_steps": int,
     },
+    # the engine adopted a new per-bucket wire-precision plan (planner-driven
+    # under wire_precision="auto", or an operator override): before/after
+    # per-bucket precisions plus who asked for the change
+    "precision_switch": {
+        "plan_version": int,
+        "old_precisions": list,
+        "new_precisions": list,
+        "reason": str,
+    },
 }
 
 

@@ -188,6 +188,7 @@ class Telemetry:
         variant: str = "default",
         host_overhead: Optional[Dict] = None,
         wire_bytes_by_leg: Optional[Dict[str, int]] = None,
+        wire_bytes_by_precision: Optional[Dict[str, int]] = None,
     ) -> None:
         """One dispatched training step's host-side evidence.
 
@@ -195,7 +196,11 @@ class Telemetry:
         (sharded exchanges report ``{"rs": ..., "ag": ...}``); each leg gets
         its own ``wire_bytes_<leg>_total`` counter and the dict rides the
         ``step`` JSONL event (the schema validator allows extra fields on
-        known event types)."""
+        known event types).  ``wire_bytes_by_precision`` breaks the same
+        traffic down by wire precision (``f32``/``int8``/``int4`` — the
+        quantized-ring exchange's modelled bytes); each precision gets a
+        ``wire_bytes_precision_<p>_total`` counter — the flat-name analog of
+        a ``wire_bytes{precision=...}`` labeled family."""
         self.current_step = int(step)
         self.current_variant = variant
         self.recompile.record_step()
@@ -212,6 +217,12 @@ class Telemetry:
                 r.counter(
                     f"wire_bytes_{leg}_total",
                     help=f"bytes communicated per rank on the {leg} leg",
+                ).inc(max(0, int(nbytes)))
+        if wire_bytes_by_precision:
+            for prec, nbytes in sorted(wire_bytes_by_precision.items()):
+                r.counter(
+                    f"wire_bytes_precision_{prec}_total",
+                    help=f"bytes communicated per rank at wire precision {prec}",
                 ).inc(max(0, int(nbytes)))
         r.histogram("step_wall_ms", help="host-observed step wall time").observe(
             wall_s * 1e3
@@ -233,6 +244,10 @@ class Telemetry:
             if wire_bytes_by_leg:
                 event["wire_bytes_by_leg"] = {
                     k: int(v) for k, v in sorted(wire_bytes_by_leg.items())
+                }
+            if wire_bytes_by_precision:
+                event["wire_bytes_by_precision"] = {
+                    k: int(v) for k, v in sorted(wire_bytes_by_precision.items())
                 }
             self.jsonl.emit(event)
 
@@ -274,6 +289,40 @@ class Telemetry:
             if measured_exposed_ms is not None:
                 event["measured_exposed_ms"] = round(float(measured_exposed_ms), 4)
             self.jsonl.emit(event)
+
+    def on_precision_switch(
+        self,
+        step: int,
+        plan_version: int,
+        old_precisions,
+        new_precisions,
+        reason: str = "planner",
+    ) -> None:
+        """The engine adopted a new per-bucket wire-precision plan
+        (``DistributedDataParallel.apply_precision_plan`` — planner-driven
+        under ``wire_precision="auto"`` or an operator override).  Exported
+        as the ``precision_switch_total`` counter plus per-precision bucket
+        counts, and as a schema-validated ``precision_switch`` JSONL event
+        carrying the full before/after per-bucket precision lists."""
+        r = self.registry
+        r.counter(
+            "precision_switch_total",
+            help="per-bucket wire-precision plan swaps adopted by the engine",
+        ).inc()
+        new_precisions = [str(p) for p in new_precisions]
+        for prec in sorted(set(new_precisions)):
+            r.gauge(
+                f"buckets_at_precision_{prec}",
+                help=f"buckets exchanging at wire precision {prec}",
+            ).set(new_precisions.count(prec))
+        if self.jsonl:
+            self.jsonl.emit(
+                {"event": "precision_switch", "step": int(step),
+                 "plan_version": int(plan_version),
+                 "old_precisions": [str(p) for p in old_precisions],
+                 "new_precisions": new_precisions,
+                 "reason": str(reason)}
+            )
 
     def on_snapshot(
         self, step: int, wall_ms: float, n_bytes: int, kind: str = "async"

@@ -69,6 +69,12 @@ class AutotuneTaskManager:
         self.tensor_arrivals: Dict[str, float] = {}
         self.wire_samples: List[WireSample] = []
         self._intra_size = 1
+        self._world_size = 1
+        #: convergence guardrail for the quantized-ring wire: only precisions
+        #: that passed the loss-parity gate (``ci/perf_audit.py`` ``--wire``
+        #: lane, or an operator override) may be chosen per bucket.  "f32"
+        #: alone = never quantize, the safe default.
+        self.precision_allow_list: List[str] = ["f32"]
         #: the full planner decision record, surfaced over the
         #: ``planner_trail`` endpoint and into ``AUTOTUNE_RUN.json``
         self.decision_trail: Dict = {
@@ -80,6 +86,7 @@ class AutotuneTaskManager:
             "warm_start": [],
             "dp_plan": None,
             "greedy_plan": None,
+            "precision_plan": None,
             "proposals": [],
             "chosen": None,
         }
@@ -222,10 +229,35 @@ class AutotuneTaskManager:
                 logger.warning("ignoring malformed bucket_wire span: %r", s)
             if s.get("intra_size"):
                 self._intra_size = max(1, int(s["intra_size"]))
+            if s.get("world_size"):
+                self._world_size = max(1, int(s["world_size"]))
         if ready or any(s.get("action") == "bucket_wire" for s in spans):
             self._refresh_planner()
 
     # -- planner integration --------------------------------------------------
+
+    def set_precision_allow_list(self, allowed: List[str]) -> None:
+        """Install the convergence-guardrail allow-list (the precisions the
+        loss-parity gate certified) and refresh the precision plan in the
+        decision trail if a planner is already live."""
+        allow = sorted({"f32"} | set(allowed))
+        unknown = set(allow) - {"f32", "int8", "int4"}
+        if unknown:
+            raise ValueError(f"unknown wire precisions: {sorted(unknown)}")
+        self.precision_allow_list = allow
+        if self.planner is not None:
+            self._refresh_precision_plan()
+
+    def _refresh_precision_plan(self) -> None:
+        """Re-choose per-bucket wire precision over the DP partition at the
+        live bucket-size cap and record it (allow-list included) in the
+        decision trail."""
+        dp = self.planner.plan(max_bucket_bytes=self.hyperparameter.bucket_size)
+        self.decision_trail["precision_plan"] = self.planner.plan_precision(
+            dp.buckets,
+            n_ranks=self._world_size,
+            allowed=self.precision_allow_list,
+        )
 
     def _overlap_efficiency(self) -> float:
         """Aggregate measured overlap fraction across wire samples (η in the
@@ -279,6 +311,7 @@ class AutotuneTaskManager:
         # predicted cost — the decision the CI gate audits.
         dp = self.planner.plan()
         trail["dp_plan"] = dp.summary()
+        self._refresh_precision_plan()
         decls = self.ordered_tensor_list()
         shapes = {td.name: (td.num_elements,) for td in decls}
         greedy_specs = split_declarations(decls, shapes, self.hyperparameter.bucket_size)

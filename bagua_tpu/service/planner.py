@@ -58,6 +58,7 @@ __all__ = [
     "BucketPlanner",
     "PlanResult",
     "fit_alpha_beta",
+    "quantized_hop_bytes",
 ]
 
 
@@ -69,11 +70,14 @@ class WireSample:
     ``"intra"`` (hierarchical intra-axis reduce), ``"inter"``
     (hierarchical cross-axis exchange), ``"rs"`` (sharded reduce-scatter,
     the ``zero`` algorithm's in-backward leg), ``"ag"`` (the deferred
-    parameter all-gather riding the next step's forward) or ``"pp"`` (one
+    parameter all-gather riding the next step's forward), ``"pp"`` (one
     neighbor ``ppermute`` hop of a fused collective-matmul ring — see
-    :mod:`bagua_tpu.kernels.collective_matmul`).  ``hidden_frac`` is the
-    span's measured overlap fraction from the device trace, if
-    attributed."""
+    :mod:`bagua_tpu.kernels.collective_matmul`) or ``"qr8"`` / ``"qr4"``
+    (one hop of the blockwise-quantized ring — the compressed-payload
+    ``ppermute`` plus the fused dequant-reduce-requant kernel, see
+    :mod:`bagua_tpu.kernels.quantized_ring`; ``nbytes`` is the hop's
+    compressed payload + sidecar).  ``hidden_frac`` is the span's measured
+    overlap fraction from the device trace, if attributed."""
 
     nbytes: float
     seconds: float
@@ -110,6 +114,33 @@ DEFAULT_AG = AlphaBeta(alpha=100e-6, beta=80e9)
 # the neighbor, so the launch latency prior sits well below a full collective
 # and the bandwidth prior at the per-link ICI rate.
 DEFAULT_PP = AlphaBeta(alpha=20e-6, beta=90e9)
+# One hop of the blockwise-quantized ring: the same neighbor ppermute as pp
+# but carrying a compressed payload AND running the fused
+# dequant-reduce-requant kernel before the send, so the latency prior sits
+# above pp (quantization math per hop) while the bandwidth prior stays near
+# the per-link rate.  int4 pays extra nibble pack/unpack arithmetic per byte.
+DEFAULT_QR8 = AlphaBeta(alpha=30e-6, beta=90e9)
+DEFAULT_QR4 = AlphaBeta(alpha=40e-6, beta=80e9)
+
+#: quantization block size mirrored from
+#: :data:`bagua_tpu.kernels.quantized_ring.DEFAULT_BLOCK` — the planner is
+#: deliberately jax-free, so it re-states the constant instead of importing
+#: the kernel module (parity is pinned by ``tests/test_planner.py``).
+QR_BLOCK = 4096
+
+
+def quantized_hop_bytes(numel: int, n_ranks: int, bits: int, block: int = QR_BLOCK) -> int:
+    """Bytes of one quantized-ring hop (compressed shard payload + f32
+    min/max sidecar) — the pure-Python mirror of
+    :func:`bagua_tpu.kernels.quantized_ring.ring_wire_bytes` divided by its
+    ``2 * (n - 1)`` hops, kept import-free so the planner stays device-less."""
+    n = int(n_ranks)
+    if n <= 1:
+        return 0
+    shard = -(-(int(numel) // n) // block) * block  # padded shard elems
+    nblocks = shard // block
+    payload = shard // (1 if bits == 8 else 2)
+    return payload + nblocks * 8
 
 
 def fit_alpha_beta(
@@ -164,6 +195,8 @@ class CostModel:
         rs: AlphaBeta = DEFAULT_RS,
         ag: AlphaBeta = DEFAULT_AG,
         pp: AlphaBeta = DEFAULT_PP,
+        qr8: AlphaBeta = DEFAULT_QR8,
+        qr4: AlphaBeta = DEFAULT_QR4,
     ):
         self.flat = flat
         self.intra = intra
@@ -172,6 +205,8 @@ class CostModel:
         self.rs = rs
         self.ag = ag
         self.pp = pp
+        self.qr8 = qr8
+        self.qr4 = qr4
 
     @classmethod
     def from_samples(
@@ -188,6 +223,8 @@ class CostModel:
             rs=fit_alpha_beta(by_leg.get("rs", []), DEFAULT_RS),
             ag=fit_alpha_beta(by_leg.get("ag", []), DEFAULT_AG),
             pp=fit_alpha_beta(by_leg.get("pp", []), DEFAULT_PP),
+            qr8=fit_alpha_beta(by_leg.get("qr8", []), DEFAULT_QR8),
+            qr4=fit_alpha_beta(by_leg.get("qr4", []), DEFAULT_QR4),
         )
 
     def bucket_wire_time(
@@ -208,6 +245,24 @@ class CostModel:
         """Predicted time of the deferred parameter all-gather for one
         bucket's full payload (the sharded pattern's second leg)."""
         return self.ag.predict(nbytes)
+
+    def quantized_ring_wire_time(
+        self, numel: int, n_ranks: int, precision: str, block: int = QR_BLOCK
+    ) -> float:
+        """Predicted wire time of one bucket's blockwise-quantized ring
+        allreduce (:func:`~bagua_tpu.kernels.quantized_ring.quantized_ring_allreduce`)
+        over ``n_ranks``: ``2 * (n - 1)`` sequential hops (reduce-scatter then
+        all-gather), each a neighbor exchange of the compressed shard priced
+        through the fitted ``qr8`` / ``qr4`` leg."""
+        leg = {"int8": self.qr8, "qr8": self.qr8, "int4": self.qr4, "qr4": self.qr4}[
+            precision
+        ]
+        n = int(n_ranks)
+        if n <= 1:
+            return 0.0
+        bits = 8 if leg is self.qr8 else 4
+        hop = quantized_hop_bytes(numel, n, bits, block)
+        return 2 * (n - 1) * leg.predict(hop)
 
     def ring_matmul_wire_time(self, nbytes: float, ring_size: int) -> float:
         """Total wire time of one fused collective-matmul ring
@@ -237,6 +292,8 @@ class CostModel:
                 ("rs", self.rs),
                 ("ag", self.ag),
                 ("pp", self.pp),
+                ("qr8", self.qr8),
+                ("qr4", self.qr4),
             )
         }
 
@@ -414,6 +471,107 @@ class BucketPlanner:
         cuts.reverse()
         buckets = [[items[k] for k in range(i, j)] for i, j in cuts]
         return self.evaluate(buckets, hierarchical)
+
+    # -- per-bucket wire precision (the quantized-ring chooser) --------------
+
+    #: dtypes the quantized ring can carry (mirrors the engines' float set)
+    QUANTIZABLE_DTYPES = ("f32", "f16", "bf16")
+
+    def plan_precision(
+        self,
+        buckets: Sequence[Sequence[TensorDeclaration]],
+        n_ranks: int,
+        allowed: Sequence[str] = ("f32",),
+        hierarchical: bool = False,
+        block: int = QR_BLOCK,
+    ) -> Dict:
+        """Choose a wire precision per bucket, gated by a convergence
+        allow-list.
+
+        For every bucket of an (already chosen) partition, price the exact
+        exchange each precision would run — the engine's f32 collective
+        (flat / hierarchical / sharded, whatever this planner's
+        ``wire_pattern`` says) against the blockwise-quantized ring through
+        the fitted ``qr8`` / ``qr4`` legs — and pick the cheapest precision
+        **from the allow-list**.  ``allowed`` is the convergence guardrail:
+        only precisions that passed the loss-parity gate
+        (``ci/perf_audit.py`` ``--wire`` lane) may be chosen; everything else
+        is still priced and recorded as ``blocked`` so the decision trail
+        shows what the guardrail cost.  ``"f32"`` is always implicitly
+        allowed — exact exchange needs no parity evidence.
+
+        Non-float buckets and degenerate rings (``n_ranks < 2``) stay f32,
+        matching the engines' own resolution rules.  Returns a JSON-ready
+        record: ``precisions`` (the adoptable per-bucket plan, in bucket
+        order) plus per-bucket candidate timings and aggregate savings."""
+        n = int(n_ranks)
+        allow = {"f32"} | {p for p in allowed if p != "f32"}
+        unknown = allow - {"f32", "int8", "int4"}
+        if unknown:
+            raise ValueError(f"unknown wire precisions in allow-list: {sorted(unknown)}")
+        rows: List[Dict] = []
+        precisions: List[str] = []
+        total_f32 = total_chosen = 0.0
+        for bi, bucket in enumerate(buckets):
+            nbytes = sum(_decl_bytes(td) for td in bucket)
+            numel = sum(td.num_elements for td in bucket)
+            dtypes = {td.dtype for td in bucket}
+            f32_time = self.cost_model.bucket_wire_time(
+                nbytes, hierarchical, wire_pattern=self.wire_pattern
+            )
+            cand = {"f32": f32_time}
+            quantizable = (
+                n >= 2 and dtypes and dtypes <= set(self.QUANTIZABLE_DTYPES)
+            )
+            if quantizable:
+                for prec in ("int8", "int4"):
+                    ring = self.cost_model.quantized_ring_wire_time(
+                        numel, n, prec, block
+                    )
+                    if self.wire_pattern == "sharded":
+                        # zero's gradient leg is the reduce-scatter half of
+                        # the ring (n-1 of the 2(n-1) hops); the deferred
+                        # param all-gather stays f32 regardless of precision
+                        t = ring / 2.0
+                    elif hierarchical:
+                        # exact f32 sum intra-node, quantized ring inter-node
+                        t = self.cost_model.intra.predict(nbytes)
+                        t += self.cost_model.quantized_ring_wire_time(
+                            numel, max(1, n // self.cost_model.intra_size), prec, block
+                        )
+                    else:
+                        t = ring
+                    cand[prec] = t
+            chosen = min(
+                (p for p in cand if p in allow), key=lambda p: (cand[p], p)
+            )
+            precisions.append(chosen)
+            total_f32 += f32_time
+            total_chosen += cand[chosen]
+            rows.append(
+                {
+                    "bucket": bi,
+                    "nbytes": nbytes,
+                    "numel": numel,
+                    "dtype": sorted(dtypes)[0] if len(dtypes) == 1 else sorted(dtypes),
+                    "candidate_us": {p: round(t * 1e6, 3) for p, t in cand.items()},
+                    "chosen": chosen,
+                    "blocked": sorted(
+                        p for p in cand if p not in allow and cand[p] < cand[chosen]
+                    ),
+                }
+            )
+        return {
+            "allow_list": sorted(allow),
+            "n_ranks": n,
+            "wire_pattern": self.wire_pattern,
+            "hierarchical": bool(hierarchical),
+            "precisions": precisions,
+            "per_bucket": rows,
+            "total_wire_ms_f32": round(total_f32 * 1e3, 4),
+            "total_wire_ms": round(total_chosen * 1e3, 4),
+            "saved_frac": round(1.0 - total_chosen / total_f32, 4) if total_f32 else 0.0,
+        }
 
     # -- candidate ranking (warm-start input) --------------------------------
 

@@ -35,6 +35,17 @@ scatter stage already ends with each rank holding its reduced chunk
 path simply STOPS there and decompresses locally, dropping the u8 gather of
 the gradient leg entirely.  Bitwise-identical to rank me's slice of the
 monolithic ByteGrad output because the reference decompress is row-wise.
+
+``wire_precision`` composition: the gradient leg's reduce-scatter runs as
+the blockwise-quantized ring (:mod:`bagua_tpu.kernels.quantized_ring`) —
+int8 or packed-int4 levels per hop with a fused dequant-reduce-requant at
+every rank.  ``"int4"`` threads a persistent per-bucket error-feedback
+residual through the algorithm state (monolithic path only — the residual
+makes the algorithm hold bucketized state, fencing off overlap and
+re-bucketing); ``"int8"`` is stateless and keeps overlap.  The deferred
+parameter all-gather (leg 3) always stays full precision — it ships
+*parameters*, not gradients, and quantizing it would bias the weights.
+Mutually exclusive with ``compression="bytegrad"``.
 """
 
 from typing import Any, Dict
@@ -42,6 +53,7 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
+from bagua_tpu.algorithms._precision import WirePrecisionMixin
 from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
 from bagua_tpu.bucket import flatten_bucket_leaves, split_bucket_flat
 from bagua_tpu.communication import (
@@ -53,13 +65,14 @@ from bagua_tpu.communication import (
     reduce_scatter_inplace,
 )
 from bagua_tpu.kernels.minmax_uint8 import get_compressors, get_fused_reducer
+from bagua_tpu.kernels.quantized_ring import quantized_ring_reduce_scatter
 from bagua_tpu.sharded.layout import ShardLayout, reshard_bucket_rows
 from bagua_tpu.utils import from_bagua_datatype
 
 _FLOAT_DTYPES = ("f32", "f16", "bf16")
 
 
-class ZeroAlgorithmImpl(AlgorithmImpl):
+class ZeroAlgorithmImpl(WirePrecisionMixin, AlgorithmImpl):
     supports_overlap = True
     overlap_mode = "gradient"
     algo_name = "zero"
@@ -69,12 +82,17 @@ class ZeroAlgorithmImpl(AlgorithmImpl):
 
     def __init__(
         self, process_group, hierarchical: bool = False, average: bool = True,
-        compression: str = None, use_pallas=None,
+        compression: str = None, use_pallas=None, wire_precision: str = "f32",
     ):
         super().__init__(process_group, hierarchical=hierarchical)
         if compression not in (None, "bytegrad"):
             raise ValueError(
                 f"zero compression must be None or 'bytegrad', got {compression!r}"
+            )
+        if compression is not None and wire_precision != "f32":
+            raise ValueError(
+                "compression and a quantized wire_precision are mutually "
+                "exclusive — pick one compression rung"
             )
         self.average = average
         self.compression = compression
@@ -83,6 +101,7 @@ class ZeroAlgorithmImpl(AlgorithmImpl):
             # inside a trace) — same policy as ByteGradAlgorithmImpl.
             self._compressors = get_compressors(use_pallas)
             self._fused_reducer = get_fused_reducer(use_pallas)
+        self._init_wire_precision(wire_precision, use_pallas)
 
     # -- state ---------------------------------------------------------------
 
@@ -92,12 +111,21 @@ class ZeroAlgorithmImpl(AlgorithmImpl):
         step-0 gate in :meth:`on_step_start` keeps them from ever being
         applied."""
         n = self.process_group.size
-        return {
+        state = {
             "pending": tuple(
                 jnp.zeros((spec.numel // n,), from_bagua_datatype(spec.dtype))
                 for spec in self._bound_plan.specs
             )
         }
+        if self._ef_enabled():
+            # int4 error-feedback residuals, one f32 flat per bucket (see
+            # WirePrecisionMixin) — full bucket length: the reduce-scatter
+            # charges this rank wherever its hops requantized.
+            state["qr_residual"] = tuple(
+                jnp.zeros((spec.numel,), jnp.float32)
+                for spec in self._bound_plan.specs
+            )
+        return state
 
     def stash_updates(self, state, pending):
         """Called by the engine's sharded-update phase with this step's
@@ -107,8 +135,19 @@ class ZeroAlgorithmImpl(AlgorithmImpl):
 
     def reshard_host_state(self, state, old: ShardLayout, new: ShardLayout):
         """Host-side migration of the rank-stacked ``pending`` shards between
-        shard layouts (mid-training rebucket, elastic world-size remap)."""
-        return {"pending": tuple(reshard_bucket_rows(list(state["pending"]), old, new))}
+        shard layouts (mid-training rebucket, elastic world-size remap).
+        Error-feedback residuals do not migrate — dropping them loses one
+        step of compensation, not correctness — so they restart at zero in
+        the new layout."""
+        out = {"pending": tuple(reshard_bucket_rows(list(state["pending"]), old, new))}
+        if "qr_residual" in state:
+            import numpy as np
+
+            out["qr_residual"] = tuple(
+                np.zeros((new.n_shards,) + np.asarray(r).shape[1:], np.float32)
+                for r in state["qr_residual"]
+            )
+        return out
 
     # -- leg 3: deferred all-gather -------------------------------------------
 
@@ -138,8 +177,19 @@ class ZeroAlgorithmImpl(AlgorithmImpl):
 
     # -- leg 1: reduce-scatter ------------------------------------------------
 
-    def _reduce_scatter_flat(self, flat, spec):
-        """Rank me's reduced shard of one bucket's padded flat buffer."""
+    def _reduce_scatter_flat(self, flat, spec, precision="f32", residual=None):
+        """Rank me's reduced shard of one bucket's padded flat buffer.
+        Returns ``(shard, new_residual)`` — ``new_residual`` is None except
+        on the quantized-ring path with error feedback enabled."""
+        if precision in ("int8", "int4") and spec.dtype in _FLOAT_DTYPES:
+            bits = 8 if precision == "int8" else 4
+            x = flat.astype(jnp.float32)
+            if residual is not None:
+                x = x + residual
+            shard, err = quantized_ring_reduce_scatter(
+                x, bits=bits, average=self.average, hop=self._ring_hops[bits]
+            )
+            return shard.astype(flat.dtype), (err if residual is not None else None)
         if self.compression == "bytegrad" and spec.dtype in _FLOAT_DTYPES:
             n = axis_size()
             chunk = flat.shape[0] // n
@@ -151,40 +201,58 @@ class ZeroAlgorithmImpl(AlgorithmImpl):
             # The monolithic pipeline would all-gather (q2, mm2) here; the
             # sharded path stops and decompresses its own chunk locally —
             # bitwise row me of the reference output, zero gather bytes.
-            return decompress(q2, mm2).reshape(-1).astype(flat.dtype)
+            return decompress(q2, mm2).reshape(-1).astype(flat.dtype), None
         op = ReduceOp.AVG if self.average else ReduceOp.SUM
-        return reduce_scatter_inplace(flat, op=op)
+        return reduce_scatter_inplace(flat, op=op), None
 
-    def _exchange_bucket(self, bucket_idx, grads, ctx: StepContext):
+    def _exchange_bucket(self, bucket_idx, grads, ctx: StepContext, residual=None):
         """One bucket's exchange: reduce-scatter, then embed the shard back
         into a zero-filled full-shape image so the leaves keep their
         shapes/dtypes (the sharded updater slices the shard back out)."""
         spec = ctx.plan.specs[bucket_idx]
         n = self.process_group.size
+        prec = self._precision_for_bucket(bucket_idx, spec)
         with self.annotate(bucket_idx, "rs"):
             flat = flatten_bucket_leaves(grads, spec)
-            shard = self._reduce_scatter_flat(flat, spec)
+            shard, new_resid = self._reduce_scatter_flat(
+                flat, spec, precision=prec, residual=residual
+            )
             buf = jax.lax.dynamic_update_slice(
                 jnp.zeros_like(flat), shard.astype(flat.dtype),
                 (rank_id() * (spec.numel // n),),
             )
-        return split_bucket_flat(buf, spec)
+        return split_bucket_flat(buf, spec), new_resid
 
     def transform_gradients(self, grads, params, state, ctx: StepContext):
         groups = ctx.plan.group_leaves(grads)
+        resid = list(state["qr_residual"]) if "qr_residual" in state else None
         out = []
         for bi, spec in enumerate(ctx.plan.specs):
             leaves = [groups[bi][s.name] for s in spec.slots]
-            exchanged = self._exchange_bucket(bi, leaves, ctx)
+            r = (
+                resid[bi]
+                if resid is not None
+                and self._precision_for_bucket(bi, spec) == "int4"
+                else None
+            )
+            exchanged, new_r = self._exchange_bucket(bi, leaves, ctx, residual=r)
+            if new_r is not None:
+                resid[bi] = new_r
             out.append({s.name: l for s, l in zip(spec.slots, exchanged)})
-        return ctx.plan.ungroup_leaves(out, grads), params, state
+        grads = ctx.plan.ungroup_leaves(out, grads)
+        if resid is not None:
+            state = {**state, "qr_residual": tuple(resid)}
+        return grads, params, state
 
     def overlap_exchange(
         self, bucket_idx: int, grads, ctx: StepContext, params_leaves=None
     ):
         # Same wire program as transform_gradients, anchored at the ops
         # producing this bucket's cotangents by the engine's custom_vjp rule.
-        return self._exchange_bucket(bucket_idx, list(grads), ctx)
+        # Error feedback never reaches here: int4/auto hold bucketized state,
+        # which reports overlap unsupported.
+        exchanged, _ = self._exchange_bucket(bucket_idx, list(grads), ctx)
+        return exchanged
 
 
 class ZeroAlgorithm(Algorithm):
@@ -194,15 +262,17 @@ class ZeroAlgorithm(Algorithm):
 
     def __init__(
         self, hierarchical: bool = False, average: bool = True,
-        compression: str = None, use_pallas=None,
+        compression: str = None, use_pallas=None, wire_precision: str = "f32",
     ):
         self.hierarchical = hierarchical
         self.average = average
         self.compression = compression
         self.use_pallas = use_pallas
+        self.wire_precision = wire_precision
 
     def reify(self, process_group) -> ZeroAlgorithmImpl:
         return ZeroAlgorithmImpl(
             process_group, hierarchical=self.hierarchical, average=self.average,
             compression=self.compression, use_pallas=self.use_pallas,
+            wire_precision=self.wire_precision,
         )

@@ -30,6 +30,8 @@ Usage::
     python ci/perf_audit.py               # writes PERF_AUDIT.md + .json
     python ci/perf_audit.py --quick       # gradient_allreduce variants + fsdp
     python ci/perf_audit.py --quick --model=mlp --ddp-only   # tier-1 CI lane
+    python ci/perf_audit.py --quick --model=mlp --ddp-only --wire=int8
+                                          # quantized-ring wire lane
 
 Run under the CPU sim; on a real-TPU session run bench.py instead (and this
 audit's census still applies — the SPMD partitioner emits the same wire
@@ -179,6 +181,11 @@ VARIANTS = {
     # the optimizer updates only each rank's shard.
     "zero": ({}, {"overlap": False}),
     "zero[overlap]": ({}, {"overlap": True}),
+    # In-collective blockwise quantization: the gradient exchange is the
+    # quantized ring (u8 / packed-int4 payload + f32 minmax sidecar per hop),
+    # zero full-precision all-reduces anywhere in the step.
+    "gradient_allreduce[int8]": ({"wire_precision": "int8"}, {"overlap": False}),
+    "gradient_allreduce[int4]": ({"wire_precision": "int4"}, {"overlap": False}),
 }
 
 # Compressed/decentralized overlap rows paired with their monolithic
@@ -250,6 +257,7 @@ def audit_ddp(algorithms, model="vgg16"):
             "memory": memstats(compiled),
             "compile_s": round(time.time() - t0, 1),
             "buckets": ddp.plan.num_buckets,
+            "bucket_numels": [s.numel for s in ddp.plan.specs],
             "slots": sum(len(s.slots) for s in ddp.plan.specs),
             "overlap": ddp.overlap_enabled,
             "opt_state_bytes_per_chip": opt_bytes // n,
@@ -612,6 +620,204 @@ def assert_zero_census(ddp_results, n):
     )
 
 
+def assert_wire_census(ddp_results, n, wire):
+    """The quantized-ring wire gate (``--wire=int8|int4``, docs/kernels.md).
+
+    The ``gradient_allreduce[<wire>]`` row's compiled step must carry the
+    gradient exchange entirely in-collective: ZERO all-reduces, every ring
+    hop's payload u8 on the wire (int4 ships two nibbles packed per byte —
+    still u8 to XLA), and total wire bytes — collective-permute results are
+    one hop's send; of an all-gather result, (n−1)/n crossed the wire —
+    EQUAL to the modeled :func:`ring_wire_bytes` over the bucket plan and
+    ≤ 0.3× the f32 baseline's ring traffic."""
+    from bagua_tpu.kernels.quantized_ring import ring_wire_bytes
+
+    name = f"gradient_allreduce[{wire}]"
+    row = ddp_results[name]
+    base = ddp_results["gradient_allreduce"]
+    bits = 8 if wire == "int8" else 4
+    buckets = row["buckets"]
+    failures = []
+    if buckets <= 1:
+        failures.append(f"{name}: single-bucket plan — per-bucket ring untestable")
+    ar = row["census"].get("all-reduce", {"count": 0})["count"]
+    if ar != 0:
+        failures.append(
+            f"{name}: {ar} all-reduces, expected none (in-collective quantization)"
+        )
+    cp_u8 = row["census"].get("collective-permute", {}).get("by_dtype", {}).get(
+        "u8", {"count": 0, "bytes": 0}
+    )
+    if cp_u8["count"] < buckets * (n - 1):
+        failures.append(
+            f"{name}: {cp_u8['count']} u8 collective-permutes, expected >= "
+            f"{n - 1} payload hops per bucket × {buckets}"
+        )
+    ag_u8 = row["census"].get("all-gather", {}).get("by_dtype", {}).get(
+        "u8", {"count": 0, "bytes": 0}
+    )
+    if ag_u8["count"] == 0:
+        failures.append(f"{name}: no u8 all-gather — the AG leg must ship compressed")
+    cp_b = _op_bytes(row, "collective-permute")
+    ag_b = _op_bytes(row, "all-gather")
+    q_wire = cp_b + ag_b * (n - 1) // n
+    modeled = sum(ring_wire_bytes(m, n, bits) for m in row["bucket_numels"])
+    if q_wire != modeled:
+        failures.append(
+            f"{name}: census wire bytes {q_wire} != modeled ring_wire_bytes "
+            f"{modeled} over buckets {row['bucket_numels']}"
+        )
+    ar_wire = _op_bytes(base, "all-reduce") * 2 * (n - 1) // n
+    ratio = q_wire / max(1, ar_wire)
+    if ratio > 0.30:
+        failures.append(
+            f"{name}: wire bytes {q_wire} are {ratio:.3f}× the f32 baseline's "
+            f"ring {ar_wire} — gate is 0.30× (payload + minmax sidecar + "
+            f"block padding all included)"
+        )
+    if failures:
+        raise SystemExit(
+            "quantized-ring wire assertion FAILED:\n  " + "\n  ".join(failures)
+        )
+    print(
+        f"[audit] wire quantized-ring census assertion passed ({name}: "
+        f"0 all-reduces, {cp_u8['count']} u8 ring hops over {buckets} buckets, "
+        f"{q_wire} wire B = modeled, {ratio:.3f}x f32 ring {ar_wire} B)",
+        file=sys.stderr,
+    )
+    return {
+        "variant": name,
+        "bits": bits,
+        "block": int(os.environ.get("BAGUA_QR_BLOCK") or 4096),
+        "wire_bytes": q_wire,
+        "modeled_wire_bytes": modeled,
+        "f32_ring_bytes": ar_wire,
+        "ratio_vs_f32": round(ratio, 4),
+        "u8_ring_hops": cp_u8["count"],
+    }
+
+
+def wire_loss_parity_lane(steps=12, tol=0.10):
+    """The convergence-guardrail gate behind the planner allow-list.
+
+    Trains the CI MLP under each wire precision (same data, same init) and
+    certifies the quantized precisions whose final loss lands within ``tol``
+    of the exact-f32 run's.  int8 rides its 256 levels; int4's 16 levels only
+    survive because the error-feedback residual re-enters the next step's
+    gradient — both must certify here, and the certified set IS the
+    allow-list ``plan_precision`` may quantize from."""
+    import bagua_tpu
+    from bagua_tpu.algorithms import build_algorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+    group = bagua_tpu.init_process_group(intra_size=4)
+    n = group.size
+    rng = np.random.RandomState(7)
+    batches = [
+        (jnp.asarray(rng.randn(8 * n, 32).astype(np.float32)),
+         jnp.asarray(rng.randn(8 * n, 8).astype(np.float32)))
+        for _ in range(steps)
+    ]
+    first, final = {}, {}
+    for prec in ("f32", "int8", "int4"):
+        ddp = DistributedDataParallel(
+            mse_loss, optax.sgd(5e-2),
+            build_algorithm("gradient_allreduce", wire_precision=prec),
+            process_group=group, bucket_size_bytes=1 << 12, overlap=False,
+        )
+        state = ddp.init(init_mlp(jax.random.PRNGKey(0), [32, 24, 8]))
+        losses = []
+        for b in batches:
+            state, loss = ddp.train_step(state, b)
+            losses.append(float(np.asarray(loss)[0]))
+        first[prec], final[prec] = losses[0], losses[-1]
+        ddp.shutdown()
+    gate = final["f32"] * (1.0 + tol)
+    allow, failures = [], []
+    for prec in ("int8", "int4"):
+        if not np.isfinite(final[prec]) or final[prec] >= first[prec]:
+            failures.append(f"{prec}: diverged ({first[prec]} -> {final[prec]})")
+        elif final[prec] > gate:
+            failures.append(
+                f"{prec}: final loss {final[prec]:.6f} > {gate:.6f} "
+                f"(f32 {final['f32']:.6f} + {tol:.0%} drift gate)"
+            )
+        else:
+            allow.append(prec)
+    if failures:
+        raise SystemExit(
+            "wire loss-parity assertion FAILED:\n  " + "\n  ".join(failures)
+        )
+    print(
+        f"[audit] wire loss-parity lane passed ({steps} steps, final loss "
+        f"f32={final['f32']:.6f} int8={final['int8']:.6f} "
+        f"int4={final['int4']:.6f}, drift gate {tol:.0%} -> allow-list "
+        f"{allow})",
+        file=sys.stderr,
+    )
+    return {
+        "steps": steps,
+        "drift_tol": tol,
+        "final_loss": {k: round(v, 6) for k, v in final.items()},
+        "allow_list": allow,
+    }
+
+
+def wire_planner_allowlist_lane(allow):
+    """Feed the certified allow-list into the autotune manager and hold the
+    planner to the mixed-precision claim on the recorded VGG16 operating
+    point: under the seed bucket cap the per-bucket chooser must keep small
+    buckets f32 (the 2(n−1)-hop latency floor) and flip the large ones
+    quantized, with the allow-list and the blocked cheaper precisions on
+    record in ``decision_trail["precision_plan"]``."""
+    from bagua_tpu.defs import TensorDeclaration
+    from bagua_tpu.service.autotune_task_manager import AutotuneTaskManager
+
+    path = os.path.join(REPO, "ci", "fixtures", "vgg16_bucket_spans.json")
+    with open(path) as f:
+        fx = json.load(f)
+    mgr = AutotuneTaskManager("vgg16_wire_lane")
+    mgr.tensor_list = [TensorDeclaration(**d) for d in fx["declarations"]]
+    spans = [
+        {"action": "tensor_ready", "tensor_name": name, "start_time": t}
+        for name, t in fx["arrivals"].items()
+    ] + [dict(s, action="bucket_wire", world_size=8) for s in fx["wire_samples"]]
+    mgr.report_spans(spans)
+    sealed = mgr.decision_trail["precision_plan"]
+    assert sealed["allow_list"] == ["f32"] and set(sealed["precisions"]) == {"f32"}, (
+        f"default allow-list must pin every bucket f32: {sealed}"
+    )
+    mgr.set_precision_allow_list(allow)
+    plan = mgr.decision_trail["precision_plan"]
+    chosen = set(plan["precisions"])
+    failures = []
+    if plan["allow_list"] != sorted({"f32"} | set(allow)):
+        failures.append(f"allow-list not recorded: {plan['allow_list']}")
+    if "f32" not in chosen or not chosen & {"int8", "int4"}:
+        failures.append(
+            f"plan must be mixed (latency floor keeps small buckets f32, "
+            f"bandwidth flips large ones): got {plan['precisions']}"
+        )
+    if not plan["total_wire_ms"] < plan["total_wire_ms_f32"]:
+        failures.append(
+            f"quantized plan must price below all-f32: "
+            f"{plan['total_wire_ms']} vs {plan['total_wire_ms_f32']} ms"
+        )
+    if failures:
+        raise SystemExit(
+            "wire planner allow-list assertion FAILED:\n  " + "\n  ".join(failures)
+        )
+    print(
+        f"[audit] wire planner allow-list lane passed "
+        f"({len(plan['precisions'])} buckets -> {plan['precisions']}, "
+        f"wire {plan['total_wire_ms']} ms vs f32 {plan['total_wire_ms_f32']} ms, "
+        f"saved_frac {plan['saved_frac']}, allow_list {plan['allow_list']})",
+        file=sys.stderr,
+    )
+    return plan
+
+
 def audit_fsdp():
     import bagua_tpu
     from bagua_tpu.parallel.fsdp import FSDP, scan_layers
@@ -865,6 +1071,13 @@ EXPECTED = {
     "zero[overlap]": "the reduce-scatter leg anchored inside the backward "
     "pass per bucket (custom_vjp anchor, same as gradient_allreduce[overlap]); "
     "the deferred all-gather already overlaps the forward in both modes",
+    "gradient_allreduce[int8]": "in-collective blockwise quantized ring: u8 "
+    "payload + f32 minmax sidecar collective-permutes per hop, fused "
+    "dequantize→add→requantize between hops, compressed all-gather tail — "
+    "zero full-precision all-reduces",
+    "gradient_allreduce[int4]": "same ring at 16 levels, two nibbles packed "
+    "per wire byte; the error-feedback residual (algorithm state) keeps it "
+    "convergent — gated by the loss-parity lane",
 }
 
 
@@ -1072,8 +1285,21 @@ def main():
         help="audit ONE algorithm plus its [overlap] variant (tier-1 lane: "
         "--quick --algo=bytegrad exercises the compressed census gate)",
     )
+    ap.add_argument(
+        "--wire", choices=("int8", "int4"), default=None,
+        help="quantized-ring wire lane: census + byte gate for the "
+        "gradient_allreduce[<wire>] row, the loss-parity guardrail, and the "
+        "planner allow-list gate (tier-1 lane: --quick --wire=int8)",
+    )
     ap.add_argument("--out", default=os.path.join(REPO, "PERF_AUDIT"))
     args = ap.parse_args()
+
+    if args.wire:
+        # MLP-scale ring shards pad badly at the 4096-elem default block
+        # (shard ≈ 1–2k elems), which would swamp the byte gate with zeros;
+        # 128 keeps padding + sidecar overhead honest at this scale.  The
+        # knob is read per trace, so setting it here covers every build.
+        os.environ.setdefault("BAGUA_QR_BLOCK", "128")
 
     if args.model == "tp":
         # The tp lane is self-contained (no DDP/FSDP audit, no markdown);
@@ -1091,7 +1317,10 @@ def main():
         "gradient_allreduce", "gradient_allreduce[flat]",
         "gradient_allreduce[overlap]", "gradient_allreduce[overlap,flat]",
     ]
-    if args.algo == "zero":
+    if args.wire:
+        # The wire gate compares against the all-reduce baseline row.
+        algos = ["gradient_allreduce", f"gradient_allreduce[{args.wire}]"]
+    elif args.algo == "zero":
         # The sharded gate compares against the all-reduce baseline row.
         algos = ["gradient_allreduce", "zero", "zero[overlap]"]
     elif args.algo:
@@ -1113,6 +1342,16 @@ def main():
     assert_overlap_census(ddp_results)
     assert_compressed_overlap_census(ddp_results)
     assert_zero_census(ddp_results, n)
+    # Quantized-ring wire gates: compiled census + byte gate, then the
+    # loss-parity guardrail whose certified allow-list feeds the planner's
+    # per-bucket precision choice on the recorded VGG16 operating point.
+    wire_result = None
+    if args.wire:
+        wire_result = assert_wire_census(ddp_results, n, args.wire)
+        wire_result["loss_parity"] = wire_loss_parity_lane()
+        wire_result["precision_plan"] = wire_planner_allowlist_lane(
+            wire_result["loss_parity"]["allow_list"]
+        )
     # Executed telemetry gate: emits + schema-validates the metrics stream
     # next to --out and asserts a retrace-free steady state.
     telemetry_smoke(args.out)
@@ -1123,7 +1362,7 @@ def main():
     # it, hold the resumed state bitwise-equal to an uninterrupted run (the
     # --algo lanes skip it — one execution per CI run is the evidence).
     resilience_result = None
-    if args.algo is None:
+    if args.algo is None and args.wire is None:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         import fault_injection
 
@@ -1139,6 +1378,7 @@ def main():
             {"ddp": ddp_results, "fsdp": fsdp_result, "mesh": n,
              "model": args.model, "trace_overlap": trace,
              "autotune_planner": planner_result,
+             "wire": wire_result,
              "resilience": resilience_result},
             f, indent=1,
         )

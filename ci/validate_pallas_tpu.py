@@ -13,6 +13,8 @@ The kernels under test (reference analog:
 * ``block_attention_pallas`` (``kernels/flash_attention.py``)
 * ``matmul_tile_pallas`` (``kernels/collective_matmul.py`` — the tile GEMM
   the ``ag_matmul``/``matmul_rs`` rings interleave with ``ppermute``)
+* ``hop_dequant_add_requant_pallas`` (``kernels/quantized_ring.py`` — the
+  fused dequant→add→requant hop of the int8/int4 quantized ring)
 
 If Mosaic rejects a kernel, the failure lands in the JSON (and the kernels'
 env kill-switches — ``BAGUA_TPU_PALLAS_MINMAX`` / ``BAGUA_TPU_PALLAS_FLASH``
@@ -450,6 +452,59 @@ def validate_collective_matmul(interpret, report):
     report.append(entry)
 
 
+def validate_quantized_ring_hop(interpret, report):
+    """The fused dequantize→add→requantize ring hop behind the quantized
+    reduce-scatter (``kernels/quantized_ring.py``).  Bitwise parity on the
+    requantized payload AND the sum-space error is the contract: the payload
+    travels the ring (a differing byte desyncs every downstream hop) and the
+    error feeds the per-bucket error-feedback residual.  Its record gates
+    ``BAGUA_PALLAS_QUANTIZED_RING`` auto-ON via ``validated_on_hardware``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bagua_tpu.kernels.quantized_ring import (
+        _compressors,
+        hop_dequant_add_requant,
+        hop_dequant_add_requant_pallas,
+    )
+
+    for bits, block in ((8, 4096), (4, 8192)):
+        entry = {"kernel": f"quantized_ring_hop_int{bits}"}
+        try:
+            # One travelling shard at a bucket-sized shape (the unit the ring
+            # runs n-1 times per bucket).
+            nblocks = 4 if INTERPRET_SMOKE else 4096
+            rs = np.random.RandomState(6 + bits)
+            comp, _ = _compressors(bits)
+            incoming = jnp.asarray(rs.randn(nblocks, block).astype(np.float32))
+            local = jnp.asarray(rs.randn(nblocks, block).astype(np.float32))
+            q, mm = comp(incoming)
+            jax.block_until_ready((q, mm))
+            q_p, mm_p, err_p = hop_dequant_add_requant_pallas(
+                q, mm, local, bits=bits, interpret=interpret
+            )
+            q_j, mm_j, err_j = hop_dequant_add_requant(q, mm, local, bits=bits)
+            jax.block_until_ready((q_p, q_j))
+            entry["payload_bitwise_equal"] = bool(jnp.array_equal(q_p, q_j))
+            entry["err_bitwise_equal"] = bool(jnp.array_equal(err_p, err_j))
+            entry["minmax_max_abs_diff"] = float(jnp.max(jnp.abs(mm_p - mm_j)))
+            entry["pallas_ms"] = round(bench(
+                lambda: hop_dequant_add_requant_pallas(
+                    q, mm, local, bits=bits, interpret=interpret)), 3)
+            entry["jnp_ms"] = round(bench(
+                lambda: hop_dequant_add_requant(q, mm, local, bits=bits)), 3)
+            entry["ok"] = (
+                entry["payload_bitwise_equal"]
+                and entry["err_bitwise_equal"]
+                and entry["minmax_max_abs_diff"] < 1e-5
+            )
+        except Exception as e:  # noqa: BLE001 — Mosaic rejection is a finding, not a crash
+            entry["ok"] = False
+            entry["error"] = f"{type(e).__name__}: {e}"[:800]
+        report.append(entry)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--interpret", action="store_true",
@@ -475,6 +530,7 @@ def main():
     validate_fused_reduce(args.interpret, report)
     validate_flash(args.interpret, report)
     validate_collective_matmul(args.interpret, report)
+    validate_quantized_ring_hop(args.interpret, report)
 
     result = {
         "backend": backend,

@@ -4,23 +4,39 @@
 import numpy as np
 
 EPS = 1e-7
+# Degenerate-range guard terms — mirror bagua_tpu.kernels.minmax_uint8.
+REL_EPS = 1e-35
+F32_MAX = 3.4028235e38
+
+
+def oracle_scale(mn, mx, levels=255.0):
+    """Bounded-denominator scale (mirrors ``minmax_uint8._safe_scale``):
+    the relative term keeps ``rint(mx * scale)`` representable for
+    near-constant chunks at extreme magnitude, the clamp keeps scale > 0
+    when the range itself overflows f32; both vanish in f32 rounding for
+    any sane chunk."""
+    amax = np.maximum(np.abs(mn), np.abs(mx))
+    return np.float32(levels) / np.minimum(
+        mx - mn + np.float32(EPS) + np.float32(REL_EPS) * amax,
+        np.float32(F32_MAX),
+    )
 
 
 def oracle_compress(chunks: np.ndarray):
     mn = chunks.min(axis=1, keepdims=True)
     mx = chunks.max(axis=1, keepdims=True)
-    scale = 255.0 / (mx - mn + EPS)
+    scale = oracle_scale(mn, mx)
     upper = np.rint(mx * scale)
     lower = upper - 255.0
-    q = (np.minimum(np.rint(chunks * scale), upper) - lower).astype(np.uint8)
-    return q, np.concatenate([mn, mx], axis=1)
+    q = np.minimum(np.rint(chunks * scale), upper) - lower
+    return q.astype(np.uint8), np.concatenate([mn, mx], axis=1)
 
 
 def oracle_decompress(q: np.ndarray, minmax: np.ndarray):
     mn, mx = minmax[:, 0:1], minmax[:, 1:2]
-    scale = 255.0 / (mx - mn + EPS)
+    scale = oracle_scale(mn, mx)
     lower = np.rint(mx * scale) - 255.0
-    return (q.astype(np.float32) + lower) / scale
+    return ((q.astype(np.float32) + lower) / scale).astype(np.float32)
 
 
 def oracle_compressed_allreduce(per_rank: np.ndarray, average: bool = True):

@@ -99,6 +99,93 @@ def test_constant_chunk_roundtrip(value):
     np.testing.assert_allclose(x, chunks, atol=1e-4)
 
 
+@pytest.mark.parametrize(
+    "value", [1e32, -1e35, 3.4e38], ids=["1e32", "-1e35", "f32max"]
+)
+def test_constant_chunk_huge_magnitude(value):
+    """Constant blocks at huge magnitude: the EPS term alone leaves
+    ``mx * scale`` overflowing f32 to inf, and ``upper - lower`` becomes NaN.
+    The bounded-denominator scale keeps everything finite: q degenerates to 0
+    (the 255-level offset is absorbed by the huge bounds) and the round-trip
+    reconstructs the constant to f32 rounding, on both backends and in the
+    numpy oracle — bitwise-identical q between all three."""
+    chunks = np.full((2, 4096), value, np.float32)
+    q_ref, mm_ref = compress_minmax_uint8(jnp.asarray(chunks))
+    q_pl, mm_pl = compress_minmax_uint8_pallas(jnp.asarray(chunks), interpret=True)
+    oq, omm = oracle_compress(chunks)
+    np.testing.assert_array_equal(np.asarray(q_ref), oq)
+    np.testing.assert_array_equal(np.asarray(q_pl), oq)
+    assert (np.asarray(q_ref) == 0).all()
+    for dec in (
+        np.asarray(decompress_minmax_uint8(q_ref, mm_ref)),
+        np.asarray(decompress_minmax_uint8_pallas(q_pl, mm_pl, interpret=True)),
+        oracle_decompress(oq, omm),
+    ):
+        assert np.isfinite(dec).all()
+        np.testing.assert_allclose(dec, chunks, rtol=1e-6)
+
+
+def test_mixed_constant_and_varying_chunks():
+    """The scale bound is per-chunk: a batch mixing in-range chunks with an
+    overflow-prone constant one must quantize the former bitwise as the
+    unguarded scheme would (the guard terms vanish in f32 rounding) while
+    keeping the latter finite, bitwise across backends and vs the numpy
+    oracle.  The all-zero chunk round-trips exactly (its scale is the plain
+    255 / EPS, and q + lower is exactly zero)."""
+    rng = np.random.RandomState(8)
+    chunks = rng.randn(4, 4096).astype(np.float32)
+    chunks[1] = 0.0
+    chunks[3] = -2.5e33  # degenerate AND overflow-prone
+    q_ref, mm_ref = compress_minmax_uint8(jnp.asarray(chunks))
+    q_pl, mm_pl = compress_minmax_uint8_pallas(jnp.asarray(chunks), interpret=True)
+    oq, omm = oracle_compress(chunks)
+    np.testing.assert_array_equal(np.asarray(q_ref), oq)
+    np.testing.assert_array_equal(np.asarray(q_pl), oq)
+    dec = np.asarray(decompress_minmax_uint8(q_ref, mm_ref))
+    assert not np.isnan(dec).any()
+    np.testing.assert_array_equal(dec[1], chunks[1])
+    np.testing.assert_allclose(dec[3], chunks[3], rtol=1e-6)
+    level = (chunks[0].max() - chunks[0].min()) / 255.0
+    assert np.abs(dec[0] - chunks[0]).max() <= level * 1.01
+
+
+@pytest.mark.parametrize(
+    "shape", [(3, 100), (1, 7), (5, 129)], ids=["3x100", "1x7", "5x129"]
+)
+def test_constant_unaligned_last_block_shapes(shape):
+    """Degenerate blocks at shapes the Pallas kernels can't tile (unaligned
+    last-block sizes): the jnp fallback must apply the same bounded scale,
+    bitwise vs the oracle, with no NaNs."""
+    chunks = np.full(shape, 1.7e33, np.float32)
+    q_ref, mm_ref = compress_minmax_uint8(jnp.asarray(chunks))
+    q_pl, mm_pl = compress_minmax_uint8_pallas(jnp.asarray(chunks), interpret=True)
+    oq, omm = oracle_compress(chunks)
+    np.testing.assert_array_equal(np.asarray(q_ref), oq)
+    np.testing.assert_array_equal(np.asarray(q_pl), oq)
+    dec = np.asarray(decompress_minmax_uint8_pallas(q_pl, mm_pl, interpret=True))
+    assert np.isfinite(dec).all()
+    np.testing.assert_allclose(dec, chunks, rtol=1e-6)
+
+
+def test_fused_reducer_huge_constant_no_nan():
+    """The fused dequant-reduce-requant hits the degenerate regime twice —
+    on the incoming per-peer minmax and on the reduced chunk's requantize.
+    Huge-magnitude constants must survive both without NaN, bitwise between
+    the jnp composition and the Pallas kernel."""
+    const = jnp.full((4, 4096), 8.8e33, jnp.float32)
+    qc, mmc = compress_minmax_uint8(const)
+    q_j, mm_j = decompress_reduce_requantize(qc, mmc, average=True)
+    q_p, mm_p = decompress_reduce_requantize_pallas(
+        qc, mmc, average=True, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(q_p), np.asarray(q_j))
+    np.testing.assert_allclose(np.asarray(mm_p), np.asarray(mm_j), rtol=1e-6)
+    out = np.asarray(decompress_minmax_uint8(q_j, mm_j))
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, np.full((1, 4096), 8.8e33, np.float32),
+                               rtol=1e-6)
+
+
 @pytest.mark.parametrize("average", [True, False], ids=["avg", "sum"])
 def test_fused_reducer_matches_staged_composition(average):
     """``decompress_reduce_requantize`` fuses ByteGrad's middle three stages.

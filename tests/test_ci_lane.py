@@ -185,6 +185,69 @@ def test_perf_audit_quick_zero_sharded_census(tmp_path):
         assert ratio <= 0.2, ratio
 
 
+def test_perf_audit_quick_wire_int8_quantized_census(tmp_path):
+    """Tier-1 lane for the quantized-ring wire gates: ``--quick --wire=int8``
+    audits the in-collective blockwise quantization — zero all-reduces with
+    u8-packed per-hop payloads at ≤0.3× the f32 ring bytes, the loss-parity
+    guardrail certifying int8 AND int4(+EF), and that allow-list flowing
+    into the planner's mixed per-bucket precision plan on the recorded VGG16
+    operating point."""
+    out = tmp_path / "audit_wire"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "ci", "perf_audit.py"),
+            "--quick", "--wire=int8", "--model=mlp", "--ddp-only",
+            "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (
+        f"perf_audit --quick --wire=int8 failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    assert "wire quantized-ring census assertion passed" in proc.stderr
+    assert "wire loss-parity lane passed" in proc.stderr
+    assert "wire planner allow-list lane passed" in proc.stderr
+
+    with open(str(out) + ".json") as f:
+        audit = json.load(f)
+    rows = audit["ddp"]
+    assert "gradient_allreduce" in rows and "gradient_allreduce[int8]" in rows
+    row = rows["gradient_allreduce[int8]"]
+    assert row["buckets"] > 1
+    # in-collective quantization: the full-precision exchange is GONE, the
+    # inter-hop payload crosses u8-packed (n-1 hops per bucket), and the AG
+    # tail ships compressed too
+    n = 8  # the subprocess builds its own 8-device CPU sim
+    assert row["census"].get("all-reduce", {"count": 0})["count"] == 0
+    cp_u8 = row["census"]["collective-permute"]["by_dtype"]["u8"]
+    assert cp_u8["count"] >= row["buckets"] * (n - 1)
+    assert row["census"]["all-gather"]["by_dtype"]["u8"]["count"] > 0
+
+    # The byte gate's recorded numbers: census == modeled ring_wire_bytes,
+    # and ≤ 0.3× the f32 baseline's ring traffic (re-check so a
+    # silently-skipped lane can't pass).
+    wire = audit["wire"]
+    assert wire["variant"] == "gradient_allreduce[int8]" and wire["bits"] == 8
+    assert wire["wire_bytes"] == wire["modeled_wire_bytes"]
+    assert 0 < wire["wire_bytes"] <= 0.3 * wire["f32_ring_bytes"]
+
+    # The convergence guardrail certified both quantized precisions (int4
+    # only survives through error feedback), and the planner turned that
+    # allow-list into a genuinely mixed per-bucket plan: the 2(n-1)-hop
+    # latency floor keeps small buckets f32 while bandwidth flips large
+    # ones quantized.
+    assert wire["loss_parity"]["allow_list"] == ["int8", "int4"]
+    plan = wire["precision_plan"]
+    assert plan["allow_list"] == ["f32", "int4", "int8"]
+    chosen = set(plan["precisions"])
+    assert "f32" in chosen and chosen & {"int8", "int4"}, plan["precisions"]
+    assert plan["total_wire_ms"] < plan["total_wire_ms_f32"]
+    assert 0.0 < plan["saved_frac"] < 1.0
+
+
 def test_perf_audit_quick_tp_collective_matmul(tmp_path):
     """Tier-1 lane for the collective-matmul gates: fused-vs-oracle bitwise
     parity (interpret mode), the zero-all-reduce census of the fused

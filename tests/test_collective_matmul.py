@@ -28,10 +28,10 @@ def ring_mesh(n):
     return Mesh(np.array(jax.devices()[:n]), ("tp",))
 
 
-def run_ag(n, x, w, dot=None):
+def run_ag(n, x, w, dot=None, ring="uni"):
     fn = jax.jit(
         jax.shard_map(
-            lambda a, b: ag_matmul(a, b, "tp", dot=dot),
+            lambda a, b: ag_matmul(a, b, "tp", dot=dot, ring=ring),
             mesh=ring_mesh(n),
             in_specs=(P("tp", None), P(None, None)),
             out_specs=P(None, None),
@@ -41,10 +41,10 @@ def run_ag(n, x, w, dot=None):
     return np.asarray(fn(x, w))
 
 
-def run_rs(n, x, w, dot=None):
+def run_rs(n, x, w, dot=None, ring="uni"):
     fn = jax.jit(
         jax.shard_map(
-            lambda a, b: matmul_rs(a, b, "tp", dot=dot),
+            lambda a, b: matmul_rs(a, b, "tp", dot=dot, ring=ring),
             mesh=ring_mesh(n),
             in_specs=(P(None, "tp"), P("tp", None)),
             out_specs=P("tp", None),
@@ -158,6 +158,101 @@ def test_ring_grads_match_oracle_composition():
 
     for a, b in zip(grads(dot), grads(None)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+# -- bidirectional ring ("bidir": two counter-rotating half-arcs) ------------
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+def test_ag_matmul_bidir_bitwise_matches_uni(n):
+    """The bidirectional all-gather ring only changes the transport — every
+    source block is still multiplied whole by the same dot — so its output is
+    BITWISE the unidirectional ring's, on arbitrary floats, even/odd ring
+    sizes included."""
+    rng = np.random.RandomState(10)
+    x = jnp.asarray(rng.randn(n * 6, 16).astype(np.float32))
+    w = jnp.asarray(rng.randn(16, 24).astype(np.float32))
+    np.testing.assert_array_equal(
+        run_ag(n, x, w, ring="bidir"), run_ag(n, x, w)
+    )
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+def test_matmul_rs_bidir_matches_psum_dot(n):
+    """The bidirectional reduce-scatter sums the same partial products over
+    two arcs — correct to f32 rounding against the psum'd product."""
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(n * 4, n * 8).astype(np.float32))
+    w = jnp.asarray(rng.randn(n * 8, 24).astype(np.float32))
+    got = run_rs(n, x, w, ring="bidir")
+    np.testing.assert_allclose(got, np.asarray(x @ w), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+def test_matmul_rs_bidir_bitwise_on_exact_sums(n):
+    """Pinning the arc algebra against the unidirectional oracle: on
+    integer-valued operands every partial product and serial sum is exact in
+    f32, so any source double-counted, dropped, or misrouted by the two-arc
+    schedule shows up as a bitwise mismatch."""
+    rng = np.random.RandomState(12)
+    x = jnp.asarray(rng.randint(-4, 5, size=(n * 4, n * 8)).astype(np.float32))
+    w = jnp.asarray(rng.randint(-4, 5, size=(n * 8, 24)).astype(np.float32))
+    np.testing.assert_array_equal(
+        run_rs(n, x, w, ring="bidir"), run_rs(n, x, w)
+    )
+
+
+def test_bidir_pallas_tile_matches_bidir_oracle():
+    """The pluggable tile GEMM composes with the bidirectional ring exactly
+    as with the unidirectional one: pallas-interpret dots inside both arcs,
+    bitwise vs the jnp-dot bidir composition."""
+    n = 4
+    dot = functools.partial(matmul_tile_pallas, interpret=True, tile_m=4, tile_n=4)
+    rng = np.random.RandomState(13)
+    xa = jnp.asarray(rng.randn(n * 5, 7).astype(np.float32))
+    wa = jnp.asarray(rng.randn(7, 10).astype(np.float32))
+    np.testing.assert_array_equal(
+        run_ag(n, xa, wa, dot=dot, ring="bidir"), run_ag(n, xa, wa, ring="bidir")
+    )
+    xr = jnp.asarray(rng.randn(n * 3, n * 2).astype(np.float32))
+    wr = jnp.asarray(rng.randn(n * 2, 6).astype(np.float32))
+    np.testing.assert_array_equal(
+        run_rs(n, xr, wr, dot=dot, ring="bidir"), run_rs(n, xr, wr, ring="bidir")
+    )
+
+
+def test_bidir_ring_grads_match_uni():
+    """Autodiff through the two-arc rings (plain unrolled loops) lands on the
+    unidirectional grads to f32 rounding."""
+    n = 4
+    rng = np.random.RandomState(14)
+    x = jnp.asarray(rng.randn(n * 3, n * 2).astype(np.float32))
+    w = jnp.asarray(rng.randn(n * 2, 6).astype(np.float32))
+
+    def grads(ring):
+        fn = jax.jit(
+            jax.shard_map(
+                jax.grad(
+                    lambda a, b: jnp.sum(matmul_rs(a, b, "tp", ring=ring) ** 2),
+                    argnums=(0, 1),
+                ),
+                mesh=ring_mesh(n),
+                in_specs=(P(None, "tp"), P("tp", None)),
+                out_specs=(P(None, "tp"), P("tp", None)),
+                check_vma=False,
+            )
+        )
+        return fn(x, w)
+
+    for a, b in zip(grads("bidir"), grads("uni")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_unknown_ring_raises():
+    with pytest.raises(ValueError, match="ring must be"):
+        run_ag(2, jnp.zeros((4, 4)), jnp.zeros((4, 4)), ring="spiral")
+    with pytest.raises(ValueError, match="ring must be"):
+        run_rs(2, jnp.zeros((4, 4)), jnp.zeros((4, 4)), ring="spiral")
 
 
 def test_matmul_rs_indivisible_raises():

@@ -559,3 +559,171 @@ def test_describe_includes_pp_row():
     rows = CostModel().describe()
     assert "pp" in rows
     assert set(rows["pp"]) == {"alpha_us", "beta_gbps", "n_samples"}
+
+
+# -- quantized-ring (qr8/qr4) legs and the per-bucket precision chooser ------
+
+
+def test_cost_model_fits_qr_legs_from_samples():
+    from bagua_tpu.service.planner import DEFAULT_QR4, DEFAULT_QR8
+
+    qr8 = AlphaBeta(alpha=25e-6, beta=110e9)
+    qr4 = AlphaBeta(alpha=45e-6, beta=70e9)
+    samples = [
+        WireSample(nbytes=n, seconds=qr8.predict(n), leg="qr8")
+        for n in (1 << 18, 1 << 20, 1 << 22)
+    ] + [
+        WireSample(nbytes=n, seconds=qr4.predict(n), leg="qr4")
+        for n in (1 << 17, 1 << 19, 1 << 21)
+    ]
+    cm = CostModel.from_samples(samples)
+    assert cm.qr8.alpha == pytest.approx(qr8.alpha, rel=1e-6)
+    assert cm.qr8.beta == pytest.approx(qr8.beta, rel=1e-6)
+    assert cm.qr4.alpha == pytest.approx(qr4.alpha, rel=1e-6)
+    # no samples on a leg -> its prior; describe carries both rows
+    assert CostModel.from_samples([]).qr8 is DEFAULT_QR8
+    assert CostModel.from_samples([]).qr4 is DEFAULT_QR4
+    assert {"qr8", "qr4"} <= set(CostModel().describe())
+
+
+def test_quantized_hop_bytes_matches_kernel_accounting():
+    """The planner's jax-free hop-byte mirror must agree exactly with the
+    kernel module's ``ring_wire_bytes`` (2(n-1) hops per ring allreduce) —
+    the drift guard for the deliberately duplicated formula."""
+    from bagua_tpu.kernels.quantized_ring import ring_wire_bytes
+    from bagua_tpu.service.planner import quantized_hop_bytes
+
+    for numel in (1, 244, 4096, 12345678, 16 << 20):
+        for n in (2, 4, 8, 32):
+            for bits in (8, 4):
+                assert (
+                    quantized_hop_bytes(numel, n, bits) * 2 * (n - 1)
+                    == ring_wire_bytes(numel, n, bits)
+                ), (numel, n, bits)
+    assert quantized_hop_bytes(1 << 20, 1, 8) == 0
+
+
+def test_quantized_ring_wire_time_formula():
+    from bagua_tpu.service.planner import quantized_hop_bytes
+
+    qr8 = AlphaBeta(alpha=30e-6, beta=90e9)
+    cm = CostModel(qr8=qr8)
+    numel, n = 16 << 20, 8
+    hop = quantized_hop_bytes(numel, n, 8)
+    expect = 2 * (n - 1) * qr8.predict(hop)
+    assert cm.quantized_ring_wire_time(numel, n, "int8") == pytest.approx(expect)
+    # leg aliases and degenerate rings
+    assert cm.quantized_ring_wire_time(numel, n, "qr8") == pytest.approx(expect)
+    assert cm.quantized_ring_wire_time(numel, 1, "int4") == 0.0
+
+
+def test_plan_precision_guardrail_allowlist():
+    """The allow-list is the convergence guardrail: a quantized precision
+    that would win on predicted wire time is only *chosen* once certified;
+    until then it shows up as ``blocked`` in the record."""
+    ds = decls([16 << 20])  # 64 MiB bucket: quantization clearly pays
+    planner = BucketPlanner(ds, {"t0": 0.0})
+    buckets = [[ds[0]]]
+    rec = planner.plan_precision(buckets, n_ranks=8)  # default allow: f32 only
+    assert rec["precisions"] == ["f32"]
+    assert rec["allow_list"] == ["f32"]
+    assert set(rec["per_bucket"][0]["blocked"]) == {"int8", "int4"}
+    assert rec["saved_frac"] == 0.0
+    # certify int8 only: it gets chosen, int4 (cheaper still at this size)
+    # stays blocked
+    rec8 = planner.plan_precision(buckets, n_ranks=8, allowed=("f32", "int8"))
+    assert rec8["precisions"] == ["int8"]
+    assert rec8["per_bucket"][0]["candidate_us"]["int8"] < rec8["per_bucket"][0][
+        "candidate_us"
+    ]["f32"]
+    assert rec8["total_wire_ms"] < rec8["total_wire_ms_f32"]
+    with pytest.raises(ValueError, match="unknown wire precisions"):
+        planner.plan_precision(buckets, n_ranks=8, allowed=("bf16",))
+
+
+def test_plan_precision_latency_floor_keeps_small_buckets_f32():
+    """2(n-1) quantized hops carry a real latency floor: a tiny bucket is
+    cheaper as one f32 collective even with everything certified, while a
+    huge one flips to the quantized ring — the mixed plan emerges from the
+    cost model, not from a hand-set threshold."""
+    small, big = decls([64]), decls([64 << 20], prefix="b")
+    planner = BucketPlanner(small + big, {"t0": 0.0, "b0": 0.1})
+    rec = planner.plan_precision(
+        [[small[0]], [big[0]]], n_ranks=8, allowed=("f32", "int8", "int4")
+    )
+    assert rec["precisions"][0] == "f32"
+    assert rec["precisions"][1] in ("int8", "int4")
+    assert rec["per_bucket"][0]["blocked"] == []  # f32 genuinely won
+
+
+def test_plan_precision_nonfloat_and_degenerate_ring_stay_f32():
+    ds = decls([1 << 22], dtype="i32")
+    planner = BucketPlanner(ds, {"t0": 0.0})
+    rec = planner.plan_precision([[ds[0]]], n_ranks=8, allowed=("f32", "int8"))
+    assert rec["precisions"] == ["f32"]
+    assert "int8" not in rec["per_bucket"][0]["candidate_us"]
+    fds = decls([1 << 22])
+    solo = BucketPlanner(fds, {"t0": 0.0})
+    rec1 = solo.plan_precision([[fds[0]]], n_ranks=1, allowed=("f32", "int8"))
+    assert rec1["precisions"] == ["f32"]
+
+
+def test_plan_precision_sharded_prices_half_ring():
+    """zero's gradient leg is only the reduce-scatter half of the quantized
+    ring (the deferred param all-gather stays f32), so the sharded pattern's
+    quantized candidate is exactly half the allreduce pattern's."""
+    ds = decls([16 << 20])
+    ar = BucketPlanner(ds, {"t0": 0.0}, wire_pattern="allreduce")
+    sh = BucketPlanner(ds, {"t0": 0.0}, wire_pattern="sharded")
+    a = ar.plan_precision([[ds[0]]], n_ranks=8, allowed=("f32", "int8"))
+    s = sh.plan_precision([[ds[0]]], n_ranks=8, allowed=("f32", "int8"))
+    assert s["per_bucket"][0]["candidate_us"]["int8"] == pytest.approx(
+        a["per_bucket"][0]["candidate_us"]["int8"] / 2, rel=1e-3
+    )
+
+
+def test_fixture_precision_plan_is_mixed():
+    """The acceptance operating point: on the recorded VGG16 spans, under the
+    seed 10 MiB cap and an 8-rank ring with every precision certified, the
+    chooser lands a genuinely mixed plan — small/late buckets stay f32 (hop
+    latency floor), mid buckets ride int8, the big dense bucket int4 — and
+    the record carries the allow-list the guardrail applied."""
+    fx = json.load(open(FIXTURE))
+    ds = [TensorDeclaration(**d) for d in fx["declarations"]]
+    cm = CostModel.from_samples([WireSample(**s) for s in fx["wire_samples"]])
+    planner = BucketPlanner(ds, fx["arrivals"], cost_model=cm, overlap_efficiency=0.0)
+    dp = planner.plan(max_bucket_bytes=fx["seed_bucket_size_bytes"])
+    rec = planner.plan_precision(
+        dp.buckets, n_ranks=8, allowed=("f32", "int8", "int4")
+    )
+    chosen = set(rec["precisions"])
+    assert "f32" in chosen and chosen & {"int8", "int4"}, rec["precisions"]
+    assert len(chosen) >= 2
+    assert rec["allow_list"] == ["f32", "int4", "int8"]
+    assert rec["total_wire_ms"] < rec["total_wire_ms_f32"]
+    assert 0.0 < rec["saved_frac"] < 1.0
+    assert len(rec["precisions"]) == dp.n_buckets == len(rec["per_bucket"])
+
+
+def test_manager_precision_allowlist_feeds_decision_trail():
+    """Service side: bucket_wire spans carry world_size; the default trail
+    shows the guardrail blocking quantization, and installing a certified
+    allow-list re-chooses precisions in place."""
+    from bagua_tpu.service.autotune_task_manager import AutotuneTaskManager
+
+    mgr = AutotuneTaskManager("m", planner_mode="warmstart")
+    mgr.tensor_list = decls([1 << 22] * 4)  # 16 MiB each: quantization pays
+    span = wire_span(nbytes=1 << 24, seconds=2e-3, hidden_frac=0.0)
+    span["world_size"] = 8
+    mgr.report_spans(ready_spans((f"t{i}", 0.01 * i) for i in range(4)) + [span])
+    trail = mgr.decision_trail["precision_plan"]
+    assert trail is not None
+    assert trail["allow_list"] == ["f32"] and trail["n_ranks"] == 8
+    assert set(trail["precisions"]) == {"f32"}
+    assert any(row["blocked"] for row in trail["per_bucket"])
+    mgr.set_precision_allow_list(["int8", "int4"])
+    trail = mgr.decision_trail["precision_plan"]
+    assert trail["allow_list"] == ["f32", "int4", "int8"]
+    assert set(trail["precisions"]) & {"int8", "int4"}
+    with pytest.raises(ValueError, match="unknown wire precisions"):
+        mgr.set_precision_allow_list(["fp8"])

@@ -1,0 +1,368 @@
+"""Quantized ring reduce-scatter / all-gather correctness.
+
+The per-hop fused op is pinned bitwise between its jnp oracle and the Pallas
+kernel (interpret mode), and the full ring is pinned bitwise against an
+explicit per-package schedule simulation — the ring's arrival order is part
+of the wire contract, so a single differing byte at any hop is a bug, not
+noise.  Error feedback is checked as a convergence property: the residual
+re-entering the input drives the time-averaged output to the true mean far
+below the one-shot quantization error.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from bagua_tpu.communication import ALL_AXES
+from bagua_tpu.kernels.minmax_uint8 import (
+    compress_minmax_uint8,
+    decompress_minmax_uint8,
+)
+from bagua_tpu.kernels.quantized_ring import (
+    compress_minmax_uint4,
+    decompress_minmax_uint4,
+    get_ring_hop,
+    hop_dequant_add_requant,
+    hop_dequant_add_requant_pallas,
+    quantized_allgather,
+    quantized_ring_allreduce,
+    quantized_ring_reduce_scatter,
+    resolve_block,
+    ring_wire_bytes,
+)
+
+
+def _compressors(bits):
+    if bits == 8:
+        return compress_minmax_uint8, decompress_minmax_uint8
+    return compress_minmax_uint4, decompress_minmax_uint4
+
+
+# ---------------------------------------------------------------------------
+# int4 blockwise codec
+# ---------------------------------------------------------------------------
+
+
+def test_uint4_roundtrip_error_bound():
+    rng = np.random.RandomState(0)
+    blocks = rng.randn(4, 512).astype(np.float32) * 3.0
+    packed, mm = compress_minmax_uint4(jnp.asarray(blocks))
+    assert packed.shape == (4, 256) and packed.dtype == jnp.uint8
+    x = np.asarray(decompress_minmax_uint4(packed, mm))
+    level = (blocks.max(1) - blocks.min(1)) / 15.0
+    assert np.abs(x - blocks).max() <= level.max() * 1.01
+
+
+def test_uint4_packing_layout():
+    """Element j rides the low nibble of byte j, element j + B/2 the high
+    nibble — the wire format is part of the contract."""
+    blocks = jnp.asarray(np.linspace(0.0, 15.0, 8, dtype=np.float32)[None])
+    packed, mm = compress_minmax_uint4(blocks)
+    p = np.asarray(packed)[0]
+    lo, hi = p & 0xF, p >> 4
+    q = np.concatenate([lo, hi]).astype(np.float32)
+    # linspace over [0, 15] quantizes to its own rounded levels
+    np.testing.assert_array_equal(q, np.rint(np.linspace(0, 15, 8)))
+
+
+def test_uint4_constant_block_guard():
+    """Constant blocks: the EPS-regularized scale is huge, so at extreme
+    magnitude ``mx * scale`` would overflow — the bounded-denominator scale
+    (``minmax_uint8._safe_scale``) keeps it finite with no branch: q
+    degenerates to 0 (the 15-level offset is absorbed by the huge bounds)
+    and the round-trip reconstructs the constant to f32 rounding.  In-range
+    constants take the bitwise-unchanged normal path and round-trip to float
+    tolerance with no NaN."""
+    for v in (2.7e33, -8e31):  # overflow regime: near-exact reconstruction
+        blocks = np.full((2, 64), v, np.float32)
+        packed, mm = compress_minmax_uint4(jnp.asarray(blocks))
+        assert (np.asarray(packed) == 0).all()
+        x = np.asarray(decompress_minmax_uint4(packed, mm))
+        assert np.isfinite(x).all()
+        np.testing.assert_allclose(x, blocks, rtol=1e-6)
+    for v in (0.0, -3.0):  # in-range constants: normal path, tiny error
+        blocks = np.full((2, 64), v, np.float32)
+        packed, mm = compress_minmax_uint4(jnp.asarray(blocks))
+        x = np.asarray(decompress_minmax_uint4(packed, mm))
+        assert not np.isnan(x).any()
+        np.testing.assert_allclose(x, blocks, rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Per-hop fused op: jnp oracle vs Pallas (interpret)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "bits,block", [(8, 4096), (4, 8192)], ids=["int8", "int4"]
+)
+def test_hop_pallas_matches_oracle(bits, block):
+    rng = np.random.RandomState(1)
+    comp, _ = _compressors(bits)
+    incoming = rng.randn(4, block).astype(np.float32)
+    local = rng.randn(4, block).astype(np.float32) * 2.0
+    q, mm = comp(jnp.asarray(incoming))
+    q_j, mm_j, err_j = hop_dequant_add_requant(q, mm, jnp.asarray(local), bits=bits)
+    q_p, mm_p, err_p = hop_dequant_add_requant_pallas(
+        q, mm, jnp.asarray(local), bits=bits, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(q_p), np.asarray(q_j))
+    np.testing.assert_allclose(np.asarray(mm_p), np.asarray(mm_j), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(err_p), np.asarray(err_j))
+
+
+@pytest.mark.parametrize("bits", [8, 4], ids=["int8", "int4"])
+def test_hop_pallas_fallback_unaligned(bits):
+    """Off-tile block sizes route to the jnp oracle bitwise-transparently."""
+    rng = np.random.RandomState(2)
+    comp, _ = _compressors(bits)
+    incoming = rng.randn(3, 100).astype(np.float32)
+    local = rng.randn(3, 100).astype(np.float32)
+    q, mm = comp(jnp.asarray(incoming))
+    out_j = hop_dequant_add_requant(q, mm, jnp.asarray(local), bits=bits)
+    out_p = hop_dequant_add_requant_pallas(
+        q, mm, jnp.asarray(local), bits=bits, interpret=True
+    )
+    for a, b in zip(out_p, out_j):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_hop_constant_degenerate_no_nan():
+    const = jnp.full((2, 4096), 5.5e33, jnp.float32)
+    q, mm = compress_minmax_uint8(const)
+    q2, mm2, err = hop_dequant_add_requant(q, mm, const, bits=8)
+    out = np.asarray(decompress_minmax_uint8(q2, mm2))
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, np.full((2, 4096), 1.1e34, np.float32),
+                               rtol=1e-6)
+    # Constant blocks re-quantize near-losslessly: the residual is bounded
+    # by f32 rounding of the sum, not by a quantization step.
+    assert np.abs(np.asarray(err)).max() <= 1e-6 * 1.1e34
+
+
+# ---------------------------------------------------------------------------
+# Ring schedule simulation oracle
+# ---------------------------------------------------------------------------
+
+
+def sim_quantized_ring_rs(x_stack: np.ndarray, bits: int, block: int,
+                          average: bool = True):
+    """Explicit per-package simulation of the ring schedule: package for
+    destination d starts at rank d+1 and moves forward one rank per step.
+    Uses the same jnp block codecs, so agreement with the shard_map run must
+    be bitwise."""
+    comp, deco = _compressors(bits)
+    n, L = x_stack.shape
+    S = L // n
+    nb = -(-S // block)
+    Sp = nb * block
+    xb = np.zeros((n, n, Sp), np.float32)
+    xb[:, :, :S] = x_stack.reshape(n, n, S)
+    shards = np.zeros((n, S), np.float32)
+    errs = np.zeros((n, n, Sp), np.float32)
+    for d in range(n):
+        r0 = (d + 1) % n
+        local0 = jnp.asarray(xb[r0, d].reshape(nb, block))
+        q, mm = comp(local0)
+        errs[r0, d] = np.asarray(local0 - deco(q, mm)).reshape(-1)
+        for t in range(1, n):
+            r = (r0 + t) % n
+            local = jnp.asarray(xb[r, d].reshape(nb, block))
+            if t < n - 1:
+                q, mm, e = hop_dequant_add_requant(q, mm, local, bits=bits)
+                errs[r, d] += np.asarray(e).reshape(-1)
+            else:
+                assert r == d
+                red = np.asarray(deco(q, mm) + local).reshape(-1)
+                shards[d] = (red / n if average else red)[:S]
+    return shards, errs[:, :, :S].reshape(n, n * S)
+
+
+@pytest.mark.parametrize("bits", [8, 4], ids=["int8", "int4"])
+@pytest.mark.parametrize("average", [True, False], ids=["avg", "sum"])
+def test_ring_rs_matches_schedule_sim(group, bits, average):
+    rng = np.random.RandomState(3)
+    n = group.size
+    block = 64
+    L = n * 96  # unaligned shard (96 % 64 != 0): pads to 2 blocks
+    x = rng.randn(n, L).astype(np.float32)
+
+    fn = jax.jit(
+        group.shard_map(
+            lambda v: tuple(
+                o[None]
+                for o in quantized_ring_reduce_scatter(
+                    v[0], ALL_AXES, bits=bits, average=average, block=block
+                )
+            ),
+            in_specs=P(ALL_AXES),
+            out_specs=(P(ALL_AXES), P(ALL_AXES)),
+        )
+    )
+    shards, errs = fn(jnp.asarray(x))
+    sim_shards, sim_errs = sim_quantized_ring_rs(x, bits, block, average)
+    np.testing.assert_array_equal(np.asarray(shards), sim_shards)
+    np.testing.assert_array_equal(np.asarray(errs), sim_errs)
+
+
+def test_ring_rs_pallas_hop_bitwise(group):
+    """The ring with the Pallas hop (interpret) is bitwise-identical to the
+    jnp-hop ring at an aligned block size."""
+    rng = np.random.RandomState(4)
+    n = group.size
+    block = 4096
+    L = n * block
+    x = jnp.asarray(rng.randn(n, L).astype(np.float32))
+
+    def run(hop):
+        fn = jax.jit(
+            group.shard_map(
+                lambda v: quantized_ring_reduce_scatter(
+                    v[0], ALL_AXES, bits=8, block=block, hop=hop
+                )[0][None],
+                in_specs=P(ALL_AXES),
+                out_specs=P(ALL_AXES),
+            )
+        )
+        return np.asarray(fn(x))
+
+    import functools
+    jnp_hop = functools.partial(hop_dequant_add_requant, bits=8)
+    pl_hop = functools.partial(
+        hop_dequant_add_requant_pallas, bits=8, interpret=True
+    )
+    np.testing.assert_array_equal(run(jnp_hop), run(pl_hop))
+
+
+@pytest.mark.parametrize("bits", [8, 4], ids=["int8", "int4"])
+def test_allreduce_identical_across_ranks_and_error_bound(group, bits):
+    rng = np.random.RandomState(5)
+    n = group.size
+    L = n * 128
+    x = rng.randn(n, L).astype(np.float32)
+
+    fn = jax.jit(
+        group.shard_map(
+            lambda v: tuple(
+                o[None]
+                for o in quantized_ring_allreduce(
+                    v[0], ALL_AXES, bits=bits, average=True, block=64
+                )
+            ),
+            in_specs=P(ALL_AXES),
+            out_specs=(P(ALL_AXES), P(ALL_AXES)),
+        )
+    )
+    out, err = np.asarray(fn(jnp.asarray(x))[0]), np.asarray(fn(jnp.asarray(x))[1])
+    # identical on every rank: the wire image is the single source of truth
+    for r in range(1, n):
+        np.testing.assert_array_equal(out[0], out[r])
+    # and close to the true mean: per-hop quantization error compounds over
+    # the ring, bounded by ~hops * level
+    mean = x.mean(0)
+    levels = 255.0 if bits == 8 else 15.0
+    spread = np.abs(x).max() * n  # generous bound on any partial's range
+    tol = (2 * n) * spread / levels
+    assert np.abs(out[0] - mean).max() <= tol
+
+
+def test_error_feedback_drives_mean_to_truth(group):
+    """The EF contract: residuals re-entering the next step's input make the
+    *time-averaged* output converge to the true mean — the bias of one-shot
+    int4 quantization washes out instead of accumulating."""
+    rng = np.random.RandomState(6)
+    n = group.size
+    L = n * 64
+    g = rng.randn(n, L).astype(np.float32)  # fixed per-rank gradients
+
+    fn = jax.jit(
+        group.shard_map(
+            lambda v: tuple(
+                o[None]
+                for o in quantized_ring_allreduce(
+                    v[0], ALL_AXES, bits=4, average=True, block=64
+                )
+            ),
+            in_specs=P(ALL_AXES),
+            out_specs=(P(ALL_AXES), P(ALL_AXES)),
+        )
+    )
+    resid = np.zeros_like(g)
+    outs = []
+    for _ in range(30):
+        out, err = fn(jnp.asarray(g + resid))
+        resid = np.asarray(err)
+        outs.append(np.asarray(out)[0])
+    mean = g.mean(0)
+    one_shot = np.abs(outs[0] - mean).max()
+    ef_avg = np.abs(np.mean(outs, axis=0) - mean).max()
+    assert ef_avg < one_shot * 0.2
+    assert ef_avg < 0.02
+
+
+def test_quantized_allgather_matches_codec(group):
+    rng = np.random.RandomState(7)
+    n = group.size
+    S = 96
+    shards = rng.randn(n, S).astype(np.float32)
+
+    fn = jax.jit(
+        group.shard_map(
+            lambda v: tuple(
+                o[None]
+                for o in quantized_allgather(v[0], ALL_AXES, bits=8, block=64)
+            ),
+            in_specs=P(ALL_AXES),
+            out_specs=(P(ALL_AXES), P(ALL_AXES)),
+        )
+    )
+    flat, err = fn(jnp.asarray(shards))
+    flat, err = np.asarray(flat), np.asarray(err)
+    # oracle: every shard independently compressed with the same block codec
+    expect = []
+    for r in range(n):
+        padded = np.zeros((2, 64), np.float32)
+        padded.reshape(-1)[:S] = shards[r]
+        q, mm = compress_minmax_uint8(jnp.asarray(padded))
+        dec = np.asarray(decompress_minmax_uint8(q, mm)).reshape(-1)[:S]
+        expect.append(dec)
+        np.testing.assert_array_equal(err[r], shards[r] - dec)
+    expect = np.concatenate(expect)
+    for r in range(n):
+        np.testing.assert_array_equal(flat[r], expect)
+
+
+def test_ring_wire_bytes_accounting():
+    # 8 ranks, 64k elements, block 4096: shard 8192 elems = 2 blocks
+    n, numel, B = 8, 8 * 8192, 4096
+    per_hop8 = 8192 + 2 * 8
+    assert ring_wire_bytes(numel, n, 8, block=B) == 2 * (n - 1) * per_hop8
+    per_hop4 = 4096 + 2 * 8
+    assert ring_wire_bytes(numel, n, 4, block=B) == 2 * (n - 1) * per_hop4
+    assert ring_wire_bytes(numel, 1, 8) == 0
+    # compressed hop bytes sit well under the 0.3x f32 gate
+    f32_hop_bytes = 2 * (n - 1) * 8192 * 4
+    assert ring_wire_bytes(numel, n, 8, block=B) <= 0.3 * f32_hop_bytes
+
+
+def test_resolve_block_env(monkeypatch):
+    assert resolve_block() == 4096
+    monkeypatch.setenv("BAGUA_QR_BLOCK", "512")
+    assert resolve_block() == 512
+    assert resolve_block(128) == 128
+    with pytest.raises(ValueError):
+        resolve_block(7)
+
+
+def test_get_ring_hop_dispatch(monkeypatch):
+    import functools as ft
+
+    h = get_ring_hop(8)
+    assert isinstance(h, ft.partial) and h.func is hop_dequant_add_requant
+    h = get_ring_hop(4, use_pallas=True)
+    assert h.func is hop_dequant_add_requant_pallas
+    monkeypatch.setenv("BAGUA_PALLAS_QUANTIZED_RING", "1")
+    h = get_ring_hop(8)
+    assert h.func is hop_dequant_add_requant_pallas

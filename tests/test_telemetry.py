@@ -428,6 +428,124 @@ def test_on_rebucket_counter_gauges_and_event(tmp_path):
     assert "bagua_plan_version 2" in prom
 
 
+def test_precision_switch_event_schema():
+    """``precision_switch`` is a first-class schema-validated event type:
+    the before/after per-bucket precision lists and the reason are required,
+    typed payload fields."""
+    ok = {"ts": 1.0, "event": "precision_switch", "step": 4, "plan_version": 0,
+          "old_precisions": ["f32", "f32"], "new_precisions": ["int8", "f32"],
+          "reason": "planner"}
+    assert validate_metrics_event(ok) == []
+    missing = dict(ok)
+    del missing["new_precisions"]
+    assert any("'new_precisions'" in p for p in validate_metrics_event(missing))
+    badtype = dict(ok, old_precisions="f32")
+    assert any("'old_precisions'" in p for p in validate_metrics_event(badtype))
+
+
+def test_on_precision_switch_surfaces(tmp_path):
+    """A wire-precision plan swap lands on every telemetry surface at once:
+    the ``precision_switch_total`` counter, per-precision bucket-count
+    gauges, a schema-valid JSONL event, and the Prometheus export."""
+    path = str(tmp_path / "p.jsonl")
+    tel = Telemetry(metrics_jsonl=path)
+    tel.on_precision_switch(
+        step=3, plan_version=0, old_precisions=["f32", "f32", "f32"],
+        new_precisions=["int8", "f32", "int4"],
+    )
+    tel.on_precision_switch(
+        step=9, plan_version=0, old_precisions=["int8", "f32", "int4"],
+        new_precisions=["int8", "int8", "int4"], reason="operator",
+    )
+    tel.close()
+
+    snap = tel.registry.snapshot()
+    assert snap["precision_switch_total"] == 2
+    assert snap["buckets_at_precision_int8"] == 2.0
+    assert snap["buckets_at_precision_int4"] == 1.0
+
+    assert validate_metrics_file(path) == []
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    sw = [e for e in events if e["event"] == "precision_switch"]
+    assert [e["reason"] for e in sw] == ["planner", "operator"]
+    assert sw[0]["old_precisions"] == ["f32", "f32", "f32"]
+    assert sw[0]["new_precisions"] == ["int8", "f32", "int4"]
+    assert sw[1]["step"] == 9
+
+    prom = tel.registry.to_prometheus()
+    assert "bagua_precision_switch_total 2" in prom
+    assert "bagua_buckets_at_precision_int8 2" in prom
+
+
+def test_on_step_per_precision_wire_counters(tmp_path):
+    """``wire_bytes_by_precision`` splits the census into per-precision
+    counters (the flat-name labeled family) and rides the step JSONL event."""
+    path = str(tmp_path / "w.jsonl")
+    tel = Telemetry(metrics_jsonl=path)
+    by_prec = {"f32": 1000, "int8": 300, "int4": 150}
+    for step in range(3):
+        tel.on_step(step=step, wall_s=0.01, n_samples=32, wire_bytes=1450,
+                    wire_bytes_by_precision=by_prec)
+    tel.close()
+
+    snap = tel.registry.snapshot()
+    assert snap["wire_bytes_precision_f32_total"] == 3000
+    assert snap["wire_bytes_precision_int8_total"] == 900
+    assert snap["wire_bytes_precision_int4_total"] == 450
+    assert snap["wire_bytes_total"] == 3 * 1450
+
+    assert validate_metrics_file(path) == []
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    steps = [e for e in events if e["event"] == "step"]
+    assert all(e["wire_bytes_by_precision"] == by_prec for e in steps)
+
+
+def test_precision_plan_switch_emits_telemetry_from_engine(group, tmp_path):
+    """End-to-end: ``apply_precision_plan`` on an ``auto`` engine emits the
+    ``precision_switch`` event and subsequent steps feed the per-precision
+    wire-byte counters with the modelled quantized-ring bytes."""
+    from bagua_tpu.kernels.quantized_ring import ring_wire_bytes
+
+    path = str(tmp_path / "pe.jsonl")
+    tel = Telemetry(metrics_jsonl=path)
+    ddp = DistributedDataParallel(
+        mse_loss, optax.sgd(0.05),
+        GradientAllReduceAlgorithm(wire_precision="auto"),
+        process_group=group, bucket_size_bytes=1 << 9, telemetry=tel,
+    )
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    batch = make_batch()
+    state, _ = ddp.train_step(state, batch)
+
+    nb = ddp.plan.num_buckets
+    assert nb >= 2
+    plan = ["int8"] + ["f32"] * (nb - 1)
+    assert ddp.apply_precision_plan(plan, reason="operator")
+    state, _ = ddp.train_step(state, batch)
+    tel.close()
+
+    snap = tel.registry.snapshot()
+    assert snap["precision_switch_total"] == 1
+    assert snap["buckets_at_precision_int8"] == 1.0
+    assert snap["buckets_at_precision_f32"] == float(nb - 1)
+    # step 1 ran all-f32, step 2 ran the mixed plan: the int8 counter holds
+    # exactly one step's modelled ring bytes for bucket 0
+    n = group.size
+    assert snap["wire_bytes_precision_int8_total"] == ring_wire_bytes(
+        ddp.plan.specs[0].numel, n, 8
+    )
+
+    assert validate_metrics_file(path) == []
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    (sw,) = [e for e in events if e["event"] == "precision_switch"]
+    assert sw["old_precisions"] == ["f32"] * nb
+    assert sw["new_precisions"] == plan and sw["reason"] == "operator"
+    step_events = [e for e in events if e["event"] == "step"]
+    assert "wire_bytes_by_precision" in step_events[-1]
+    assert step_events[-1]["wire_bytes_by_precision"]["int8"] > 0
+    ddp.shutdown()
+
+
 def test_snapshot_and_restart_event_schemas(tmp_path):
     """The resilience subsystem's JSONL events are schema-validated like
     every other event type: required payload fields, typed, with torn or
