@@ -111,6 +111,7 @@ class DistributedDataParallel:
         dp_filter: Optional[Callable[[str], bool]] = None,
         overlap="auto",
         telemetry=None,
+        health_monitor=None,
     ):
         self.loss_fn = loss_fn
         self.group = process_group or get_default_group()
@@ -168,6 +169,16 @@ class DistributedDataParallel:
         self.host_overhead = {"pre": 0.0, "lock_wait": 0.0, "dispatch": 0.0,
                               "post": 0.0, "steps": 0}
         self.telemetry = telemetry
+        #: optional training-health guardrail
+        #: (:class:`~bagua_tpu.observability.health.HealthMonitor`).  When
+        #: attached the compiled step additionally returns the per-rank
+        #: health scalars (loss / global grad-norm / nonfinite count — pure
+        #: reads, the parameter path is bitwise-identical either way) and
+        #: the host feeds the aggregated values to the monitor after every
+        #: dispatch.
+        self.health_monitor = health_monitor
+        if health_monitor is not None and telemetry is not None:
+            health_monitor.bind_telemetry(telemetry)
         #: host-observed full train_step wall times (ring-buffered) —
         #: host_overhead_snapshot surfaces its p50/p95/p99 tail
         self.step_timer = StepTimer()
@@ -410,6 +421,7 @@ class DistributedDataParallel:
         impl, plan, group = self.impl, self.plan, self.group
         overlap = self.overlap_enabled
         updater = self._sharded_updater  # rebucket rebuilds it + clears _step_fns
+        health_on = self.health_monitor is not None
 
         def local_step(state: TrainState, batch):
             params, opt_state, algo_state, step = (
@@ -484,6 +496,17 @@ class DistributedDataParallel:
                     grads, params, algo_state = impl.transform_gradients(
                         grads, params, algo_state, ctx
                     )
+            health = None
+            if health_on:
+                # Pure reads of the step's loss and (exchanged) gradients —
+                # adds reductions to the graph but feeds nothing back into
+                # the parameter path, so params stay bitwise-identical with
+                # the monitor on or off (pinned in tests, same discipline as
+                # the named-scope labels).
+                from bagua_tpu.observability.health import health_scalars
+
+                with step_scope("health"):
+                    health = health_scalars(loss, grads)
             if updater is not None:
                 # Sharded-update phase (zero algorithm): the exchange left the
                 # reduced gradients in rank-me's shard slice of every bucket;
@@ -531,12 +554,15 @@ class DistributedDataParallel:
                 algo_state=_restack(algo_state),
                 step=(step + 1)[None],
             )
+            if health_on:
+                return new_state, loss[None], health[None]
             return new_state, loss[None]
 
+        n_out = 3 if health_on else 2
         sharded = self.group.shard_map(
             local_step,
             in_specs=(P(ALL_AXES), P(ALL_AXES)),
-            out_specs=(P(ALL_AXES), P(ALL_AXES)),
+            out_specs=(P(ALL_AXES),) * n_out,
         )
         return jax.jit(sharded, donate_argnums=(0,))
 
@@ -561,6 +587,7 @@ class DistributedDataParallel:
         variant = self.impl.step_variant(self._host_step)
         tel = self.telemetry
         fn = self._step_fns.get(variant)
+        missed = fn is None
         if fn is None:
             # A jit-cache miss IS the compile event the recompile detector
             # counts — report it before building so a hang inside tracing
@@ -582,7 +609,8 @@ class DistributedDataParallel:
             tel.enter_phase("dispatch")
         lock = self.impl.host_dispatch_lock
         if lock is None:
-            new_state, losses = fn(state, batch)
+            out = fn(state, batch)
+            new_state, losses = out[0], out[1]
             t2 = time.perf_counter()
             ov["dispatch"] += t2 - t1
             step_ov["dispatch"] = t2 - t1
@@ -597,7 +625,8 @@ class DistributedDataParallel:
                 t2 = time.perf_counter()
                 ov["lock_wait"] += t2 - t1
                 step_ov["lock_wait"] = t2 - t1
-                new_state, losses = fn(state, batch)
+                out = fn(state, batch)
+                new_state, losses = out[0], out[1]
                 t3 = time.perf_counter()
                 ov["dispatch"] += t3 - t2
                 step_ov["dispatch"] = t3 - t2
@@ -607,6 +636,14 @@ class DistributedDataParallel:
         ov["steps"] += 1
         wall = time.perf_counter() - t0
         self.step_timer.tick(wall)
+        if missed and tel is not None:
+            # jit compiles synchronously inside the first dispatch, so on a
+            # cache-miss step the dispatch duration IS the compile wall —
+            # the compile_ms histogram + the goodput ledger's compile bucket
+            tel.on_compile_done(
+                variant, self._host_step - 1,
+                wall_ms=step_ov.get("dispatch", 0.0) * 1e3,
+            )
         if tel is not None:
             tel.enter_phase("wait")
             leaves = jax.tree_util.tree_leaves(batch)
@@ -632,7 +669,35 @@ class DistributedDataParallel:
                 wire_bytes_by_leg=wire_by_leg,
                 wire_bytes_by_precision=wire_by_precision,
             )
+        if self.health_monitor is not None and len(out) == 3:
+            loss_mean, gn_max, nonfinite = self._read_health(out[2])
+            self.health_monitor.observe(
+                step=self._host_step - 1, loss=loss_mean, grad_norm=gn_max,
+                nonfinite=nonfinite, state=new_state,
+            )
         return new_state, losses
+
+    @staticmethod
+    def _read_health(arr):
+        """Aggregate the rank-stacked ``(size, 3)`` health vector host-side:
+        mean loss, max grad norm, summed nonfinite count.  On a multi-host
+        group only this process' shards are addressable; every rank reaches
+        the same alert decision from its own slice (all slices of a
+        replicated reduction agree, and per-rank values differ only in the
+        local loss/grad terms the detector thresholds are far above)."""
+        import numpy as np
+
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            rows = np.concatenate(
+                [np.asarray(s.data).reshape(-1, 3) for s in arr.addressable_shards]
+            )
+        else:
+            rows = np.asarray(arr).reshape(-1, 3)
+        return (
+            float(np.mean(rows[:, 0])),
+            float(np.max(rows[:, 1])),
+            int(np.sum(rows[:, 2])),
+        )
 
     # -- shard-layout migration (sharded-update algorithms) ------------------
 

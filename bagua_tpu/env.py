@@ -121,6 +121,13 @@ def get_snapshot_every() -> int:
     return int(os.environ.get("BAGUA_SNAPSHOT_EVERY", 0))
 
 
+def get_metrics_max_mb() -> float:
+    """``BAGUA_METRICS_MAX_MB``: size-based rotation threshold (MiB) for the
+    telemetry JSONL event stream — the live file rotates to ``path.N`` when
+    it would exceed this.  0 (the default) disables rotation."""
+    return float(os.environ.get("BAGUA_METRICS_MAX_MB", 0) or 0)
+
+
 def get_rpc_retries() -> int:
     """``BAGUA_RPC_RETRIES``: attempts (1 + retries) for service RPCs
     (autotune client, rendezvous KV) before the error surfaces."""

@@ -32,10 +32,33 @@ from bagua_tpu.observability.metrics import (
     Histogram,
     JsonlSink,
     MetricsRegistry,
+    rotated_metrics_files,
     validate_metrics_event,
     validate_metrics_file,
 )
 from bagua_tpu.observability.telemetry import RecompileDetector, Telemetry
+from bagua_tpu.observability.goodput import (
+    GoodputLedger,
+    GoodputMeter,
+    flops_from_cost_analysis,
+    model_flops_per_sample,
+    predicted_wire_time,
+    register_model_flops,
+)
+from bagua_tpu.observability.health import (
+    HealthConfig,
+    HealthMonitor,
+    PrecisionDemotionAction,
+    SnapshotOnAnomalyAction,
+    health_scalars,
+)
+from bagua_tpu.observability.aggregate import (
+    GangAggregator,
+    GangView,
+    StepSummary,
+    straggler_score,
+    summarize_telemetry,
+)
 from bagua_tpu.observability.trace_analysis import (
     COLLECTIVE_OPS,
     analyze_trace,
@@ -65,11 +88,31 @@ __all__ = [
     "Histogram",
     "JsonlSink",
     "MetricsRegistry",
+    "rotated_metrics_files",
     "validate_metrics_event",
     "validate_metrics_file",
     # telemetry
     "RecompileDetector",
     "Telemetry",
+    # goodput / MFU
+    "GoodputLedger",
+    "GoodputMeter",
+    "flops_from_cost_analysis",
+    "model_flops_per_sample",
+    "predicted_wire_time",
+    "register_model_flops",
+    # health guardrail
+    "HealthConfig",
+    "HealthMonitor",
+    "PrecisionDemotionAction",
+    "SnapshotOnAnomalyAction",
+    "health_scalars",
+    # gang aggregation
+    "GangAggregator",
+    "GangView",
+    "StepSummary",
+    "straggler_score",
+    "summarize_telemetry",
     # trace analysis
     "COLLECTIVE_OPS",
     "analyze_trace",

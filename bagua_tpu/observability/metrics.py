@@ -29,6 +29,7 @@ __all__ = [
     "Histogram",
     "JsonlSink",
     "MetricsRegistry",
+    "rotated_metrics_files",
     "validate_metrics_event",
     "EVENT_REQUIRED_FIELDS",
 ]
@@ -145,10 +146,14 @@ class MetricsRegistry:
 
     # -- Prometheus text exposition ------------------------------------------
 
+    #: ring-buffer percentile -> Prometheus summary quantile label
+    _QUANTILE_LABELS = (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99"))
+
     def to_prometheus(self) -> str:
         """The text exposition format (one family per metric; histograms as
-        ``_count``/``_sum`` plus pXX gauges — quantile summaries without the
-        streaming-quantile machinery)."""
+        conformant summaries: ``name{quantile="0.5|0.95|0.99"}`` series
+        followed by ``name_count``/``name_sum`` — quantile summaries
+        without the streaming-quantile machinery)."""
         with self._lock:
             metrics = dict(self._metrics)
         lines = []
@@ -164,10 +169,12 @@ class MetricsRegistry:
                 lines.append(f"{full} {m.value}")
             else:
                 lines.append(f"# TYPE {full} summary")
+                pct = m.percentiles()
+                for key, q in self._QUANTILE_LABELS:
+                    if key in pct:
+                        lines.append(f'{full}{{quantile="{q}"}} {pct[key]}')
                 lines.append(f"{full}_count {m.count}")
                 lines.append(f"{full}_sum {m.sum}")
-                for p, v in m.percentiles().items():
-                    lines.append(f'{full}{{quantile="0.{p[1:]}"}} {v}')
         return "\n".join(lines) + "\n"
 
     def write_prometheus(self, path: str) -> None:
@@ -216,6 +223,15 @@ EVENT_PAYLOAD_FIELDS = {
         "new_precisions": list,
         "reason": str,
     },
+    # the health monitor detected an anomaly (kind: loss_spike /
+    # grad_norm_explosion / nonfinite); actions lists the registered
+    # correctives that reported applying (e.g. precision_demotion)
+    "health_alert": {
+        "kind": str,
+        "value": (int, float),
+        "threshold": (int, float),
+        "actions": list,
+    },
 }
 
 
@@ -247,14 +263,41 @@ class JsonlSink:
 
     Events are validated on emit; an invalid event raises immediately —
     a malformed stream is a bug at the emit site, not something a reader
-    should have to defend against."""
+    should have to defend against.
 
-    def __init__(self, path: str):
+    Long jobs can bound the file with size-based rotation: when
+    ``max_bytes`` (default: ``BAGUA_METRICS_MAX_MB`` MiB; unset/0 = off)
+    would be exceeded, the live file is atomically renamed to ``path.N``
+    (``.1`` oldest) and a fresh ``path`` is opened — no line is ever split
+    across files, and :func:`validate_metrics_file` validates the whole
+    rotated set."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
+        if max_bytes is None:
+            from bagua_tpu.env import get_metrics_max_mb
+
+            mb = get_metrics_max_mb()
+            max_bytes = int(mb * (1 << 20)) if mb > 0 else 0
         self.path = path
+        self.max_bytes = max(0, int(max_bytes))
         self._lock = threading.Lock()
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f: Optional[IO] = open(path, "a")
+
+    def _rotate_locked(self) -> None:
+        assert self._f is not None
+        self._f.close()
+        suffixes = [0]
+        base = os.path.basename(self.path)
+        d = os.path.dirname(os.path.abspath(self.path))
+        for entry in os.listdir(d):
+            if entry.startswith(base + "."):
+                tail = entry[len(base) + 1:]
+                if tail.isdigit():
+                    suffixes.append(int(tail))
+        os.replace(self.path, f"{self.path}.{max(suffixes) + 1}")
+        self._f = open(self.path, "a")
 
     def emit(self, event: Dict) -> None:
         event.setdefault("ts", time.time())
@@ -265,6 +308,12 @@ class JsonlSink:
         with self._lock:
             if self._f is None:
                 raise ValueError(f"JsonlSink({self.path}) is closed")
+            if (
+                self.max_bytes
+                and self._f.tell() > 0
+                and self._f.tell() + len(line) + 1 > self.max_bytes
+            ):
+                self._rotate_locked()
             self._f.write(line + "\n")
             self._f.flush()
 
@@ -289,19 +338,46 @@ class JsonlSink:
         self.close()
 
 
+def rotated_metrics_files(path: str) -> List[str]:
+    """The rotated set a :class:`JsonlSink` at ``path`` may have produced,
+    oldest first: ``path.1``, ``path.2``, ..., then the live ``path``.
+    Just ``[path]`` when rotation never fired."""
+    base = os.path.basename(path)
+    d = os.path.dirname(os.path.abspath(path))
+    suffixes = []
+    if os.path.isdir(d):
+        for entry in os.listdir(d):
+            if entry.startswith(base + "."):
+                tail = entry[len(base) + 1:]
+                if tail.isdigit():
+                    suffixes.append(int(tail))
+    out = [f"{path}.{n}" for n in sorted(suffixes)]
+    out.append(path)
+    return out
+
+
 def validate_metrics_file(path: str) -> List[str]:
-    """Validate every line of a JSONL metrics file; returns problems with
-    line numbers (empty = the whole stream is schema-clean)."""
+    """Validate every line of a JSONL metrics file — including any rotated
+    ``path.N`` segments the sink produced — returning problems with line
+    numbers (empty = the whole stream is schema-clean).  Problems in a
+    rotated segment are prefixed with its basename."""
     problems = []
-    with open(path) as f:
-        for i, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                event = json.loads(line)
-            except json.JSONDecodeError as e:
-                problems.append(f"line {i}: not JSON ({e})")
-                continue
-            problems += [f"line {i}: {p}" for p in validate_metrics_event(event)]
+    files = [p for p in rotated_metrics_files(path) if os.path.exists(p)]
+    if not files:
+        files = [path]  # surface the FileNotFoundError from open()
+    for fp in files:
+        tag = "" if len(files) == 1 else f"{os.path.basename(fp)} "
+        with open(fp) as f:
+            for i, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError as e:
+                    problems.append(f"{tag}line {i}: not JSON ({e})")
+                    continue
+                problems += [
+                    f"{tag}line {i}: {p}" for p in validate_metrics_event(event)
+                ]
     return problems

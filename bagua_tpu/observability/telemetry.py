@@ -51,6 +51,8 @@ class RecompileDetector:
         self.window = window
         self.max_retraces_per_window = max_retraces_per_window
         self.compiles_by_variant: Dict[str, int] = {}
+        self.compile_ms_by_variant: Dict[str, float] = {}
+        self.compile_ms_total = 0.0
         self.steps = 0
         self.retraces = 0
         self.alerts = 0
@@ -87,6 +89,15 @@ class RecompileDetector:
                 on_alert(msg, len(recent))
         return True
 
+    def record_compile_wall(self, variant: str, wall_ms: float) -> None:
+        """Attribute one compile's measured wall time to its variant —
+        counts say *that* the step function churned, wall time says what
+        the churn *cost* (the goodput ledger's compile bucket)."""
+        self.compile_ms_by_variant[variant] = (
+            self.compile_ms_by_variant.get(variant, 0.0) + float(wall_ms)
+        )
+        self.compile_ms_total += float(wall_ms)
+
     def record_step(self) -> None:
         self.steps += 1
         if self._alerted and all(
@@ -100,6 +111,10 @@ class RecompileDetector:
             "retraces": self.retraces,
             "alerts": self.alerts,
             "compiles_by_variant": dict(self.compiles_by_variant),
+            "compile_ms_total": round(self.compile_ms_total, 3),
+            "compile_ms_by_variant": {
+                k: round(v, 3) for k, v in self.compile_ms_by_variant.items()
+            },
         }
 
 
@@ -114,6 +129,10 @@ class Telemetry:
             ``snapshot_provider`` is pointed at :meth:`snapshot` so hang
             dumps carry the last known (step, phase, bucket, variant).
         retrace_window / max_retraces_per_window: recompile alert rate knobs.
+        goodput: a :class:`~bagua_tpu.observability.goodput.GoodputMeter` to
+            feed (phases → ledger buckets, steps → MFU, compile/snapshot/
+            restart walls → their ledger buckets).  The hub points the
+            meter's gauges at its own registry.
     """
 
     def __init__(
@@ -123,8 +142,12 @@ class Telemetry:
         watchdog: Optional[Watchdog] = None,
         retrace_window: int = 100,
         max_retraces_per_window: int = 2,
+        goodput=None,
     ):
         self.registry = registry or MetricsRegistry()
+        self.goodput = goodput
+        if goodput is not None:
+            goodput.bind_registry(self.registry)
         self.jsonl = JsonlSink(metrics_jsonl) if metrics_jsonl else None
         self.recompile = RecompileDetector(
             window=retrace_window, max_retraces_per_window=max_retraces_per_window
@@ -147,6 +170,8 @@ class Telemetry:
         self.current_phase = phase
         if self.watchdog is not None:
             self.watchdog.beat(phase=phase)
+        if self.goodput is not None:
+            self.goodput.on_phase(phase)
 
     def snapshot(self) -> Dict:
         """The last known position + registry snapshot — embedded in the
@@ -179,6 +204,19 @@ class Telemetry:
                  "retrace": bool(retrace)}
             )
 
+    def on_compile_done(self, variant: str, step: int, wall_ms: float) -> None:
+        """The compile announced by :meth:`on_compile` finished; ``wall_ms``
+        is its measured wall time (the engine reads it off the first
+        dispatch, which jit compiles synchronously).  Feeds the
+        ``compile_ms`` histogram, the detector's per-variant wall ledger,
+        and the goodput ledger's compile bucket."""
+        self.recompile.record_compile_wall(variant, wall_ms)
+        self.registry.histogram(
+            "compile_ms", help="step-function compile wall time"
+        ).observe(float(wall_ms))
+        if self.goodput is not None:
+            self.goodput.on_compile(float(wall_ms) / 1e3)
+
     def on_step(
         self,
         step: int,
@@ -205,6 +243,8 @@ class Telemetry:
         self.current_variant = variant
         self.recompile.record_step()
         self.step_timer.tick(wall_s, n_samples)
+        if self.goodput is not None:
+            self.goodput.on_step(wall_s, n_samples)
         r = self.registry
         r.counter("steps_total", help="training steps dispatched").inc()
         r.counter("samples_total", help="samples processed").inc(max(0, int(n_samples)))
@@ -339,6 +379,8 @@ class Telemetry:
             help="background snapshot write time (off the critical path)",
         ).observe(float(wall_ms))
         r.gauge("snapshot_last_step", help="step of the newest snapshot").set(step)
+        if self.goodput is not None:
+            self.goodput.on_snapshot(kind, float(wall_ms))
         if self.jsonl:
             self.jsonl.emit(
                 {"event": "snapshot", "step": int(step),
@@ -368,12 +410,39 @@ class Telemetry:
         r.gauge("resumed_world_size", help="gang size after the latest resume").set(
             new_world_size
         )
+        if self.goodput is not None:
+            self.goodput.on_restart(lost_steps)
         if self.jsonl:
             self.jsonl.emit(
                 {"event": "restart", "step": int(step),
                  "old_world_size": int(old_world_size),
                  "new_world_size": int(new_world_size),
                  "plan_source": plan_source, "lost_steps": int(lost_steps)}
+            )
+
+    def on_health_alert(
+        self,
+        step: int,
+        kind: str,
+        value: float,
+        threshold: float,
+        detail: str = "",
+        actions=(),
+    ) -> None:
+        """The health monitor detected an anomaly (``kind`` one of
+        ``loss_spike``/``grad_norm_explosion``/``nonfinite``); ``actions``
+        lists the registered corrective actions that reported applying.
+        Exported as a per-kind counter and a schema-validated
+        ``health_alert`` JSONL event."""
+        self.registry.counter(
+            f"health_alerts_{kind}_total",
+            help=f"health anomalies of kind {kind}",
+        ).inc()
+        if self.jsonl:
+            self.jsonl.emit(
+                {"event": "health_alert", "step": int(step), "kind": str(kind),
+                 "value": float(value), "threshold": float(threshold),
+                 "detail": str(detail), "actions": [str(a) for a in actions]}
             )
 
     def _emit_alert(self, msg: str, retraces_in_window: int) -> None:

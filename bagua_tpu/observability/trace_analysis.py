@@ -35,11 +35,15 @@ import bisect
 import glob
 import gzip
 import json
+import logging
 import os
 import re
+import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from bagua_tpu.observability.annotations import parse_exchange_label, parse_mp_label
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "COLLECTIVE_OPS",
@@ -127,21 +131,29 @@ def load_trace_events(log_dir: str) -> List[Dict]:
         raise FileNotFoundError(f"no trace.json.gz under {log_dir}")
     out = []
     with gzip.open(path, "rt") as f:
-        for ev in _iter_trace_events(f):
-            if ev.get("ph") != "X" or "dur" not in ev:
-                continue
-            args = ev.get("args") or {}
-            hlo_op = args.get("hlo_op")
-            if not hlo_op:
-                continue  # host-side python/runtime event, not a device op
-            out.append(
-                {
-                    "hlo_op": hlo_op,
-                    "hlo_module": args.get("hlo_module", ""),
-                    "lane": (ev.get("pid"), ev.get("tid")),
-                    "ts": float(ev["ts"]),
-                    "dur": float(ev["dur"]),
-                }
+        try:
+            for ev in _iter_trace_events(f):
+                if ev.get("ph") != "X" or "dur" not in ev:
+                    continue
+                args = ev.get("args") or {}
+                hlo_op = args.get("hlo_op")
+                if not hlo_op:
+                    continue  # host-side python/runtime event, not a device op
+                out.append(
+                    {
+                        "hlo_op": hlo_op,
+                        "hlo_module": args.get("hlo_module", ""),
+                        "lane": (ev.get("pid"), ev.get("tid")),
+                        "ts": float(ev["ts"]),
+                        "dur": float(ev["dur"]),
+                    }
+                )
+        except (EOFError, gzip.BadGzipFile, OSError, zlib.error) as e:
+            # a truncated capture (job killed mid-profile) is the common
+            # case, not a parse bug: degrade to the events salvaged so far
+            logger.warning(
+                "trace %s truncated/corrupt after %d op events (%s); "
+                "analyzing the salvaged prefix", path, len(out), e,
             )
     return out
 

@@ -50,6 +50,19 @@ class Trainer:
             pulling the next batch) and points the watchdog's hang dump at
             the hub's snapshot, so a timeout names the step/phase/variant the
             job died in.
+        health_monitor: opt-in
+            :class:`~bagua_tpu.observability.health.HealthMonitor`, passed
+            through to the DDP engine (which computes the in-graph health
+            scalars and feeds the detector each step).  When a snapshotter
+            is configured the trainer registers
+            :class:`~bagua_tpu.observability.health.SnapshotOnAnomalyAction`
+            so the first anomaly leaves a restorable pre-divergence state.
+        gang_window: if > 0 (and a telemetry hub is attached), every
+            ``gang_window`` fit steps this rank pushes its step summary
+            through the rendezvous KV and rank 0 exports the joined gang
+            view (:class:`~bagua_tpu.observability.aggregate.GangAggregator`
+            — best-effort: a missing/unreachable KV degrades to a
+            local-only view with zero training-path impact).
     """
 
     def __init__(
@@ -69,6 +82,8 @@ class Trainer:
         profile_dir: Optional[str] = None,
         profile_steps: Tuple[int, int] = (10, 13),
         telemetry=None,
+        health_monitor=None,
+        gang_window: int = 0,
     ):
         # Env-gated persistent compile cache (BAGUA_COMPILE_CACHE_DIR): a
         # restarted trainer deserializes the step executable instead of
@@ -80,10 +95,14 @@ class Trainer:
         if cache_dir:
             logger.info("persistent compilation cache at %s", cache_dir)
         self.telemetry = telemetry
+        self.health_monitor = health_monitor
         self.ddp = DistributedDataParallel(
             loss_fn, optimizer, algorithm, process_group=process_group,
             dp_filter=dp_filter, telemetry=telemetry,
+            health_monitor=health_monitor,
         )
+        self.gang_window = int(gang_window)
+        self.gang = None  # built lazily in init_state (needs the KV client)
         self.ckpt_dir = ckpt_dir
         self.ckpt_interval = ckpt_interval
         self.autotune_model_name = autotune_model_name
@@ -126,6 +145,14 @@ class Trainer:
                 # cold-starts the planner
                 manifest_extra_fn=lambda: {"plan": self.ddp.export_plan_payload()},
             )
+            if health_monitor is not None:
+                from bagua_tpu.observability import SnapshotOnAnomalyAction
+
+                # first anomaly => blocking snapshot of the pre-divergence
+                # state (fires once; see health.SnapshotOnAnomalyAction)
+                health_monitor.register_action(
+                    SnapshotOnAnomalyAction(self.snapshotter)
+                )
             self.preemption = PreemptionWatcher()
             try:
                 self.preemption.install()
@@ -170,6 +197,18 @@ class Trainer:
                 self._session = AutotuneSession(self.ddp, self.autotune_model_name)
             except Exception as e:  # service not reachable: train without tuning
                 logger.warning("autotune disabled: %s", e)
+        if self.gang_window > 0 and self.telemetry is not None and self.gang is None:
+            from bagua_tpu.observability import GangAggregator
+
+            # best-effort: a None client (no endpoint / single process) means
+            # the aggregator runs local-only from the start
+            self.gang = GangAggregator(
+                self._rendezvous_client(),
+                rank=jax.process_index(),
+                world_size=jax.process_count(),
+                window=self.gang_window,
+                registry=self.telemetry.registry,
+            )
         return state
 
     def _rendezvous_client(self):
@@ -228,6 +267,16 @@ class Trainer:
             step = self._state_step(state)
             if self.snapshotter is not None:
                 self.snapshotter.maybe_snapshot(state, step)
+            if self.gang is not None:
+                # window-cadenced, best-effort; off-cadence calls return
+                # immediately and KV trouble degrades to a local-only view
+                ho = self.ddp.host_overhead
+                denom = max(1, int(ho.get("steps", 1)))
+                self.gang.tick(
+                    step, self.telemetry,
+                    phase_ms={k: 1e3 * v / denom for k, v in ho.items()
+                              if k != "steps"},
+                )
             if self.preemption is not None and self.preemption.should_stop():
                 self._drain_and_exit(state, step)
                 return state
@@ -278,6 +327,10 @@ class Trainer:
         from bagua_tpu.resilience import write_resumable_marker
 
         logger.warning("preemption signal received: draining at step %d", step)
+        if self.telemetry is not None:
+            # the goodput ledger charges everything from here to the exit
+            # (block + final snapshot) to the drain bucket
+            self.telemetry.enter_phase("drain")
         jax.block_until_ready(state)
         try:
             self.snapshotter.force_snapshot(state, step)

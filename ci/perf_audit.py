@@ -330,6 +330,119 @@ def telemetry_smoke(out_prefix: str, steps: int = 6):
     return metrics_path
 
 
+def health_guardrail_lane(out_prefix: str, steady_steps: int = 6):
+    """Executed health-guardrail gate: synthetic loss spike + forced-NaN step.
+
+    An MLP DDP engine runs under ``wire_precision="auto"`` with a
+    planner-adopted all-int8 per-bucket plan and an attached
+    :class:`HealthMonitor` carrying the shipped precision-demotion action.
+    A synthetic loss spike (targets ×1000 for one step) must fire the EWMA
+    z-score detector and demote the wire to f32 — the census on the
+    re-lowered step confirms it (f32 all-reduce per bucket, zero u8
+    collective bytes); a forced-NaN batch must latch the nonfinite
+    detector.  Every emitted ``health_alert`` event must validate against
+    the schema.  tests/test_ci_lane.py greps the sentinel line and
+    re-checks the artifacts.
+    """
+    import bagua_tpu
+    from bagua_tpu.algorithms import build_algorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+    from bagua_tpu.observability import (
+        HealthConfig, HealthMonitor, PrecisionDemotionAction, Telemetry,
+        validate_metrics_file,
+    )
+
+    # MLP-scale ring shards need the small quantization block (see --wire)
+    os.environ.setdefault("BAGUA_QR_BLOCK", "128")
+    group = bagua_tpu.init_process_group(intra_size=4)
+    n = group.size
+    params = init_mlp(jax.random.PRNGKey(0), [64, 128, 128, 64])
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(8 * n, 64).astype(np.float32))
+    y = jnp.asarray(rng.rand(8 * n, 64).astype(np.float32))
+
+    metrics_path = out_prefix + "_health_metrics.jsonl"
+    if os.path.exists(metrics_path):  # append-mode sink: fresh stream
+        os.remove(metrics_path)
+    tel = Telemetry(metrics_jsonl=metrics_path)
+    monitor = HealthMonitor(telemetry=tel, config=HealthConfig(
+        warmup_steps=3, loss_z_threshold=4.0, grad_norm_factor=8.0))
+    ddp = DistributedDataParallel(
+        loss_fn=mse_loss, optimizer=optax.sgd(0.01, momentum=0.9),
+        algorithm=build_algorithm("gradient_allreduce", wire_precision="auto"),
+        process_group=group, bucket_size_bytes=1 << 16,
+        telemetry=tel, health_monitor=monitor,
+    )
+    monitor.register_action(PrecisionDemotionAction(ddp))
+    state = ddp.init(params)
+    # the planner-chosen aggressive wire the guardrail protects
+    assert ddp.apply_precision_plan(
+        ["int8"] * ddp.plan.num_buckets, reason="planner"
+    )
+    losses = None
+    for _ in range(steady_steps):
+        state, losses = ddp.train_step(state, (x, y))
+    jax.block_until_ready(losses)
+    assert not monitor.alerts, f"steady lane must stay quiet: {monitor.alerts}"
+    before = ddp.impl.bucket_precisions(ddp.plan)
+    assert set(before) == {"int8"}, before
+
+    # synthetic loss spike: one batch with targets scaled x1000
+    state, _ = ddp.train_step(state, (x, y * 1000.0))
+    spike = [a for a in monitor.alerts if a["kind"] == "loss_spike"]
+    assert spike, f"loss spike not detected: {monitor.alerts}"
+    assert "precision_demotion" in spike[0]["actions"], spike
+    after = ddp.impl.bucket_precisions(ddp.plan)
+    assert set(after) == {"f32"}, f"expected f32 demotion, got {after}"
+
+    # census on the re-lowered step: f32 all-reduce, zero u8 collective bytes
+    variant = ddp.impl.step_variant(ddp._host_step)
+    text = ddp._build_step(variant).lower(state, (x, y)).compile().as_text()
+    c = census(text)
+    u8 = sum(e["by_dtype"].get("u8", {}).get("bytes", 0) for e in c.values())
+    ar = c.get("all-reduce", {})
+    assert u8 == 0, f"demoted lane still moves u8 wire bytes: {c}"
+    assert "f32" in ar.get("dtypes", []) and ar.get("count", 0) >= ddp.plan.num_buckets, (
+        f"expected an f32 all-reduce per bucket after demotion: {ar}"
+    )
+
+    # forced-NaN batch: the nonfinite latch must fire
+    x_nan = np.asarray(x).copy()
+    x_nan[0, 0] = np.nan
+    state, _ = ddp.train_step(state, (jnp.asarray(x_nan), y))
+    assert monitor.nan_latched, monitor.report()
+    kinds = {a["kind"] for a in monitor.alerts}
+    assert "nonfinite" in kinds, kinds
+    tel.close()
+    ddp.shutdown()
+
+    problems = validate_metrics_file(metrics_path)
+    assert not problems, f"health lane metrics failed schema validation: {problems}"
+    with open(metrics_path) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    alert_events = [e for e in events if e["event"] == "health_alert"]
+    assert {e["kind"] for e in alert_events} >= {"loss_spike", "nonfinite"}, alert_events
+    switches = [e for e in events if e["event"] == "precision_switch"]
+    assert any(e["reason"].startswith("health:") for e in switches), switches
+    print(
+        f"[audit] health guardrail lane passed ({len(alert_events)} alerts, "
+        f"wire {before[0]}->{after[0]}, nan latch on, "
+        f"{len(events)} events in {os.path.basename(metrics_path)})",
+        file=sys.stderr,
+    )
+    return {
+        "alerts": [
+            {"kind": a["kind"], "actions": a["actions"]} for a in monitor.alerts
+        ],
+        "precisions_before": before,
+        "precisions_after": after,
+        "nan_latched": True,
+        "census_u8_bytes": u8,
+        "census_f32_allreduce": ar.get("count", 0),
+    }
+
+
 def autotune_planner_lane(fixture_path=None):
     """Recorded-span planner gate (pure cost model, no compile — CPU-safe).
 
@@ -1355,6 +1468,13 @@ def main():
     # Executed telemetry gate: emits + schema-validates the metrics stream
     # next to --out and asserts a retrace-free steady state.
     telemetry_smoke(args.out)
+    # Executed health-guardrail gate: synthetic loss spike + forced NaN must
+    # fire the detector, demote the planner-chosen int8 wire to f32 (census
+    # confirmed) and emit schema-valid health_alert events.  The focused
+    # --algo/--wire lanes skip it — one execution per CI run is the evidence.
+    health_result = None
+    if args.algo is None and args.wire is None:
+        health_result = health_guardrail_lane(args.out)
     # Recorded-span planner gate: DP partition must beat the greedy seed
     # plan's predicted exposed comm on the committed VGG16 fixture.
     planner_result = autotune_planner_lane()
@@ -1379,6 +1499,7 @@ def main():
              "model": args.model, "trace_overlap": trace,
              "autotune_planner": planner_result,
              "wire": wire_result,
+             "health": health_result,
              "resilience": resilience_result},
             f, indent=1,
         )

@@ -1,0 +1,247 @@
+"""Gang-scope aggregation: straggler attribution, KV push/collect, degradation.
+
+Pins the acceptance criteria of the cross-rank observability layer:
+
+* straggler attribution on a synthetic 4-rank summary set with one slowed
+  rank — flagged rank, score = p50/median, slowest-phase attribution;
+* push/collect through a *real* in-process rendezvous server, namespaced
+  by the attempt nonce;
+* clean degradation: a dead KV endpoint (or no client at all) trips the
+  breaker and yields a local-only view — gauges flag it, training-path
+  calls never raise;
+* gang gauges ride the ordinary Prometheus export.
+"""
+
+import pytest
+
+from helpers import free_port
+from bagua_tpu.distributed.rendezvous import (
+    RendezvousClient,
+    RendezvousState,
+    start_rendezvous_server,
+)
+from bagua_tpu.observability import (
+    GangAggregator,
+    GangView,
+    MetricsRegistry,
+    StepSummary,
+    Telemetry,
+    straggler_score,
+    summarize_telemetry,
+)
+from bagua_tpu.observability.aggregate import gang_kv_key
+from bagua_tpu.resilience.retry import CircuitBreaker
+
+
+def four_rank_summaries(slow_rank=2, slow_factor=2.0):
+    """Synthetic gang: three healthy ranks at 10 ms p50, one slowed one
+    whose time went into the data phase."""
+    out = []
+    for r in range(4):
+        slow = r == slow_rank
+        out.append(StepSummary(
+            rank=r, step=100, window=20,
+            p50_ms=10.0 * (slow_factor if slow else 1.0),
+            p99_ms=15.0,
+            wire_bytes=1 << 20,
+            mfu=0.4,
+            samples_per_s=100.0,
+            phase_ms={"dispatch": 4.0, "wait": 3.0,
+                      "data": 11.0 if slow else 2.0},
+        ))
+    return out
+
+
+# -- straggler attribution ----------------------------------------------------
+
+
+def test_straggler_attribution_synthetic_four_ranks():
+    s = straggler_score(four_rank_summaries(slow_rank=2, slow_factor=2.0))
+    assert s is not None
+    assert s["rank"] == 2
+    assert s["score"] == pytest.approx(2.0)
+    assert s["p50_ms"] == pytest.approx(20.0)
+    assert s["gang_median_ms"] == pytest.approx(10.0)
+    assert s["phase"] == "data"  # the slowed rank's largest phase bucket
+
+
+def test_straggler_below_factor_or_underpopulated_is_none():
+    assert straggler_score(four_rank_summaries(slow_factor=1.2)) is None
+    assert straggler_score(four_rank_summaries()[:1]) is None
+    assert straggler_score([]) is None
+    # a custom factor can flag the mild skew
+    assert straggler_score(four_rank_summaries(slow_factor=1.2), factor=1.1) is not None
+
+
+def test_step_summary_payload_roundtrip_filters_unknown_fields():
+    s = four_rank_summaries()[1]
+    payload = s.payload()
+    payload["from_the_future"] = {"x": 1}  # newer writer: ignored on read
+    back = StepSummary.from_payload(payload)
+    assert back == s
+
+
+def test_gang_view_report_and_export():
+    reg = MetricsRegistry()
+    view = GangView(4, four_rank_summaries(slow_rank=3, slow_factor=3.0))
+    rep = view.report()
+    assert rep["ranks_reporting"] == 4 and not rep["local_only"]
+    assert rep["p50_median_ms"] == pytest.approx(10.0)
+    assert rep["p50_skew"] == pytest.approx(3.0)
+    assert rep["mfu_mean"] == pytest.approx(0.4)
+    assert rep["straggler"]["rank"] == 3
+    view.export(reg)
+    snap = reg.snapshot()
+    assert snap["gang_ranks_reporting"] == 4
+    assert snap["gang_straggler_rank"] == 3
+    assert snap["gang_step_p50_skew"] == pytest.approx(3.0)
+    prom = reg.to_prometheus()
+    assert "bagua_gang_step_p50_ms_median" in prom
+    # no straggler -> sentinel values, not a missing gauge
+    GangView(4, four_rank_summaries(slow_factor=1.0)).export(reg)
+    snap = reg.snapshot()
+    assert snap["gang_straggler_rank"] == -1 and snap["gang_straggler_score"] == 0.0
+
+
+def test_summarize_telemetry_reads_registry(tmp_path):
+    tel = Telemetry(metrics_jsonl=str(tmp_path / "m.jsonl"))
+    for i in range(6):
+        tel.on_step(step=i, wall_s=0.010, n_samples=32, wire_bytes=1000)
+    tel.registry.gauge("mfu").set(0.33)
+    tel.registry.gauge("health_loss").set(1.25)
+    s = summarize_telemetry(tel, rank=3, step=6, window=6,
+                            phase_ms={"dispatch": 5.0})
+    assert s.rank == 3 and s.step == 6 and s.window == 6
+    assert s.p50_ms == pytest.approx(10.0, rel=0.01)
+    assert s.wire_bytes == 6000
+    assert s.mfu == pytest.approx(0.33)
+    assert s.phase_ms == {"dispatch": 5.0}
+    assert s.health["health_loss"] == pytest.approx(1.25)
+    tel.close()
+
+
+# -- KV push/collect against a real server ------------------------------------
+
+
+@pytest.fixture()
+def kv_server():
+    st = RendezvousState(min_nodes=1, settle_s=0.05)
+    port = free_port()
+    server = start_rendezvous_server(st, port, host="127.0.0.1")
+    try:
+        yield port
+    finally:
+        server.shutdown()
+
+
+def test_push_collect_roundtrip_over_real_kv(kv_server):
+    port = kv_server
+    aggs = [
+        GangAggregator(
+            RendezvousClient(f"127.0.0.1:{port}", node_rank=r, timeout_s=10),
+            rank=r, world_size=4, attempt="a7", window=20,
+        )
+        for r in range(4)
+    ]
+    summaries = four_rank_summaries(slow_rank=1, slow_factor=2.5)
+    # non-zero ranks push and get no view back
+    for r in (1, 2, 3):
+        assert aggs[r].aggregate(summaries[r]) is None
+    reg = MetricsRegistry()
+    aggs[0].registry = reg
+    view = aggs[0].aggregate(summaries[0])
+    assert view is not None and view.ranks_reporting == 4
+    assert not view.local_only
+    assert view.straggler["rank"] == 1 and view.straggler["phase"] == "data"
+    assert reg.snapshot()["gang_degraded"] == 0
+    # attempt nonce namespaces the keys: a different attempt sees nothing
+    other = GangAggregator(aggs[0].client, rank=0, world_size=4, attempt="b0")
+    assert other.collect() == []
+    assert aggs[0].client.kv_get(gang_kv_key("a7", 1))["rank"] == 1
+
+
+def test_partial_gang_is_marked_local_only(kv_server):
+    port = kv_server
+    agg = GangAggregator(
+        RendezvousClient(f"127.0.0.1:{port}", node_rank=0, timeout_s=10),
+        rank=0, world_size=4, attempt="pp",
+    )
+    view = agg.aggregate(four_rank_summaries()[0])  # nobody else published
+    assert view.ranks_reporting == 1 and view.local_only
+
+
+# -- degradation --------------------------------------------------------------
+
+
+def test_dead_endpoint_degrades_to_local_only(monkeypatch):
+    reg = MetricsRegistry()
+    # nothing listens on this port; client must fail fast, never raise
+    monkeypatch.setenv("BAGUA_RPC_RETRIES", "0")
+    client = RendezvousClient(f"127.0.0.1:{free_port()}", node_rank=0, timeout_s=1)
+    breaker = CircuitBreaker(failure_threshold=2, cooldown_s=60.0, name="t")
+    agg = GangAggregator(client, rank=0, world_size=4, attempt="x",
+                         registry=reg, breaker=breaker)
+    s = four_rank_summaries()[0]
+    for _ in range(3):  # trips the breaker on the way
+        view = agg.aggregate(s)
+        assert view is not None and view.local_only
+        assert view.ranks_reporting == 1 and view.summaries[0].rank == 0
+    snap = reg.snapshot()
+    assert snap["gang_degraded"] == 1 and snap["gang_local_only"] == 1
+    assert snap["gang_push_failures_total"] == 3
+
+
+def test_no_client_is_a_clean_local_only_view():
+    reg = MetricsRegistry()
+    agg = GangAggregator(None, rank=0, world_size=2, registry=reg)
+    view = agg.aggregate(four_rank_summaries()[0])
+    assert view.local_only and view.ranks_reporting == 1
+    # deliberate local-only mode is configuration, not failure: no counter
+    assert "gang_push_failures_total" not in reg.snapshot()
+    assert reg.snapshot()["gang_degraded"] == 1
+
+
+def test_trainer_gang_window_exports_local_view(group, tmp_path):
+    """Trainer(gang_window=N) builds the aggregator lazily and ticks it on
+    cadence; single-process (no KV endpoint) runs local-only end to end."""
+    import jax
+    import numpy as np
+    import optax
+
+    from bagua_tpu.algorithms import Algorithm
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+    from bagua_tpu.trainer import Trainer
+
+    tel = Telemetry(metrics_jsonl=str(tmp_path / "m.jsonl"))
+    rng = np.random.RandomState(0)
+
+    def batches(n):
+        for _ in range(n):
+            yield (rng.randn(16, 8).astype(np.float32),
+                   rng.randn(16, 4).astype(np.float32))
+
+    with Trainer(
+        mse_loss, optax.sgd(0.05), Algorithm.init("gradient_allreduce"),
+        process_group=group, watchdog_timeout_s=0, telemetry=tel,
+        gang_window=3,
+    ) as t:
+        state = t.init_state(init_mlp(jax.random.PRNGKey(0), [8, 16, 4]))
+        assert t.gang is not None and t.gang.window == 3
+        t.fit(state, batches(7))
+    view = t.gang.last_view
+    assert view is not None and view.ranks_reporting == 1
+    assert view.summaries[0].phase_ms  # host-overhead attribution rode along
+    snap = tel.registry.snapshot()
+    assert snap["gang_ranks_reporting"] == 1
+    tel.close()
+
+
+def test_tick_is_window_cadenced(tmp_path):
+    tel = Telemetry(metrics_jsonl=str(tmp_path / "m.jsonl"))
+    tel.on_step(step=0, wall_s=0.01, n_samples=8, wire_bytes=10)
+    agg = GangAggregator(None, rank=0, world_size=1, window=5)
+    assert agg.tick(0, tel) is None     # step 0 never aggregates
+    assert agg.tick(3, tel) is None     # off-cadence
+    view = agg.tick(5, tel)
+    assert view is not None and view.summaries[0].step == 5
+    tel.close()

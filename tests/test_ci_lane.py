@@ -39,6 +39,7 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert "telemetry metrics schema check passed" in proc.stderr
     assert "autotune planner lane passed" in proc.stderr
     assert "fault-injection resilience lane passed" in proc.stderr
+    assert "health guardrail lane passed" in proc.stderr
 
     # The telemetry smoke emits a JSONL metrics stream next to --out; hold it
     # to the event schema here too (belt and braces: the subprocess already
@@ -93,6 +94,31 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert fi["lost_steps"] <= 2 * fi["snapshot_every"]
     assert audit["resilience"]["fault_injection"] == fi
     assert resilience["overhead"]["p50_on_ms"] > 0
+
+    # The health-guardrail lane's artifact: the detector fired on the
+    # synthetic loss spike AND the forced NaN, the demotion action moved
+    # the planner-chosen int8 wire to f32 (census-confirmed: zero uint8
+    # collective bytes afterwards), and the NaN latch is set.
+    health = audit["health"]
+    kinds = {a["kind"] for a in health["alerts"]}
+    assert {"loss_spike", "nonfinite"} <= kinds
+    assert any("precision_demotion" in a["actions"] for a in health["alerts"])
+    assert set(health["precisions_before"]) == {"int8"}
+    assert set(health["precisions_after"]) == {"f32"}
+    assert health["nan_latched"] is True
+    assert health["census_u8_bytes"] == 0
+    assert health["census_f32_allreduce"] >= 1  # f32 all-reduce count post-demotion
+    # the lane's own JSONL stream validated (health_alert schema included)
+    health_metrics = str(out) + "_health_metrics.jsonl"
+    assert os.path.exists(health_metrics)
+    assert validate_metrics_file(health_metrics) == []
+    with open(health_metrics) as f:
+        hev = [json.loads(line) for line in f if line.strip()]
+    assert {e["kind"] for e in hev if e["event"] == "health_alert"} >= {
+        "loss_spike", "nonfinite"}
+    assert any(
+        e["event"] == "precision_switch" and e["reason"].startswith("health:")
+        for e in hev)
 
 
 def test_perf_audit_quick_bytegrad_compressed_census(tmp_path):

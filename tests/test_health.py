@@ -1,0 +1,294 @@
+"""Training-health guardrail: detector units, actions, engine integration.
+
+Pins the acceptance criteria:
+
+* the in-graph health scalars are **bitwise-inert**: training with the
+  monitor on vs off produces bitwise-identical parameters, for both the
+  allreduce and the ZeRO (sharded-optimizer) paths with overlap on;
+* the detector raises ``loss_spike`` (EWMA z-score), ``grad_norm_explosion``
+  (factor over its EWMA), and ``nonfinite`` (latched once), with warmup
+  suppression and NaN-poisoning resistance;
+* ``health_alert`` events validate against the JSONL schema;
+* :class:`PrecisionDemotionAction` demotes a planner-chosen wire plan one
+  rung (int8→f32) under ``wire_precision="auto"`` and refuses to touch a
+  user-pinned precision; :class:`SnapshotOnAnomalyAction` fires exactly once;
+* a raising action is contained — the step loop never sees it.
+"""
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bagua_tpu.algorithms import build_algorithm
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.contrib.zero import zero_optimizer
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.models.mlp import init_mlp, mse_loss
+from bagua_tpu.observability import (
+    HealthConfig,
+    HealthMonitor,
+    PrecisionDemotionAction,
+    SnapshotOnAnomalyAction,
+    Telemetry,
+    health_scalars,
+    validate_metrics_file,
+)
+
+LAYERS = [12, 16, 16, 4]
+N = 8
+
+
+def make_batch(seed=0, batch=32, scale=1.0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(batch, LAYERS[0]).astype(np.float32))
+    y = jnp.asarray(scale * rng.randn(batch, LAYERS[-1]).astype(np.float32))
+    return x, y
+
+
+# -- in-graph scalars ---------------------------------------------------------
+
+
+def test_health_scalars_values():
+    grads = {"w": jnp.asarray([3.0, 4.0], jnp.float32),
+             "b": jnp.asarray([[0.0]], jnp.float32),
+             "n_steps": jnp.asarray(7, jnp.int32)}  # non-inexact leaf: ignored
+    h = np.asarray(health_scalars(jnp.asarray(2.5), grads))
+    assert h.shape == (3,) and h.dtype == np.float32
+    assert h[0] == pytest.approx(2.5)
+    assert h[1] == pytest.approx(5.0)  # sqrt(9+16+0)
+    assert h[2] == 0.0
+
+    grads["w"] = jnp.asarray([np.nan, np.inf], jnp.float32)
+    h = np.asarray(health_scalars(jnp.asarray(1.0), grads))
+    assert h[2] == 2.0 and not math.isfinite(float(h[1]))
+
+
+# -- detector units -----------------------------------------------------------
+
+
+def feed_steady(mon, n, loss=1.0, gn=1.0, start=0):
+    for i in range(n):
+        assert mon.observe(step=start + i, loss=loss, grad_norm=gn, nonfinite=0) == []
+
+
+def test_warmup_suppresses_alerts():
+    mon = HealthMonitor(config=HealthConfig(warmup_steps=5, loss_z_threshold=2.0))
+    # wild values during warmup: no alerts while the EWMAs settle
+    for i, loss in enumerate([1.0, 100.0, 0.01, 50.0, 2.0]):
+        assert mon.observe(step=i, loss=loss, grad_norm=1.0, nonfinite=0) == []
+    assert mon.report()["observed_steps"] == 5
+
+
+def test_loss_spike_z_score():
+    mon = HealthMonitor(config=HealthConfig(warmup_steps=3, loss_z_threshold=6.0))
+    feed_steady(mon, 10, loss=1.0)
+    alerts = mon.observe(step=10, loss=1000.0, grad_norm=1.0, nonfinite=0)
+    assert [a["kind"] for a in alerts] == ["loss_spike"]
+    a = alerts[0]
+    assert a["value"] == 1000.0 and a["threshold"] == 6.0 and a["step"] == 10
+    assert "z=" in a["detail"]
+    # a flat loss cannot alert on numerical noise (min_std floor)
+    mon2 = HealthMonitor(config=HealthConfig(warmup_steps=3))
+    feed_steady(mon2, 10, loss=1.0)
+    assert mon2.observe(step=10, loss=1.0 + 1e-9, grad_norm=1.0, nonfinite=0) == []
+
+
+def test_grad_norm_explosion():
+    mon = HealthMonitor(config=HealthConfig(warmup_steps=3, grad_norm_factor=10.0,
+                                            loss_z_threshold=1e9))
+    feed_steady(mon, 8, gn=2.0)
+    alerts = mon.observe(step=8, loss=1.0, grad_norm=50.0, nonfinite=0)
+    assert [a["kind"] for a in alerts] == ["grad_norm_explosion"]
+    assert alerts[0]["threshold"] == pytest.approx(20.0)
+
+
+def test_nan_latch_fires_once_and_does_not_poison_ewma():
+    mon = HealthMonitor(config=HealthConfig(warmup_steps=3, loss_z_threshold=6.0))
+    feed_steady(mon, 8, loss=1.0)
+    mean_before = mon._loss_mean
+    alerts = mon.observe(step=8, loss=float("nan"), grad_norm=1.0, nonfinite=3)
+    assert [a["kind"] for a in alerts] == ["nonfinite"]
+    assert mon.nan_latched
+    # the NaN never entered the EWMA
+    assert mon._loss_mean == pytest.approx(mean_before, rel=1e-6)
+    # second nonfinite step: counted, not re-alerted
+    assert mon.observe(step=9, loss=float("inf"), grad_norm=1.0, nonfinite=1) == []
+    # a healthy step afterwards is still judged against clean statistics
+    assert mon.observe(step=10, loss=1.0, grad_norm=1.0, nonfinite=0) == []
+
+
+def test_actions_run_in_order_and_failures_are_contained():
+    calls = []
+
+    def ok(alert, state):
+        calls.append("ok")
+        return True
+
+    def declined(alert, state):
+        calls.append("declined")
+        return False
+
+    def boom(alert, state):
+        calls.append("boom")
+        raise RuntimeError("action blew up")
+
+    ok.name = "ok_action"
+    mon = HealthMonitor(config=HealthConfig(warmup_steps=1, loss_z_threshold=2.0),
+                        actions=[ok, declined, boom])
+    feed_steady(mon, 5, loss=1.0)
+    alerts = mon.observe(step=5, loss=1e6, grad_norm=1.0, nonfinite=0)
+    assert len(alerts) == 1
+    # only the applier is recorded; the raiser was logged and skipped
+    assert alerts[0]["actions"] == ["ok_action"]
+    assert calls == ["ok", "declined", "boom"]
+
+
+def test_alert_history_ring_is_bounded():
+    mon = HealthMonitor(config=HealthConfig(warmup_steps=1, loss_z_threshold=2.0,
+                                            max_alerts=4))
+    feed_steady(mon, 3, loss=1.0)
+    raised = []
+    for i in range(10):
+        # geometric spikes: each is far outside even the post-spike EWMA std
+        raised += mon.observe(step=10 + i, loss=1e3 * 100.0 ** i, grad_norm=1.0,
+                              nonfinite=0)
+    assert len(raised) > 4  # enough alerts to overflow the ring...
+    assert len(mon.alerts) <= 4  # ...which keeps only the most recent
+    assert mon.alerts == raised[-len(mon.alerts):]
+
+
+def test_health_alert_event_schema(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    tel = Telemetry(metrics_jsonl=path)
+    mon = HealthMonitor(telemetry=tel,
+                        config=HealthConfig(warmup_steps=1, loss_z_threshold=2.0))
+    feed_steady(mon, 5, loss=1.0)
+    mon.observe(step=5, loss=1e6, grad_norm=1.0, nonfinite=0)
+    tel.close()
+    assert validate_metrics_file(path) == []
+    events = [json.loads(l) for l in open(path)]
+    ha = [e for e in events if e["event"] == "health_alert"]
+    assert len(ha) == 1
+    assert ha[0]["kind"] == "loss_spike" and ha[0]["step"] == 5
+    assert isinstance(ha[0]["value"], float) and isinstance(ha[0]["actions"], list)
+
+
+# -- bitwise inertness (acceptance) -------------------------------------------
+
+
+def _run_steps(group, opt_fn, monitor, n_steps=4):
+    ddp = DistributedDataParallel(
+        mse_loss, opt_fn(), GradientAllReduceAlgorithm(),
+        process_group=group, bucket_size_bytes=1 << 9, overlap=True,
+        health_monitor=monitor,
+    )
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    for i in range(n_steps):
+        state, _ = ddp.train_step(state, make_batch(seed=i))
+    leaves = [np.asarray(l) for l in jax.tree.leaves(state.params)]
+    ddp.shutdown()
+    return leaves
+
+
+@pytest.mark.parametrize("opt_fn", [
+    pytest.param(lambda: optax.adam(1e-2), id="gradient_allreduce"),
+    pytest.param(lambda: zero_optimizer(optax.adam(1e-2), n_shards=N), id="zero"),
+])
+def test_monitor_is_bitwise_inert(group, opt_fn):
+    """Params after N overlapped steps are bitwise-identical with the
+    monitor on vs off — the health scalars are pure reads."""
+    mon = HealthMonitor(config=HealthConfig(warmup_steps=1))
+    with_mon = _run_steps(group, opt_fn, mon)
+    without = _run_steps(group, opt_fn, None)
+    assert mon.report()["observed_steps"] > 0  # the monitor really observed
+    for a, b in zip(with_mon, without):
+        np.testing.assert_array_equal(a, b)
+        assert a.tobytes() == b.tobytes()
+
+
+# -- actions against the real engine ------------------------------------------
+
+
+def test_precision_demotion_under_auto_plan(group):
+    """The verified demotion recipe: wire_precision="auto" + a
+    planner-adopted int8 plan; a loss spike demotes every bucket to f32."""
+    mon = HealthMonitor(config=HealthConfig(warmup_steps=2, loss_z_threshold=4.0))
+    ddp = DistributedDataParallel(
+        mse_loss, optax.sgd(0.05),
+        build_algorithm("gradient_allreduce", wire_precision="auto"),
+        # "auto" holds per-bucket EF state, so backward-overlap is fenced
+        process_group=group, bucket_size_bytes=1 << 9, overlap="auto",
+        health_monitor=mon,
+    )
+    mon.register_action(PrecisionDemotionAction(ddp))
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    state, _ = ddp.train_step(state, make_batch(0))  # plan exists after warmup
+    assert ddp.apply_precision_plan(["int8"] * ddp.plan.num_buckets,
+                                    reason="planner")
+    for i in range(1, 6):
+        state, _ = ddp.train_step(state, make_batch(i))
+    assert mon.alerts == []
+    assert list(ddp.impl.bucket_precisions(ddp.plan)) == ["int8"] * ddp.plan.num_buckets
+    # synthetic divergence: targets scaled 1000x
+    state, _ = ddp.train_step(state, make_batch(99, scale=1000.0))
+    kinds = {a["kind"] for a in mon.alerts}
+    assert "loss_spike" in kinds
+    applied = [a for a in mon.alerts if "precision_demotion" in a["actions"]]
+    assert applied, mon.alerts
+    assert list(ddp.impl.bucket_precisions(ddp.plan)) == ["f32"] * ddp.plan.num_buckets
+    # training continues on the demoted wire
+    state, _ = ddp.train_step(state, make_batch(100))
+    ddp.shutdown()
+
+
+def test_precision_demotion_refuses_pinned_precision(group):
+    """A uniform pinned wire_precision is an explicit operator choice —
+    the action declines instead of overriding it."""
+    ddp = DistributedDataParallel(
+        mse_loss, optax.sgd(0.05),
+        build_algorithm("gradient_allreduce", wire_precision="int8"),
+        process_group=group, bucket_size_bytes=1 << 9, overlap=False,
+    )
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    state, _ = ddp.train_step(state, make_batch(0))
+    action = PrecisionDemotionAction(ddp)
+    assert action({"kind": "loss_spike"}, None) is False
+    assert list(ddp.impl.bucket_precisions(ddp.plan)) == ["int8"] * ddp.plan.num_buckets
+    ddp.shutdown()
+
+
+def test_precision_demotion_noop_without_knob_or_at_f32(group):
+    ddp = DistributedDataParallel(
+        mse_loss, optax.sgd(0.05), GradientAllReduceAlgorithm(),
+        process_group=group, bucket_size_bytes=1 << 9, overlap=False,
+    )
+    action = PrecisionDemotionAction(ddp)
+    assert action({"kind": "loss_spike"}, None) is False  # no plan yet
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    state, _ = ddp.train_step(state, make_batch(0))
+    assert action({"kind": "loss_spike"}, None) is False  # plain f32 algorithm
+    ddp.shutdown()
+
+
+def test_snapshot_on_anomaly_fires_once():
+    class Snap:
+        def __init__(self):
+            self.calls = []
+
+        def snapshot(self, state, step, blocking=False, kind="async"):
+            self.calls.append((step, blocking, kind))
+
+    snap = Snap()
+    action = SnapshotOnAnomalyAction(snap)
+    assert action({"kind": "loss_spike", "step": 7}, state={"p": 1}) is True
+    assert action({"kind": "nonfinite", "step": 8}, state={"p": 1}) is False
+    assert snap.calls == [(7, True, "anomaly")]
+    # no state (detector-only caller): declines without firing
+    fresh = SnapshotOnAnomalyAction(snap)
+    assert fresh({"kind": "loss_spike", "step": 1}, state=None) is False
+    assert not fresh.fired

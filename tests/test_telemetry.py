@@ -41,6 +41,7 @@ from bagua_tpu.observability import (
     analyze_trace,
     parse_exchange_label,
     parse_step_phase,
+    rotated_metrics_files,
     validate_metrics_event,
     validate_metrics_file,
 )
@@ -192,6 +193,7 @@ def test_recompile_detector_steady_state_is_quiet():
     assert rep == {
         "steps": 5, "retraces": 0, "alerts": 0,
         "compiles_by_variant": {"default": 1},
+        "compile_ms_total": 0.0, "compile_ms_by_variant": {},
     }
 
 
@@ -220,6 +222,7 @@ def test_recompile_detector_rearms_after_quiet_window():
     assert det.report() == {
         "steps": 3, "retraces": 2, "alerts": 2,
         "compiles_by_variant": {"v": 3},
+        "compile_ms_total": 0.0, "compile_ms_by_variant": {},
     }
 
 
@@ -293,7 +296,14 @@ def test_metrics_registry_instruments():
     prom = reg.to_prometheus()
     assert "# TYPE bagua_c counter" in prom and "bagua_c 3" in prom
     assert "# TYPE bagua_g gauge" in prom
-    assert "bagua_h_count 100" in prom and 'bagua_h{quantile="0.50"}' in prom
+    # histograms export as conformant summaries: quantile-labeled samples
+    # (bare quantile values, "0.5" not "0.50") followed by _count/_sum
+    assert 'bagua_h{quantile="0.5"} 51.0' in prom
+    assert 'bagua_h{quantile="0.95"}' in prom and 'bagua_h{quantile="0.99"}' in prom
+    assert "bagua_h_count 100" in prom
+    assert f"bagua_h_sum {float(sum(range(1, 101)))}" in prom
+    # quantile samples precede the _count/_sum pair within the family
+    assert prom.index('bagua_h{quantile="0.5"}') < prom.index("bagua_h_count")
 
 
 def test_histogram_window_is_recent_tail():
@@ -325,6 +335,47 @@ def test_event_schema_validation(tmp_path):
     problems = validate_metrics_file(path)
     assert any("not JSON" in p for p in problems)
     assert any("'step'" in p for p in problems)
+
+
+def test_jsonl_sink_rotation_and_rotated_validation(tmp_path, monkeypatch):
+    path = str(tmp_path / "m.jsonl")
+    ev = {"event": "custom", "step": 0, "ts": 1.0}
+    line_len = len(json.dumps(ev, sort_keys=True)) + 1
+    # room for ~2 lines per file: every 3rd emit rotates
+    with JsonlSink(path, max_bytes=2 * line_len + 1) as sink:
+        for i in range(7):
+            sink.emit({"event": "custom", "step": i, "ts": 1.0})
+    files = rotated_metrics_files(path)
+    assert files[-1] == path and len(files) > 1
+    assert all(os.path.exists(f) for f in files)
+    # no event lost, order preserved oldest-file-first, no line split
+    steps = []
+    for f in files:
+        with open(f) as fh:
+            steps.extend(json.loads(ln)["step"] for ln in fh)
+    assert steps == list(range(7))
+    assert validate_metrics_file(path) == []
+    # a bad line in a rotated segment is reported with the segment's name
+    with open(files[0], "a") as fh:
+        fh.write("not json\n")
+    problems = validate_metrics_file(path)
+    assert any(os.path.basename(files[0]) in p for p in problems)
+
+    # default off: no rotation regardless of size
+    monkeypatch.delenv("BAGUA_METRICS_MAX_MB", raising=False)
+    path2 = str(tmp_path / "n.jsonl")
+    with JsonlSink(path2) as sink:
+        for i in range(50):
+            sink.emit({"event": "custom", "step": i, "ts": 1.0})
+    assert rotated_metrics_files(path2) == [path2]
+
+    # BAGUA_METRICS_MAX_MB drives the default ceiling (fractional MiB ok)
+    monkeypatch.setenv("BAGUA_METRICS_MAX_MB", str(2 * line_len / (1 << 20)))
+    path3 = str(tmp_path / "o.jsonl")
+    with JsonlSink(path3) as sink:
+        for i in range(5):
+            sink.emit({"event": "custom", "step": i, "ts": 1.0})
+    assert len(rotated_metrics_files(path3)) > 1
 
 
 # -- StepTimer and Watchdog satellites ----------------------------------------
