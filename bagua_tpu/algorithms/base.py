@@ -42,6 +42,7 @@ from bagua_tpu.bucket import BucketPlan
 from bagua_tpu.communication import BaguaProcessGroup
 from bagua_tpu.env import get_default_bucket_size
 from bagua_tpu.observability.annotations import bucket_scope
+from bagua_tpu.observability.flight_recorder import notify_collective
 
 
 @dataclasses.dataclass
@@ -113,7 +114,13 @@ class AlgorithmImpl:
     def annotate(self, bucket_idx, phase: str):
         """Named scope labeling one bucket's exchange ops in the device trace
         (``bagua_ex/algo=<name>/bucket=<i>/phase=<phase>``).  Pure metadata —
-        wrapping traced code in it never changes the computation."""
+        wrapping traced code in it never changes the computation.
+
+        Doubles as the flight recorder's trace-time capture point: every
+        exchange path wraps its bucket collective in ``annotate``, so one
+        notification here records the whole collective program of a step
+        variant (a no-op unless the engine has a capture active)."""
+        notify_collective(self.algo_name or type(self).__name__, bucket_idx, phase)
         return bucket_scope(self.algo_name or type(self).__name__, bucket_idx, phase)
 
     # -- structure ----------------------------------------------------------

@@ -158,6 +158,11 @@ class DistributedDataParallel:
         #: dashboard can line up throughput shifts with plan swaps
         self.plan_version = 0
         self._step_fns = {}
+        # Per-variant collective programs for the flight recorder: captured
+        # once at trace time, replayed into the ring every dispatch (see
+        # observability/flight_recorder.py).  Keyed like _step_fns; cleared
+        # with it whenever the plan (and so the collective sequence) changes.
+        self._flight_programs = {}
         self._host_step: Optional[int] = None  # seeded from state on first step
         self.speed_meter = SpeedMeter()
         #: cumulative host-side seconds per train_step phase — the
@@ -319,6 +324,7 @@ class DistributedDataParallel:
                 self.optimizer, plan, self.group
             )
         self._step_fns = {}
+        self._flight_programs = {}
         self.plan_version += 1
         if self.telemetry is not None:
             self.telemetry.on_rebucket(
@@ -351,6 +357,7 @@ class DistributedDataParallel:
         if new == old:
             return False
         self._step_fns = {}
+        self._flight_programs = {}
         if self.telemetry is not None:
             self.telemetry.on_precision_switch(
                 step=self._host_step if self._host_step is not None else 0,
@@ -566,6 +573,82 @@ class DistributedDataParallel:
         )
         return jax.jit(sharded, donate_argnums=(0,))
 
+    # -- flight recorder (trace-time capture, dispatch-time replay) ----------
+
+    def _flight_dispatch(self, fn, state, batch, variant, flight, missed):
+        """Dispatch one step, feeding the flight recorder.
+
+        Collectives live inside the jitted step, so a per-step ``record()``
+        in the exchange paths is impossible — they run at trace time.
+        Instead, the cache-miss dispatch (jit traces synchronously inside
+        the first call) runs under a capture context: every
+        ``AlgorithmImpl.annotate`` and quantized-ring call notifies it,
+        yielding this variant's ordered collective program.  Every dispatch
+        then replays the program into the ring — records are appended
+        (unretired) *before* the enqueue and retired after it, so a host
+        that wedges inside the dispatch window leaves unretired records as
+        evidence.  Nothing here touches the traced computation: recorder on
+        vs off is bitwise-inert (pinned in tests)."""
+        if flight is None:
+            return fn(state, batch)
+        from bagua_tpu.observability import flight_recorder as _fr
+
+        prog = self._flight_programs.get(variant)
+        if prog is None and missed:
+            with _fr.capture_program() as events:
+                out = fn(state, batch)
+            prog = self._flight_programs[variant] = self._flight_finalize(
+                variant, events
+            )
+            # the capture dispatch still records; its window is the compile
+            # wall, which the telemetry attributes separately
+            seqs = flight.record_program(prog, step=self._host_step - 1)
+            flight.retire(seqs)
+            return out
+        if not prog:
+            return fn(state, batch)
+        seqs = flight.record_program(prog, step=self._host_step - 1)
+        out = fn(state, batch)
+        flight.retire(seqs)
+        return out
+
+    def _flight_finalize(self, variant, events):
+        """Enrich the captured descriptors into replayable record templates:
+        join bucket index -> plan bytes and planner-chosen wire precision,
+        stamp the plan version, and render the label in the named-scope
+        grammar so ring records and device-trace labels join on one key."""
+        from bagua_tpu.observability.annotations import EXCHANGE_PREFIX
+
+        plan = self.plan
+        precisions = None
+        if plan is not None and hasattr(self.impl, "bucket_precisions"):
+            try:
+                precisions = self.impl.bucket_precisions(plan)
+            except Exception:
+                precisions = None
+        out = []
+        for ev in events:
+            rec = dict(ev)
+            b = int(rec.get("bucket", -1))
+            if "nbytes" not in rec:
+                rec["nbytes"] = (
+                    int(plan.specs[b].nbytes)
+                    if plan is not None and 0 <= b < len(plan.specs) else 0
+                )
+            if "precision" not in rec:
+                rec["precision"] = (
+                    str(precisions[b])
+                    if precisions and 0 <= b < len(precisions) else "f32"
+                )
+            rec["plan_version"] = int(self.plan_version)
+            rec["variant"] = str(variant)
+            rec["label"] = (
+                f"{EXCHANGE_PREFIX}/algo={rec['algo']}/bucket={b}"
+                f"/phase={rec['phase']}"
+            )
+            out.append(rec)
+        return tuple(out)
+
     def train_step(self, state: TrainState, batch):
         """One training step.  ``batch`` leaves have a leading global-batch
         dim divisible by ``group.size``.  Returns ``(new_state, losses)``
@@ -607,9 +690,10 @@ class DistributedDataParallel:
         step_ov["pre"] = t1 - t0
         if tel is not None:
             tel.enter_phase("dispatch")
+        flight = tel.flight if tel is not None else None
         lock = self.impl.host_dispatch_lock
         if lock is None:
-            out = fn(state, batch)
+            out = self._flight_dispatch(fn, state, batch, variant, flight, missed)
             new_state, losses = out[0], out[1]
             t2 = time.perf_counter()
             ov["dispatch"] += t2 - t1
@@ -625,7 +709,7 @@ class DistributedDataParallel:
                 t2 = time.perf_counter()
                 ov["lock_wait"] += t2 - t1
                 step_ov["lock_wait"] = t2 - t1
-                out = fn(state, batch)
+                out = self._flight_dispatch(fn, state, batch, variant, flight, missed)
                 new_state, losses = out[0], out[1]
                 t3 = time.perf_counter()
                 ov["dispatch"] += t3 - t2

@@ -153,10 +153,18 @@ class RendezvousState:
                 m.last_seen = time.monotonic()
             self._reap_locked()
             self._maybe_settle_locked()
+            now = time.monotonic()
             return {
                 "generation": self.generation,
                 "epoch": self.epoch,
                 "settled": self._settled is not None,
+                # per-member heartbeat ages: a silent rank is visible to the
+                # whole gang (as gang_heartbeat_age_s) long before its own
+                # watchdog fires.  str keys — this dict crosses JSON.
+                "ages": {
+                    str(r): round(now - mm.last_seen, 3)
+                    for r, mm in sorted(self._members.items())
+                },
             }
 
     def report_crash(self, node_rank: int, observed_epoch: int) -> dict:
@@ -477,6 +485,9 @@ class RendezvousClient:
         from bagua_tpu.resilience.retry import RetryPolicy
 
         self._retry_policy = RetryPolicy()
+        # freshest per-rank heartbeat ages from the coordinator, updated on
+        # every successful heartbeat(); feeds the gang_heartbeat_age_s gauges
+        self.last_heartbeat_ages: dict = {}
 
     def _call_once(self, path: str, payload: Optional[dict] = None) -> dict:
         import urllib.request
@@ -523,7 +534,13 @@ class RendezvousClient:
             pass  # coordinator may already be gone at shutdown
 
     def heartbeat(self) -> dict:
-        return self._call("/rdzv/heartbeat", {"node_rank": self.node_rank})
+        out = self._call("/rdzv/heartbeat", {"node_rank": self.node_rank})
+        ages = out.get("ages")
+        if isinstance(ages, dict):
+            self.last_heartbeat_ages = {
+                int(k): float(v) for k, v in ages.items()
+            }
+        return out
 
     def request_restart(self, observed_epoch: int) -> dict:
         try:

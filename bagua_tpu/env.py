@@ -128,6 +128,32 @@ def get_metrics_max_mb() -> float:
     return float(os.environ.get("BAGUA_METRICS_MAX_MB", 0) or 0)
 
 
+def get_flight_recorder_enabled() -> bool:
+    """``BAGUA_FLIGHT_RECORDER``: the collective flight recorder — the
+    per-rank black-box ring of one record per collective the engine issues
+    (``observability/flight_recorder.py``).  On by default whenever a
+    telemetry hub is attached; ``0``/``false``/``off`` disables.  The
+    recorder is bitwise-inert either way — the knob trades the (tiny)
+    host-side replay cost for hang forensics."""
+    return os.environ.get("BAGUA_FLIGHT_RECORDER", "1").strip().lower() not in (
+        "0", "false", "off", ""
+    )
+
+
+def get_flight_ring_size() -> int:
+    """``BAGUA_FLIGHT_RING``: flight-recorder ring capacity in records.
+    The default (4096) covers hundreds of steps of a typical bucket plan —
+    far past any watchdog timeout — in ~a few MB of host memory."""
+    return int(os.environ.get("BAGUA_FLIGHT_RING", 4096))
+
+
+def get_dump_dir() -> str:
+    """``BAGUA_DUMP_DIR``: where hang evidence lands (the watchdog's
+    ``watchdog_dump.json``, the flight recorder's ``flight_<rank>.json``).
+    Defaults to the working directory."""
+    return os.environ.get("BAGUA_DUMP_DIR") or "."
+
+
 def get_rpc_retries() -> int:
     """``BAGUA_RPC_RETRIES``: attempts (1 + retries) for service RPCs
     (autotune client, rendezvous KV) before the error surfaces."""

@@ -66,6 +66,7 @@ from bagua_tpu.kernels.minmax_uint8 import (
     decompress_minmax_uint8,
     pallas_chunk_supported,
 )
+from bagua_tpu.observability.flight_recorder import notify_ring
 
 LEVELS4 = 15.0  # int4: 16 levels
 DEFAULT_BLOCK = 4096
@@ -345,6 +346,12 @@ def quantized_ring_reduce_scatter(
         hop = get_ring_hop(bits)
     xb, nblocks = _pad_to_blocks(x, B)          # (n, nblocks, B)
     Sp = nblocks * B
+    # one flight-recorder descriptor per ring (hop count in-record, not one
+    # per hop); fires at trace time, a no-op without an active capture
+    notify_ring(
+        kind="rs", bits=bits, hops=n - 1,
+        wire_bytes=(n - 1) * (Sp // (1 if bits == 8 else 2) + nblocks * 8),
+    )
     idx = rank_id(axis)
     tag = f"qr{bits}"
     with jax.named_scope(f"{tag}_quant"):
@@ -393,6 +400,12 @@ def quantized_allgather(
     comp, deco = _compressors(bits)
     blocks, nblocks = _pad_to_blocks(shard.astype(jnp.float32)[None], B)
     blocks = blocks[0]                           # (nblocks, B)
+    # this rank ships its compressed shard to n-1 peers: one descriptor,
+    # hop count in-record (trace-time, capture-gated)
+    notify_ring(
+        kind="ag", bits=bits, hops=n - 1,
+        wire_bytes=(n - 1) * (nblocks * B // (1 if bits == 8 else 2) + nblocks * 8),
+    )
     tag = f"qr{bits}"
     with jax.named_scope(f"{tag}_ag"):
         q, mm = comp(blocks)

@@ -59,6 +59,18 @@ from bagua_tpu.observability.aggregate import (
     straggler_score,
     summarize_telemetry,
 )
+from bagua_tpu.observability.flight_recorder import (
+    FLIGHT_DUMP_SCHEMA,
+    HANG_REPORT_SCHEMA,
+    VERDICTS,
+    FlightRecorder,
+    build_hang_report,
+    capture_program,
+    flight_dump_path,
+    push_flight_digest,
+    validate_flight_dump,
+    validate_hang_report,
+)
 from bagua_tpu.observability.trace_analysis import (
     COLLECTIVE_OPS,
     analyze_trace,
@@ -113,6 +125,17 @@ __all__ = [
     "StepSummary",
     "straggler_score",
     "summarize_telemetry",
+    # flight recorder / hang forensics
+    "FLIGHT_DUMP_SCHEMA",
+    "HANG_REPORT_SCHEMA",
+    "VERDICTS",
+    "FlightRecorder",
+    "build_hang_report",
+    "capture_program",
+    "flight_dump_path",
+    "push_flight_digest",
+    "validate_flight_dump",
+    "validate_hang_report",
     # trace analysis
     "COLLECTIVE_OPS",
     "analyze_trace",

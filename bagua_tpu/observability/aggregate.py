@@ -99,11 +99,18 @@ class GangView:
     """The joined picture rank 0 (or a degraded rank, about itself) sees."""
 
     def __init__(self, world_size: int, summaries: Sequence[StepSummary],
-                 straggler_factor: float = 1.5, local_only: bool = False):
+                 straggler_factor: float = 1.5, local_only: bool = False,
+                 heartbeat_ages: Optional[Dict[int, float]] = None):
         self.world_size = int(world_size)
         self.summaries = sorted((s for s in summaries if s is not None),
                                 key=lambda s: s.rank)
         self.local_only = bool(local_only)
+        # coordinator-side seconds since each rank's last heartbeat — a rank
+        # can stop heartbeating (hung host) while its stale summary still
+        # reads healthy, so this is surfaced per rank, not folded into skew
+        self.heartbeat_ages: Dict[int, float] = {
+            int(r): float(a) for r, a in (heartbeat_ages or {}).items()
+        }
         self.straggler = straggler_score(self.summaries, factor=straggler_factor)
         p50s = [s.p50_ms for s in self.summaries]
         self.p50_median = statistics.median(p50s) if p50s else 0.0
@@ -125,6 +132,8 @@ class GangView:
             "p50_skew": round(self.skew, 4),
             "mfu_mean": round(self.mfu_mean, 6),
             "straggler": self.straggler,
+            "heartbeat_ages_s": {str(r): round(a, 3)
+                                 for r, a in sorted(self.heartbeat_ages.items())},
             "ranks": [s.payload() for s in self.summaries],
         }
 
@@ -146,6 +155,10 @@ class GangView:
             self.straggler["rank"] if self.straggler else -1)
         g("gang_straggler_score", help="straggler p50 / gang median (0 when none)").set(
             self.straggler["score"] if self.straggler else 0.0)
+        for r, age in sorted(self.heartbeat_ages.items()):
+            g(f"gang_heartbeat_age_s_rank{r}",
+              help="seconds since this rank's last rendezvous heartbeat").set(
+                round(age, 3))
 
 
 def summarize_telemetry(telemetry, rank: int, step: int, window: int = 0,
@@ -260,6 +273,23 @@ class GangAggregator:
                 logger.debug("gang: discarding malformed summary for rank %d", r)
         return out
 
+    def heartbeat_ages(self) -> Dict[int, float]:
+        """Coordinator-reported seconds since each rank's last heartbeat
+        (best-effort, breaker-gated; empty on any KV trouble or when the
+        client predates the ``ages`` reply field)."""
+        if self.client is None or not hasattr(self.client, "heartbeat"):
+            return {}
+        ok, out = self._kv_call(self.client.heartbeat)
+        if not ok or not isinstance(out, dict):
+            return {}
+        ages = out.get("ages")
+        if not isinstance(ages, dict):
+            return {}
+        try:
+            return {int(r): float(a) for r, a in ages.items()}
+        except (TypeError, ValueError):
+            return {}
+
     # -- the per-window entry point -------------------------------------------
 
     def aggregate(self, summary: StepSummary) -> Optional[GangView]:
@@ -276,9 +306,11 @@ class GangAggregator:
             if collected:
                 summaries = collected
                 local_only = len(collected) < self.world_size and self.world_size > 1
+        ages = self.heartbeat_ages()
         view = GangView(self.world_size, summaries,
                         straggler_factor=self.straggler_factor,
-                        local_only=local_only and self.world_size > 1)
+                        local_only=local_only and self.world_size > 1,
+                        heartbeat_ages=ages)
         self.last_view = view
         if self.registry is not None:
             try:

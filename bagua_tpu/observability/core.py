@@ -19,6 +19,7 @@ TPU-native analog of the reference's auxiliary subsystems (SURVEY §5.1-5.2):
 
 import faulthandler
 import logging
+import math
 import os
 import sys
 import threading
@@ -157,14 +158,18 @@ class StepTimer:
 
     def percentiles(self) -> Dict[str, float]:
         """p50/p95/p99 over the ring-buffered recent step times (seconds);
-        empty dict until the first tick."""
+        empty dict until the first tick.  Nearest-rank indexing: the p-th
+        percentile of n samples is the ``ceil(p*n)``-th smallest, so the
+        p50 of a 2-sample ring is the *lower* sample (the old ``int(p*n)``
+        truncation returned the max)."""
         with self._lock:
             n = min(self._ring_n, len(self._ring))
             recent = sorted(self._ring[:n]) if n else []
         if not recent:
             return {}
         def q(p):
-            return recent[min(len(recent) - 1, int(p * len(recent)))]
+            n = len(recent)
+            return recent[min(n - 1, max(0, math.ceil(p * n) - 1))]
         return {"p50": q(0.50), "p95": q(0.95), "p99": q(0.99)}
 
 
@@ -200,6 +205,16 @@ class Watchdog:
         self.check_interval_s = check_interval_s or min(10.0, timeout_s / 3)
         self.on_timeout = on_timeout
         self.snapshot_provider = snapshot_provider
+        # Hang-evidence wiring (all optional; see _dump_evidence): where the
+        # dumps land (None = BAGUA_DUMP_DIR or CWD), the rank's flight
+        # recorder, a hook the telemetry hub binds to emit the ``hang``
+        # JSONL event, and a zero-arg digest pusher (rendezvous KV,
+        # best-effort) the trainer binds.
+        self.dump_dir: Optional[str] = None
+        self.flight_recorder = None
+        self.hang_hook = None
+        self.digest_pusher = None
+        self.last_dump_paths: Dict[str, str] = {}
         self.last_phase: Optional[str] = None
         self._last_beat = time.monotonic()
         self._armed = False
@@ -231,6 +246,58 @@ class Watchdog:
                 ctx["telemetry_error"] = f"{type(e).__name__}: {e}"
         return ctx
 
+    def _dump_evidence(self, silent: float, ctx: Dict) -> Dict[str, str]:
+        """Persist the hang's evidence before any exit path: an atomic
+        ``watchdog_dump.json`` (the timeout context), the rank's flight-
+        recorder ring as ``flight_<rank>.json``, the best-effort digest push
+        and the hub's ``hang`` JSONL event.  Every stage is fenced — a
+        failing disk or KV must not stop the stack dump / process kill."""
+        from bagua_tpu.observability.flight_recorder import (
+            flight_dump_path, write_json_atomic,
+        )
+
+        if self.dump_dir is not None:
+            d = self.dump_dir
+        else:
+            from bagua_tpu.env import get_dump_dir
+
+            d = get_dump_dir()
+        paths: Dict[str, str] = {}
+        try:
+            path = os.path.join(d, "watchdog_dump.json")
+            write_json_atomic(path, {
+                "reason": "watchdog_timeout",
+                "silent_s": round(silent, 3),
+                "timeout_s": self.timeout_s,
+                "mono_at_dump": time.monotonic(),
+                "unix_at_dump": time.time(),
+                **ctx,
+            })
+            paths["watchdog_dump"] = path
+        except Exception:
+            logger.exception("watchdog dump failed")
+        fr = self.flight_recorder
+        if fr is not None:
+            try:
+                path = flight_dump_path(d, fr.rank)
+                fr.dump(path, reason="watchdog_timeout",
+                        telemetry=ctx.get("telemetry"))
+                paths["flight_dump"] = path
+            except Exception:
+                logger.exception("flight dump failed")
+            if self.digest_pusher is not None:
+                try:
+                    self.digest_pusher()
+                except Exception:
+                    logger.exception("flight digest push failed")
+        if self.hang_hook is not None:
+            try:
+                self.hang_hook("watchdog_timeout", ctx, paths)
+            except Exception:
+                logger.exception("hang hook failed")
+        self.last_dump_paths = paths
+        return paths
+
     def _run(self) -> None:
         while not self._stopped.wait(self.check_interval_s):
             if not self._armed:
@@ -245,6 +312,10 @@ class Watchdog:
                     self.timeout_s,
                     ctx,
                 )
+                # evidence first — the dump files and the hub's ``hang``
+                # event must exist before any exit path (on_timeout or the
+                # os._exit below) can erase the scene
+                self._dump_evidence(silent, ctx)
                 if self.on_timeout is not None:
                     self.on_timeout(silent)
                     self._armed = False

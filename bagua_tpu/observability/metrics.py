@@ -18,6 +18,7 @@ thread-safe; nothing touches the traced step.
 """
 
 import json
+import math
 import os
 import threading
 import time
@@ -82,13 +83,18 @@ class Histogram:
             self.sum += float(value)
 
     def percentiles(self) -> Dict[str, float]:
+        """Nearest-rank percentiles (the p-th is the ``ceil(p*n)``-th
+        smallest sample — same indexing as ``StepTimer.percentiles``; the
+        old ``int(p*n)`` truncation biased small rings high, returning the
+        max as the p50 of a 2-sample ring)."""
         with self._lock:
             n = min(self._n, len(self._ring))
             recent = sorted(self._ring[:n]) if n else []
         if not recent:
             return {}
         def q(p):
-            return recent[min(len(recent) - 1, int(p * len(recent)))]
+            n = len(recent)
+            return recent[min(n - 1, max(0, math.ceil(p * n) - 1))]
         return {"p50": q(0.50), "p95": q(0.95), "p99": q(0.99)}
 
 
@@ -231,6 +237,15 @@ EVENT_PAYLOAD_FIELDS = {
         "value": (int, float),
         "threshold": (int, float),
         "actions": list,
+    },
+    # the watchdog declared this rank hung (reason: watchdog_timeout /
+    # sigterm); emitted + flushed BEFORE any exit path runs, so the event
+    # survives the process kill.  Optional extras: dumps (the evidence file
+    # paths) and flight_last_seq (the flight recorder's newest sequence
+    # number, joining this event to the per-rank flight dump).
+    "hang": {
+        "reason": str,
+        "last_phase": str,
     },
 }
 

@@ -133,6 +133,12 @@ class Telemetry:
             feed (phases → ledger buckets, steps → MFU, compile/snapshot/
             restart walls → their ledger buckets).  The hub points the
             meter's gauges at its own registry.
+        flight: the collective flight recorder
+            (:class:`~bagua_tpu.observability.flight_recorder.FlightRecorder`)
+            the engine replays its collective programs into.  The default
+            ``"auto"`` builds one sized by ``BAGUA_FLIGHT_RING`` unless
+            ``BAGUA_FLIGHT_RECORDER=0``; pass ``None`` to disable or an
+            instance to adopt.  Bitwise-inert either way.
     """
 
     def __init__(
@@ -143,6 +149,7 @@ class Telemetry:
         retrace_window: int = 100,
         max_retraces_per_window: int = 2,
         goodput=None,
+        flight="auto",
     ):
         self.registry = registry or MetricsRegistry()
         self.goodput = goodput
@@ -153,9 +160,27 @@ class Telemetry:
             window=retrace_window, max_retraces_per_window=max_retraces_per_window
         )
         self.step_timer = StepTimer()
+        if flight == "auto":
+            from bagua_tpu.env import (
+                get_flight_recorder_enabled,
+                get_flight_ring_size,
+                get_rank,
+                get_world_size,
+            )
+
+            flight = None
+            if get_flight_recorder_enabled():
+                from bagua_tpu.observability.flight_recorder import FlightRecorder
+
+                flight = FlightRecorder(
+                    capacity=get_flight_ring_size(),
+                    rank=get_rank(),
+                    world_size=get_world_size(),
+                )
+        self.flight = flight
         self.watchdog = watchdog
-        if watchdog is not None and watchdog.snapshot_provider is None:
-            watchdog.snapshot_provider = self.snapshot
+        if watchdog is not None:
+            self.bind_watchdog(watchdog)
         # last known host position — what the watchdog dump reports
         self.current_phase: str = "init"
         self.current_step: int = -1
@@ -163,6 +188,19 @@ class Telemetry:
         self._t_start = time.time()
 
     # -- host position (phases, watchdog) ------------------------------------
+
+    def bind_watchdog(self, watchdog: Watchdog) -> None:
+        """Point a watchdog's evidence hooks at this hub (idempotent; only
+        unset hooks are claimed): timeout dumps carry :meth:`snapshot`, the
+        flight recorder rides along, and the hub's :meth:`on_hang` emits the
+        schema-validated ``hang`` event before any exit path runs."""
+        self.watchdog = watchdog
+        if watchdog.snapshot_provider is None:
+            watchdog.snapshot_provider = self.snapshot
+        if getattr(watchdog, "flight_recorder", None) is None:
+            watchdog.flight_recorder = self.flight
+        if getattr(watchdog, "hang_hook", None) is None:
+            watchdog.hang_hook = self.on_hang
 
     def enter_phase(self, phase: str) -> None:
         """Mark the host's position in the step (``data`` → ``dispatch`` →
@@ -444,6 +482,31 @@ class Telemetry:
                  "value": float(value), "threshold": float(threshold),
                  "detail": str(detail), "actions": [str(a) for a in actions]}
             )
+
+    def on_hang(self, reason: str, ctx: Optional[dict] = None,
+                dump_paths: Optional[dict] = None) -> None:
+        """The watchdog (or a preemption drain) declared this rank hung:
+        bump ``hangs_total`` and emit the schema-validated ``hang`` JSONL
+        event, then flush — the process may be about to ``os._exit``, and
+        the event must already be on disk when the restart loop's collector
+        arrives.  Bound to ``Watchdog.hang_hook`` so it runs *before*
+        ``on_timeout``."""
+        ctx = ctx or {}
+        self.registry.counter(
+            "hangs_total", help="watchdog timeouts / hang declarations"
+        ).inc()
+        if self.jsonl:
+            event = {
+                "event": "hang", "step": int(self.current_step),
+                "reason": str(reason),
+                "last_phase": str(ctx.get("last_phase") or self.current_phase),
+            }
+            if dump_paths:
+                event["dumps"] = {k: str(v) for k, v in sorted(dump_paths.items())}
+            if self.flight is not None:
+                event["flight_last_seq"] = int(self.flight.last_seq)
+            self.jsonl.emit(event)
+            self.flush()
 
     def _emit_alert(self, msg: str, retraces_in_window: int) -> None:
         self.registry.counter(

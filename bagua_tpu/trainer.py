@@ -112,11 +112,11 @@ class Trainer:
         )
         if self.watchdog is not None and telemetry is not None:
             # hub heartbeats carry the step phase; hang dumps carry the hub's
-            # snapshot (step, phase, variant, recompile report)
-            if telemetry.watchdog is None:
-                telemetry.watchdog = self.watchdog
-            if self.watchdog.snapshot_provider is None:
-                self.watchdog.snapshot_provider = telemetry.snapshot
+            # snapshot (step, phase, variant, recompile report), the flight
+            # ring, and a hang event through the hub's sinks
+            telemetry.bind_watchdog(self.watchdog)
+            if self.watchdog.digest_pusher is None:
+                self.watchdog.digest_pusher = self._push_flight_digest
         self._session: Optional[AutotuneSession] = None
         # xprof capture of steps [a, b) once compilation has settled
         # (docs/performance.md "profile -> fix -> repeat").
@@ -227,6 +227,18 @@ class Trainer:
             logger.warning("rendezvous client unavailable for resume (%s)", e)
             return None
 
+    def _push_flight_digest(self) -> bool:
+        """Best-effort push of this rank's flight-ring digest through the
+        rendezvous KV (retry/breaker-guarded inside; local-only degradation
+        on outage).  Called from the watchdog's evidence dump and the
+        preemption drain."""
+        fr = getattr(self.telemetry, "flight", None) if self.telemetry else None
+        if fr is None:
+            return False
+        from bagua_tpu.observability.flight_recorder import push_flight_digest
+
+        return push_flight_digest(self._rendezvous_client(), fr)
+
     def fit(self, state, batches: Iterable, n_steps: Optional[int] = None, log_every: int = 100):
         """Run the training loop; returns the final state."""
         losses = None
@@ -331,6 +343,25 @@ class Trainer:
             # the goodput ledger charges everything from here to the exit
             # (block + final snapshot) to the drain bucket
             self.telemetry.enter_phase("drain")
+            fr = getattr(self.telemetry, "flight", None)
+            if fr is not None:
+                # SIGTERM forensics: the same flight_<rank>.json + KV digest
+                # a watchdog timeout would leave, so a preempted gang is
+                # joinable by ci/diagnose_hang.py too
+                try:
+                    from bagua_tpu.env import get_dump_dir
+                    from bagua_tpu.observability.flight_recorder import (
+                        flight_dump_path,
+                    )
+
+                    fr.dump(
+                        flight_dump_path(get_dump_dir(), fr.rank),
+                        reason="sigterm",
+                        telemetry=self.telemetry.snapshot(),
+                    )
+                    self._push_flight_digest()
+                except Exception:
+                    logger.exception("flight dump on preemption failed")
         jax.block_until_ready(state)
         try:
             self.snapshotter.force_snapshot(state, step)

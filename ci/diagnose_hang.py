@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""Join per-rank flight-recorder dumps into a hang report.
+
+When a gang hangs, every rank's watchdog (or SIGTERM drain) leaves a
+``flight_<rank>.json`` black-box dump: the ring of sequence-numbered
+collective records the host dispatch path issued, plus thread stacks and
+the telemetry snapshot.  This analyzer joins those rings offline and
+answers the three questions an on-call actually has:
+
+1. **Where did the gang first diverge?** — the first sequence number at
+   which ring contents disagree (a skipped or extra collective on some
+   rank), with the divergent rank set.
+2. **What is the gang blocked on?** — the exact collective (scope label,
+   bucket index, phase, plan_version) the lagging ranks never issued or
+   never retired.
+3. **What kind of failure is it?** — a ``desync`` (programs differ),
+   ``straggler`` (identical programs, some ranks behind, the laggard
+   parked in a benign phase) or ``host_wedge`` (unretired records / a rank
+   stuck mid-dispatch) verdict, from per-record enqueue/retire deltas.
+
+The output is a schema-validated ``hang_report``
+(``bagua.hang_report.v1`` — see
+:func:`bagua_tpu.observability.flight_recorder.validate_hang_report`).
+Invalid input dumps are skipped with a warning; an invalid *report* (or no
+usable dumps at all) exits non-zero so CI lanes can gate on it.
+
+Usage::
+
+    python ci/diagnose_hang.py --dir /path/to/dumps          # flight_*.json
+    python ci/diagnose_hang.py --dir dumps --out hang_report.json
+    python ci/diagnose_hang.py --glob 'dumps/flight_*.json'  # explicit glob
+"""
+
+import argparse
+import glob as globlib
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable from any cwd without an editable install
+    sys.path.insert(0, REPO)
+
+from bagua_tpu.observability.flight_recorder import (  # noqa: E402
+    build_hang_report,
+    validate_flight_dump,
+    validate_hang_report,
+)
+
+
+def load_dumps(paths):
+    """Parse + schema-validate each dump; invalid ones are reported and
+    skipped (one corrupt rank must not block forensics on the rest)."""
+    dumps, skipped = [], []
+    for path in sorted(paths):
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as exc:
+            skipped.append((path, f"unreadable: {exc}"))
+            continue
+        problems = validate_flight_dump(payload)
+        if problems:
+            skipped.append((path, "; ".join(problems[:3])))
+            continue
+        dumps.append(payload)
+    return dumps, skipped
+
+
+def summarize(report) -> str:
+    """Human one-screen summary (stderr; the JSON is the artifact)."""
+    lines = [
+        f"verdict: {report['verdict']}",
+        f"ranks: {report['ranks']}  last_seq: {report['last_seq']}",
+    ]
+    if report.get("divergent_ranks"):
+        lines.append(
+            f"first divergence at seq {report['first_divergence_seq']} "
+            f"(divergent ranks {report['divergent_ranks']})"
+        )
+    if report.get("lagging_ranks"):
+        lines.append(f"lagging ranks: {report['lagging_ranks']}")
+    blocked = report.get("blocked_on")
+    if blocked:
+        lines.append(
+            "blocked on: "
+            f"{blocked['label']} (seq {blocked['seq']}, bucket "
+            f"{blocked['bucket']}, phase {blocked['phase']}, "
+            f"plan_version {blocked['plan_version']})"
+        )
+    if report.get("detail"):
+        lines.append(f"detail: {report['detail']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=".",
+                    help="directory holding flight_<rank>.json dumps")
+    ap.add_argument("--glob", default=None,
+                    help="explicit glob for dump files (overrides --dir)")
+    ap.add_argument("--out", default=None,
+                    help="write the hang_report JSON here (default: stdout)")
+    args = ap.parse_args(argv)
+
+    pattern = args.glob or os.path.join(args.dir, "flight_*.json")
+    paths = globlib.glob(pattern)
+    if not paths:
+        print(f"diagnose_hang: no dumps match {pattern}", file=sys.stderr)
+        return 2
+
+    dumps, skipped = load_dumps(paths)
+    for path, why in skipped:
+        print(f"diagnose_hang: skipping {path}: {why}", file=sys.stderr)
+    if not dumps:
+        print("diagnose_hang: no valid dumps to join", file=sys.stderr)
+        return 2
+
+    report = build_hang_report(dumps)
+    problems = validate_hang_report(report)
+    if problems:
+        print("diagnose_hang: internal error — report failed its own "
+              f"schema: {'; '.join(problems)}", file=sys.stderr)
+        return 3
+
+    text = json.dumps(report, indent=2, sort_keys=True)
+    if args.out:
+        tmp = f"{args.out}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(text + "\n")
+        os.replace(tmp, args.out)
+        print(f"diagnose_hang: report written to {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    print(summarize(report), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

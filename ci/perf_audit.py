@@ -443,6 +443,174 @@ def health_guardrail_lane(out_prefix: str, steady_steps: int = 6):
     }
 
 
+def hang_forensics_lane(out_prefix: str, steps: int = 8):
+    """Executed flight-recorder gate: wedge one rank of a 4-rank gang and
+    hold the analyzer to exact first-desync attribution.
+
+    Two short gradient_allreduce[overlap] runs on the 8-device mesh pin the
+    recorder's hot-path contract: recorder-on vs recorder-off training
+    state must be **bitwise identical** (the recorder captures at trace
+    time and replays at dispatch time — it never touches the traced
+    computation) and the recorder-on step-wall p50 must sit within noise
+    of recorder-off.  The recorder-on run's captured program then drives
+    the hang side: four per-rank rings replay the same program (this
+    container's CPU backend cannot run cross-process jit — see
+    ci/fault_injection.py — so the gang's rings are synthesized from the
+    one real captured program), rank 2 skips one mid-step collective (the
+    injected wedge), every ring dumps ``flight_<rank>.json``, and
+    ``ci/diagnose_hang.py`` must join them into a schema-valid
+    ``hang_report`` naming the injected collective exactly: verdict
+    ``desync``, divergent rank {2}, and the skipped bucket/phase/
+    plan_version in ``blocked_on``.  tests/test_ci_lane.py greps the
+    sentinel and re-checks the artifact.
+    """
+    import hashlib
+    import shutil
+    import statistics
+    import subprocess
+
+    import bagua_tpu
+    from bagua_tpu.algorithms import build_algorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+    from bagua_tpu.observability import Telemetry
+    from bagua_tpu.observability.flight_recorder import (
+        FlightRecorder, flight_dump_path, validate_flight_dump,
+        validate_hang_report,
+    )
+
+    group = bagua_tpu.init_process_group(intra_size=4)
+    n = group.size
+    params = init_mlp(jax.random.PRNGKey(0), [64, 128, 128, 64])
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(8 * n, 64).astype(np.float32))
+    y = jnp.asarray(rng.rand(8 * n, 64).astype(np.float32))
+
+    def run(flight):
+        tel = Telemetry(flight=flight)
+        ddp = DistributedDataParallel(
+            loss_fn=mse_loss, optimizer=optax.sgd(0.01, momentum=0.9),
+            algorithm=build_algorithm("gradient_allreduce"),
+            process_group=group, bucket_size_bytes=1 << 16, overlap=True,
+            telemetry=tel,
+        )
+        state = ddp.init(params)
+        state, losses = ddp.train_step(state, (x, y))  # compile outside timing
+        jax.block_until_ready(losses)
+        walls = []
+        for _ in range(steps):
+            t0 = time.monotonic()
+            state, losses = ddp.train_step(state, (x, y))
+            jax.block_until_ready(losses)
+            walls.append(time.monotonic() - t0)
+        digest = hashlib.sha256()
+        for leaf in jax.tree.leaves((state.params, state.opt_state)):
+            digest.update(np.asarray(leaf).tobytes())
+        program = next(iter(ddp._flight_programs.values()), ()) if flight else ()
+        ddp.shutdown()
+        tel.close()
+        return digest.hexdigest(), statistics.median(walls), list(program)
+
+    sha_off, p50_off, _ = run(None)
+    flight = FlightRecorder(capacity=256, rank=0, world_size=4)
+    sha_on, p50_on, program = run(flight)
+
+    # Bitwise-inert: recorder on vs off trains the same bits.
+    assert sha_on == sha_off, (
+        f"flight recorder perturbed training state: {sha_on} != {sha_off}"
+    )
+    # Every dispatched step replayed its program into the ring, retired.
+    assert program, "recorder-on run captured no collective program"
+    assert flight.last_seq + 1 == (steps + 1) * len(program), (
+        f"ring holds {flight.last_seq + 1} records, expected "
+        f"{(steps + 1) * len(program)}"
+    )
+    assert all(r.get("t_retire") is not None for r in flight.records()), (
+        "dispatch-path records left unretired"
+    )
+    # Hot-path overhead: p50 within noise of recorder-off (the record is a
+    # few dict copies per step; 1.5x + 2ms absorbs CPU-sim scheduling noise
+    # without letting a device sync or lock slip in).
+    assert p50_on <= p50_off * 1.5 + 2e-3, (
+        f"recorder overhead out of noise: p50 on={p50_on:.4f}s "
+        f"off={p50_off:.4f}s"
+    )
+
+    # The injected wedge: 4 per-rank rings replay the captured program;
+    # rank 2 skips one mid-step collective on the final step.
+    wedge_step = steps // 2
+    assert len(program) >= 2, f"program too short to wedge: {program}"
+    # the skipped collective must be followed by another record on the
+    # wedged rank, or the rings just end early (straggler, not desync)
+    skip_idx = min(len(program) // 2, len(program) - 2)
+    injected = dict(program[skip_idx])
+    workdir = tempfile.mkdtemp(prefix="bagua_hang_forensics_")
+    for r in range(4):
+        fr = FlightRecorder(capacity=256, rank=r, world_size=4)
+        for s in range(wedge_step + 1):
+            prog = list(program)
+            if r == 2 and s == wedge_step:
+                prog = prog[:skip_idx] + prog[skip_idx + 1:]  # the wedge
+            seqs = fr.record_program(prog, step=s)
+            if not (r == 2 and s == wedge_step):
+                fr.retire(seqs)
+            else:
+                fr.retire(seqs[:skip_idx])  # wedged mid-dispatch
+        dump = fr.dump(
+            flight_dump_path(workdir, r), reason="watchdog_timeout",
+            telemetry={"step": wedge_step, "phase": "wait" if r != 2 else "dispatch"},
+        )
+        problems = validate_flight_dump(dump)
+        assert not problems, f"rank {r} dump failed schema: {problems}"
+
+    report_path = out_prefix + "_hang_report.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "ci", "diagnose_hang.py"),
+         "--dir", workdir, "--out", report_path],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, (
+        f"diagnose_hang failed ({proc.returncode}):\n{proc.stderr}"
+    )
+    with open(report_path) as f:
+        report = json.load(f)
+    problems = validate_hang_report(report)
+    assert not problems, f"hang report failed schema: {problems}"
+
+    # Exact first-desync attribution: the rank, the seq, and the collective.
+    expected_seq = wedge_step * len(program) + skip_idx
+    assert report["verdict"] == "desync", report
+    assert report["divergent_ranks"] == [2], report
+    assert report["first_divergence_seq"] == expected_seq, (
+        f"expected divergence at seq {expected_seq}, got "
+        f"{report['first_divergence_seq']}"
+    )
+    blocked = report["blocked_on"]
+    for key in ("label", "algo", "bucket", "phase", "plan_version"):
+        assert blocked[key] == injected[key], (
+            f"blocked_on[{key!r}] = {blocked[key]!r}, injected "
+            f"{injected[key]!r}"
+        )
+    shutil.rmtree(workdir, ignore_errors=True)
+    print(
+        f"[audit] hang forensics lane passed (desync at seq {expected_seq} "
+        f"-> rank 2, {blocked['label']}, bitwise-inert recorder, "
+        f"p50 on/off {p50_on * 1e3:.2f}/{p50_off * 1e3:.2f} ms)",
+        file=sys.stderr,
+    )
+    return {
+        "verdict": report["verdict"],
+        "divergent_ranks": report["divergent_ranks"],
+        "first_divergence_seq": report["first_divergence_seq"],
+        "blocked_on": blocked,
+        "program_len": len(program),
+        "bitwise_identical": True,
+        "p50_ms_recorder_on": round(p50_on * 1e3, 3),
+        "p50_ms_recorder_off": round(p50_off * 1e3, 3),
+        "report_path": os.path.basename(report_path),
+    }
+
+
 def autotune_planner_lane(fixture_path=None):
     """Recorded-span planner gate (pure cost model, no compile — CPU-safe).
 
@@ -1475,6 +1643,13 @@ def main():
     health_result = None
     if args.algo is None and args.wire is None:
         health_result = health_guardrail_lane(args.out)
+    # Executed hang-forensics gate: recorder bitwise-inert + overhead-in-
+    # noise, one wedged rank of a 4-rank gang, and ci/diagnose_hang.py must
+    # attribute the injected desync exactly (rank, bucket, phase,
+    # plan_version).  The focused --algo/--wire lanes skip it.
+    hang_result = None
+    if args.algo is None and args.wire is None:
+        hang_result = hang_forensics_lane(args.out)
     # Recorded-span planner gate: DP partition must beat the greedy seed
     # plan's predicted exposed comm on the committed VGG16 fixture.
     planner_result = autotune_planner_lane()
@@ -1500,6 +1675,7 @@ def main():
              "autotune_planner": planner_result,
              "wire": wire_result,
              "health": health_result,
+             "hang_forensics": hang_result,
              "resilience": resilience_result},
             f, indent=1,
         )

@@ -103,6 +103,24 @@ def test_gang_view_report_and_export():
     assert snap["gang_straggler_rank"] == -1 and snap["gang_straggler_score"] == 0.0
 
 
+def test_gang_view_heartbeat_ages_report_and_export():
+    reg = MetricsRegistry()
+    # keys/values arrive as JSON strings from the coordinator; the view
+    # normalizes them so a silent rank 1 is readable straight off the gauges
+    view = GangView(4, four_rank_summaries(),
+                    heartbeat_ages={"0": "0.1", 1: 7.25, 2: 0.2, 3: 0.15})
+    rep = view.report()
+    assert rep["heartbeat_ages_s"] == {"0": 0.1, "1": 7.25, "2": 0.2, "3": 0.15}
+    view.export(reg)
+    snap = reg.snapshot()
+    assert snap["gang_heartbeat_age_s_rank0"] == pytest.approx(0.1)
+    assert snap["gang_heartbeat_age_s_rank1"] == pytest.approx(7.25)
+    assert "bagua_gang_heartbeat_age_s_rank1" in reg.to_prometheus()
+    # no ages (old coordinator) -> empty map in the report, no per-rank gauges
+    rep = GangView(4, four_rank_summaries()).report()
+    assert rep["heartbeat_ages_s"] == {}
+
+
 def test_summarize_telemetry_reads_registry(tmp_path):
     tel = Telemetry(metrics_jsonl=str(tmp_path / "m.jsonl"))
     for i in range(6):
@@ -168,6 +186,35 @@ def test_partial_gang_is_marked_local_only(kv_server):
     )
     view = agg.aggregate(four_rank_summaries()[0])  # nobody else published
     assert view.ranks_reporting == 1 and view.local_only
+
+
+def test_heartbeat_ages_ride_the_real_kv(kv_server):
+    port = kv_server
+    clients = [RendezvousClient(f"127.0.0.1:{port}", node_rank=r, timeout_s=10)
+               for r in range(3)]
+    for c in clients:
+        c.announce(nslots=1)
+    agg = GangAggregator(clients[0], rank=0, world_size=3, attempt="hb")
+    ages = agg.heartbeat_ages()
+    assert sorted(ages) == [0, 1, 2]
+    assert all(isinstance(a, float) and 0.0 <= a < 60.0 for a in ages.values())
+    # the client caches the latest map for anyone holding only the client
+    assert sorted(clients[0].last_heartbeat_ages) == [0, 1, 2]
+    # degradation: no client, or one without a heartbeat channel -> {}
+    assert GangAggregator(None, rank=0, world_size=3).heartbeat_ages() == {}
+
+    class NoHeartbeatKV:
+        pass
+
+    agg2 = GangAggregator(NoHeartbeatKV(), rank=0, world_size=3, attempt="hb")
+    assert agg2.heartbeat_ages() == {}
+
+
+def test_heartbeat_ages_degrade_on_dead_endpoint(monkeypatch):
+    monkeypatch.setenv("BAGUA_RPC_RETRIES", "0")
+    client = RendezvousClient(f"127.0.0.1:{free_port()}", node_rank=0, timeout_s=1)
+    agg = GangAggregator(client, rank=0, world_size=4, attempt="hb")
+    assert agg.heartbeat_ages() == {}  # transport failure degrades, never raises
 
 
 # -- degradation --------------------------------------------------------------

@@ -40,6 +40,7 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert "autotune planner lane passed" in proc.stderr
     assert "fault-injection resilience lane passed" in proc.stderr
     assert "health guardrail lane passed" in proc.stderr
+    assert "hang forensics lane passed" in proc.stderr
 
     # The telemetry smoke emits a JSONL metrics stream next to --out; hold it
     # to the event schema here too (belt and braces: the subprocess already
@@ -119,6 +120,28 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert any(
         e["event"] == "precision_switch" and e["reason"].startswith("health:")
         for e in hev)
+
+    # The hang-forensics lane's artifact: the recorder was bitwise-inert and
+    # within noise on the hot path, and the analyzer attributed the injected
+    # one-rank wedge to the exact collective (rank 2, the skipped bucket's
+    # label/phase/plan_version) as a schema-valid hang_report.
+    hang = audit["hang_forensics"]
+    assert hang["verdict"] == "desync"
+    assert hang["divergent_ranks"] == [2]
+    assert hang["bitwise_identical"] is True
+    assert hang["first_divergence_seq"] >= 0
+    blocked = hang["blocked_on"]
+    assert blocked["label"].startswith("bagua_ex/")
+    assert blocked["bucket"] >= 0 and blocked["phase"]
+    assert hang["p50_ms_recorder_on"] > 0 and hang["p50_ms_recorder_off"] > 0
+    report_path = str(out) + "_hang_report.json"
+    assert os.path.exists(report_path), "hang lane did not emit its report"
+    from bagua_tpu.observability import validate_hang_report
+
+    with open(report_path) as f:
+        report = json.load(f)
+    assert validate_hang_report(report) == []
+    assert report["blocked_on"]["label"] == blocked["label"]
 
 
 def test_perf_audit_quick_bytegrad_compressed_census(tmp_path):

@@ -44,9 +44,11 @@ def test_step_timer():
     assert timer.mean_step_time > 0
 
 
-def test_watchdog_fires_and_disarms():
+def test_watchdog_fires_and_disarms(tmp_path):
     fired = []
-    wd = Watchdog(timeout_s=0.2, check_interval_s=0.05, on_timeout=lambda s: fired.append(s)).start()
+    wd = Watchdog(timeout_s=0.2, check_interval_s=0.05, on_timeout=lambda s: fired.append(s))
+    wd.dump_dir = str(tmp_path)  # the timeout path now leaves evidence files
+    wd.start()
     wd.beat()
     time.sleep(0.6)
     assert fired, "watchdog should have fired"

@@ -291,14 +291,15 @@ def test_metrics_registry_instruments():
         reg.histogram("h").observe(float(v))
     snap = reg.snapshot()
     assert snap["c"] == 3 and snap["g"] == 1.5
-    assert snap["h"]["count"] == 100 and snap["h"]["p50"] == 51.0
+    # nearest-rank: the p50 of 1..100 is the 50th smallest sample
+    assert snap["h"]["count"] == 100 and snap["h"]["p50"] == 50.0
 
     prom = reg.to_prometheus()
     assert "# TYPE bagua_c counter" in prom and "bagua_c 3" in prom
     assert "# TYPE bagua_g gauge" in prom
     # histograms export as conformant summaries: quantile-labeled samples
     # (bare quantile values, "0.5" not "0.50") followed by _count/_sum
-    assert 'bagua_h{quantile="0.5"} 51.0' in prom
+    assert 'bagua_h{quantile="0.5"} 50.0' in prom
     assert 'bagua_h{quantile="0.95"}' in prom and 'bagua_h{quantile="0.99"}' in prom
     assert "bagua_h_count 100" in prom
     assert f"bagua_h_sum {float(sum(range(1, 101)))}" in prom
@@ -311,7 +312,7 @@ def test_histogram_window_is_recent_tail():
     for v in range(1, 2001):
         h.observe(float(v))
     # percentiles over the last 100 observations (1901..2000), not the run
-    assert h.percentiles()["p50"] == 1951.0
+    assert h.percentiles()["p50"] == 1950.0
     assert h.count == 2000 and h.sum == sum(range(1, 2001))
 
 
@@ -399,6 +400,33 @@ def test_step_timer_percentiles_and_thread_safety():
     assert p["p50"] == p["p95"] == p["p99"] == 0.01
 
 
+def test_step_timer_small_ring_quantiles_nearest_rank():
+    """Nearest-rank indexing on tiny rings: the old ``int(p * n)`` bias made
+    the p50 of a 2-sample ring return the MAX.  Pin the corrected values for
+    1-, 2- and 3-sample rings (and the Histogram twin, same indexing)."""
+    timer = StepTimer(window=8)
+    timer.tick(0.5)
+    assert timer.percentiles() == {"p50": 0.5, "p95": 0.5, "p99": 0.5}
+
+    timer = StepTimer(window=8)
+    timer.tick(0.010)
+    timer.tick(0.020)
+    p = timer.percentiles()
+    assert p["p50"] == 0.010  # the LOWER sample, not the max
+    assert p["p95"] == 0.020 and p["p99"] == 0.020
+
+    timer = StepTimer(window=8)
+    for v in (0.030, 0.010, 0.020):
+        timer.tick(v)
+    p = timer.percentiles()
+    assert p["p50"] == 0.020 and p["p95"] == 0.030 and p["p99"] == 0.030
+
+    h = Histogram("h", window=8)
+    h.observe(1.0)
+    h.observe(2.0)
+    assert h.percentiles()["p50"] == 1.0
+
+
 def test_watchdog_env_override(monkeypatch):
     monkeypatch.setenv("BAGUA_WATCHDOG_TIMEOUT_S", "7.5")
     assert Watchdog(timeout_s=300.0).timeout_s == 7.5
@@ -425,11 +453,13 @@ def test_watchdog_timeout_context_carries_telemetry():
     assert "telemetry" not in ctx and "boom" in ctx["telemetry_error"]
 
 
-def test_watchdog_fires_with_phase_tag():
+def test_watchdog_fires_with_phase_tag(tmp_path):
     fired = []
     wd = Watchdog(
         timeout_s=0.15, check_interval_s=0.05, on_timeout=lambda s: fired.append(s)
-    ).start()
+    )
+    wd.dump_dir = str(tmp_path)  # the timeout path now leaves evidence files
+    wd.start()
     wd.beat(phase="wait")
     deadline = time.time() + 3.0
     while not fired and time.time() < deadline:
