@@ -1,0 +1,64 @@
+"""Static analysis of traced collective programs.
+
+The BAGUA configuration space — {algorithm × wire precision × overlap mode
+× bucket plan}, mutable mid-training since PRs 8–10 — multiplies the
+distinct collective programs a gang can run; this package proves a program
+is gang-consistent **before dispatch** instead of diagnosing the hang
+afterwards.  :mod:`~bagua_tpu.analysis.collective_ir` extracts a canonical
+IR from the traced step's jaxpr, :mod:`~bagua_tpu.analysis.checks` runs
+the four checkers (rank invariance, wire-byte exactness, plan conformance,
+static/dynamic agreement with the flight recorder), and
+:mod:`~bagua_tpu.analysis.verify` wires them into the engine's
+``BAGUA_STATIC_VERIFY`` pre-dispatch gate and ``ci/static_verify.py``.
+See ``docs/static_analysis.md``.
+"""
+
+from bagua_tpu.analysis.checks import (
+    CHECK_NAMES,
+    MODELED_ALGOS,
+    Finding,
+    StaticVerifyError,
+    WireModelConfig,
+    canonical_records,
+    check_plan_conformance,
+    check_rank_invariance,
+    check_static_dynamic,
+    check_wire_exactness,
+)
+from bagua_tpu.analysis.collective_ir import (
+    COLLECTIVE_PRIMITIVES,
+    CollectiveDescriptor,
+    CollectiveProgram,
+    extract_collective_ir,
+    primitive_wire_bytes,
+)
+from bagua_tpu.analysis.verify import (
+    VerifyReport,
+    collect_ir,
+    predict_flight_program,
+    verify_collective_program,
+    verify_step_program,
+)
+
+__all__ = [
+    "CHECK_NAMES",
+    "COLLECTIVE_PRIMITIVES",
+    "MODELED_ALGOS",
+    "CollectiveDescriptor",
+    "CollectiveProgram",
+    "Finding",
+    "StaticVerifyError",
+    "VerifyReport",
+    "WireModelConfig",
+    "canonical_records",
+    "check_plan_conformance",
+    "check_rank_invariance",
+    "check_static_dynamic",
+    "check_wire_exactness",
+    "collect_ir",
+    "extract_collective_ir",
+    "predict_flight_program",
+    "primitive_wire_bytes",
+    "verify_collective_program",
+    "verify_step_program",
+]

@@ -1,0 +1,369 @@
+"""CollectiveIR: a canonical description of every collective in a jaxpr.
+
+The static verifier's substrate.  :func:`extract_collective_ir` walks a
+traced step program (a ``ClosedJaxpr`` from ``jax.make_jaxpr``, descending
+through ``pjit``/``shard_map``/``cond``/``while``/``scan``/``custom_vjp``
+sub-jaxprs) and emits one :class:`CollectiveDescriptor` per collective
+primitive — ``psum``/``pmax``/``pmin``/``reduce_scatter``/``all_gather``/
+``ppermute``/``all_to_all`` — carrying:
+
+* the mesh axes it reduces over and the resulting ring size;
+* the local operand shape/dtype and **exact** operand bytes (variadic
+  ``psum`` sums its operands);
+* the per-rank ring-model wire bytes for the primitive (an N-byte operand's
+  all-reduce moves ``2N(n-1)/n``, a reduce-scatter/all-to-all ``N(n-1)/n``,
+  an all-gather ``N(n-1)`` and a ppermute ``N`` — the same α–β legs the
+  service planner prices);
+* the enclosing named-scope label (the jaxpr ``name_stack``), parsed with
+  the shared grammar (:mod:`bagua_tpu.observability.scope_grammar`) into
+  the bucket-exchange / model-parallel / quantized-ring frames;
+* its control-flow nesting path and a **rank-conditional** flag.
+
+The rank-conditional flag comes from a taint analysis run during the same
+walk: ``axis_index`` results (and anything computed from them) are tainted;
+the rank-uniformizing collectives (``psum``/``pmax``/``pmin``/
+``all_gather`` over the full axis) launder taint away, since every rank
+gets the identical result.  A ``cond``/``while`` whose predicate is tainted
+executes *different branch programs on different ranks* — any collective
+inside such a branch is the exact desync class the flight recorder (PR 10)
+can only diagnose post-mortem, so the walker marks it for
+``check_rank_invariance`` to reject at trace time.  The analysis is
+deliberately scoped to ``axis_index``-derived taint: per-rank *data* (batch
+shards) is rank-varying too, but branching on reduced data is the normal
+``is_update_step`` pattern and must stay clean.
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+from jax._src import core as jcore
+
+from bagua_tpu.observability.scope_grammar import (
+    parse_exchange_label,
+    parse_mp_label,
+    parse_qr_scope,
+)
+
+__all__ = [
+    "COLLECTIVE_PRIMITIVES",
+    "UNIFORMIZING_PRIMITIVES",
+    "CollectiveDescriptor",
+    "CollectiveProgram",
+    "extract_collective_ir",
+    "primitive_wire_bytes",
+]
+
+#: jaxpr primitive name -> reduction op (None = data movement only)
+COLLECTIVE_PRIMITIVES = {
+    "psum": "sum",
+    "pmax": "max",
+    "pmin": "min",
+    "reduce_scatter": "sum",  # lax.psum_scatter
+    "all_gather": None,
+    "ppermute": None,
+    "all_to_all": None,
+}
+
+#: collectives whose outputs are identical on every rank of the axis — they
+#: launder axis_index taint away (a branch on a psum'd value is gang-safe)
+UNIFORMIZING_PRIMITIVES = frozenset({"psum", "pmax", "pmin", "all_gather"})
+
+#: control-flow primitives whose predicate picks the executed program
+_BRANCHING_PRIMITIVES = frozenset({"cond", "while"})
+
+
+def primitive_wire_bytes(primitive: str, operand_bytes: int, n: int) -> int:
+    """Per-rank ring-model wire bytes for one collective primitive over an
+    ``n``-rank axis with ``operand_bytes`` of local input.  These are the
+    planner's α–β payload legs: ring all-reduce ``2N(n-1)/n``, rs/a2a one
+    scatter leg ``N(n-1)/n``, all-gather ``N(n-1)`` (the operand IS the
+    local shard), ppermute one send of ``N``."""
+    if n <= 1:
+        return 0
+    if primitive in ("psum", "pmax", "pmin"):
+        return 2 * operand_bytes * (n - 1) // n
+    if primitive in ("reduce_scatter", "all_to_all"):
+        return operand_bytes * (n - 1) // n
+    if primitive == "all_gather":
+        return operand_bytes * (n - 1)
+    if primitive == "ppermute":
+        return operand_bytes
+    raise ValueError(f"not a collective primitive: {primitive!r}")
+
+
+@dataclasses.dataclass
+class CollectiveDescriptor:
+    """One collective primitive of the traced step program."""
+
+    index: int                      #: position in jaxpr walk order
+    primitive: str                  #: jaxpr primitive name
+    reduce_op: Optional[str]        #: "sum"/"max"/"min" or None
+    axes: Tuple[str, ...]           #: mesh axis names it spans
+    ring_size: int                  #: product of those axes' sizes
+    shapes: Tuple[Tuple[int, ...], ...]  #: local operand shapes
+    dtypes: Tuple[str, ...]         #: local operand dtypes
+    nbytes: int                     #: exact local operand bytes (summed)
+    wire_bytes: int                 #: per-rank ring-model wire bytes
+    label: str                      #: full name_stack string
+    scope: Optional[Dict]           #: parsed bucket-exchange frame
+    mp: Optional[Dict]              #: parsed model-parallel frame
+    qr: Optional[Dict]              #: parsed quantized-ring sub-scope
+    path: Tuple[str, ...]           #: enclosing control-flow primitives
+    rank_conditional: bool          #: under a rank-tainted predicate
+    cond_label: Optional[str]       #: label of that tainted control-flow eqn
+
+    @property
+    def bucket(self) -> Optional[int]:
+        return self.scope["bucket"] if self.scope else None
+
+    @property
+    def phase(self) -> Optional[str]:
+        return self.scope["phase"] if self.scope else None
+
+    @property
+    def algo(self) -> Optional[str]:
+        return self.scope["algo"] if self.scope else None
+
+
+@dataclasses.dataclass
+class CollectiveProgram:
+    """The CollectiveIR of one traced step: descriptors in walk order plus
+    the mesh geometry they were extracted under."""
+
+    collectives: List[CollectiveDescriptor]
+    axis_sizes: Dict[str, int]
+
+    @property
+    def world_size(self) -> int:
+        n = 1
+        for s in self.axis_sizes.values():
+            n *= int(s)
+        return n
+
+    def labeled(self) -> List[CollectiveDescriptor]:
+        """Descriptors carrying a bucket-exchange frame."""
+        return [d for d in self.collectives if d.scope is not None]
+
+    def by_bucket_phase(self) -> Dict[Tuple[str, int, str], List[CollectiveDescriptor]]:
+        """Group the labeled descriptors by ``(algo, bucket, phase)``,
+        preserving walk order inside each group (and insertion order of the
+        groups themselves)."""
+        out: Dict[Tuple[str, int, str], List[CollectiveDescriptor]] = {}
+        for d in self.labeled():
+            out.setdefault((d.scope["algo"], d.scope["bucket"], d.scope["phase"]), []).append(d)
+        return out
+
+
+def _aval_bytes(aval) -> int:
+    shape = tuple(getattr(aval, "shape", ()) or ())
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return 0
+    return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+
+
+def _axis_names(eqn) -> Tuple[str, ...]:
+    a = eqn.params.get("axes")
+    if a is None:
+        a = eqn.params.get("axis_name")
+    if a is None:
+        return ()
+    if not isinstance(a, (tuple, list)):
+        a = (a,)
+    # psum's axes param may mix positional ints with named axes; only the
+    # names define the ring
+    return tuple(str(x) for x in a if not isinstance(x, int))
+
+
+def _sub_jaxprs(params) -> List[jcore.Jaxpr]:
+    """Every sub-jaxpr reachable from an eqn's params — pjit/shard_map carry
+    one (shard_map's is an *open* ``core.Jaxpr``, pjit's a ``ClosedJaxpr``),
+    cond carries a tuple of branches, custom_vjp a call_jaxpr plus the fwd/
+    bwd thunks."""
+    subs = []
+    for v in params.values():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for w in vs:
+            if isinstance(w, jcore.ClosedJaxpr):
+                subs.append(w.jaxpr)
+            elif isinstance(w, jcore.Jaxpr):
+                subs.append(w)
+    return subs
+
+
+class _Walk:
+    def __init__(self, axis_sizes: Dict[str, int]):
+        self.axis_sizes = {str(k): int(v) for k, v in axis_sizes.items()}
+        self.out: List[CollectiveDescriptor] = []
+        # stack of (primitive, label, predicate_tainted)
+        self.ctrl: List[Tuple[str, str, bool]] = []
+
+    # -- recording -----------------------------------------------------------
+
+    def record(self, eqn, label: str) -> None:
+        name = eqn.primitive.name
+        axes = tuple(a for a in _axis_names(eqn) if a in self.axis_sizes)
+        n = 1
+        for a in axes:
+            n *= self.axis_sizes[a]
+        avals = [v.aval for v in eqn.invars]
+        nbytes = sum(_aval_bytes(a) for a in avals)
+        self.out.append(
+            CollectiveDescriptor(
+                index=len(self.out),
+                primitive=name,
+                reduce_op=COLLECTIVE_PRIMITIVES[name],
+                axes=axes,
+                ring_size=n,
+                shapes=tuple(tuple(getattr(a, "shape", ()) or ()) for a in avals),
+                dtypes=tuple(str(getattr(a, "dtype", "")) for a in avals),
+                nbytes=nbytes,
+                wire_bytes=primitive_wire_bytes(name, nbytes, n),
+                label=label,
+                scope=parse_exchange_label(label),
+                mp=parse_mp_label(label),
+                qr=parse_qr_scope(label),
+                path=tuple(p for p, _, _ in self.ctrl),
+                rank_conditional=any(t for _, _, t in self.ctrl),
+                cond_label=next(
+                    (lab for _, lab, t in reversed(self.ctrl) if t), None
+                ),
+            )
+        )
+
+    # -- taint helpers -------------------------------------------------------
+
+    @staticmethod
+    def _tainted(v, taint) -> bool:
+        return isinstance(v, jcore.Var) and v in taint
+
+    def _seed(self, sub_invars, call_invars, taint) -> set:
+        sub = set()
+        for sv, av in zip(sub_invars, call_invars):
+            if self._tainted(av, taint):
+                sub.add(sv)
+        return sub
+
+    # -- the walk ------------------------------------------------------------
+
+    def walk(self, jaxpr: jcore.Jaxpr, taint: set, record: bool = True) -> None:
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            label = str(eqn.source_info.name_stack)
+            in_taint = any(self._tainted(v, taint) for v in eqn.invars)
+
+            if name == "axis_index":
+                taint.update(eqn.outvars)
+                continue
+
+            if name in COLLECTIVE_PRIMITIVES:
+                if record:
+                    self.record(eqn, label)
+                if name in UNIFORMIZING_PRIMITIVES:
+                    continue  # outputs identical on every rank: taint laundered
+                if in_taint:
+                    taint.update(eqn.outvars)
+                continue
+
+            if name == "cond":
+                pred = eqn.invars[0]
+                pred_taint = self._tainted(pred, taint)
+                out_taint = pred_taint
+                for br in eqn.params["branches"]:
+                    brj = br.jaxpr if isinstance(br, jcore.ClosedJaxpr) else br
+                    sub = self._seed(brj.invars, eqn.invars[1:], taint)
+                    self.ctrl.append((name, label, pred_taint))
+                    self.walk(brj, sub, record)
+                    self.ctrl.pop()
+                    out_taint |= any(self._tainted(v, sub) for v in brj.outvars)
+                if out_taint:
+                    taint.update(eqn.outvars)
+                continue
+
+            if name == "while":
+                self._walk_while(eqn, taint, record, label)
+                continue
+
+            subs = _sub_jaxprs(eqn.params)
+            if subs:
+                out_taint = in_taint
+                for sj in subs:
+                    # pjit/shard_map invars align 1:1 with the call's; for
+                    # scan/custom_vjp the positional zip is a conservative
+                    # best-effort seed (zip truncates on mismatch)
+                    sub = self._seed(sj.invars, eqn.invars, taint)
+                    self.walk(sj, sub, record)
+                    out_taint |= any(self._tainted(v, sub) for v in sj.outvars)
+                if out_taint:
+                    taint.update(eqn.outvars)
+                continue
+
+            if in_taint:
+                taint.update(eqn.outvars)
+
+    def _walk_while(self, eqn, taint: set, record: bool, label: str) -> None:
+        p = eqn.params
+        cond_j = p["cond_jaxpr"].jaxpr
+        body_j = p["body_jaxpr"].jaxpr
+        cn, bn = p.get("cond_nconsts", 0), p.get("body_nconsts", 0)
+        cond_consts = list(eqn.invars[:cn])
+        body_consts = list(eqn.invars[cn:cn + bn])
+        carry = list(eqn.invars[cn + bn:])
+        carry_taint = [self._tainted(v, taint) for v in carry]
+
+        def seed_from(consts, sub_invars):
+            sub = set()
+            for sv, av in zip(sub_invars, consts + carry):
+                if self._tainted(av, taint):
+                    sub.add(sv)
+            # carry slots tainted by a previous body pass
+            for sv, t in zip(sub_invars[len(consts):], carry_taint):
+                if t:
+                    sub.add(sv)
+            return sub
+
+        # Fixpoint approximation on the carried taint: two silent body
+        # passes (one propagation step each) before the recording pass.
+        pred_taint = False
+        for _ in range(2):
+            csub = seed_from(cond_consts, cond_j.invars)
+            self.walk(cond_j, csub, record=False)
+            pred_taint = any(self._tainted(v, csub) for v in cond_j.outvars)
+            bsub = seed_from(body_consts, body_j.invars)
+            self.ctrl.append(("while", label, pred_taint))
+            self.walk(body_j, bsub, record=False)
+            self.ctrl.pop()
+            new_carry = [
+                self._tainted(v, bsub)
+                for v in body_j.outvars
+            ]
+            if new_carry == carry_taint[: len(new_carry)]:
+                break
+            for i, t in enumerate(new_carry):
+                if i < len(carry_taint):
+                    carry_taint[i] = carry_taint[i] or t
+        # recording pass with converged taint
+        bsub = seed_from(body_consts, body_j.invars)
+        self.ctrl.append(("while", label, pred_taint))
+        self.walk(body_j, bsub, record=record)
+        self.ctrl.pop()
+        if pred_taint or any(carry_taint):
+            taint.update(eqn.outvars)
+
+
+def extract_collective_ir(closed_jaxpr, axis_sizes: Dict[str, int]) -> CollectiveProgram:
+    """Walk a traced program into its CollectiveIR.
+
+    ``closed_jaxpr`` is what ``jax.make_jaxpr(step_fn)(*abstract_args)``
+    returns (an unjitted top-level works too); ``axis_sizes`` names the mesh
+    axes collectives may span (e.g. ``dict(group.mesh.shape)``) — the walker
+    sizes each descriptor's ring from it."""
+    jaxpr = (
+        closed_jaxpr.jaxpr
+        if isinstance(closed_jaxpr, jcore.ClosedJaxpr)
+        else closed_jaxpr
+    )
+    w = _Walk(axis_sizes)
+    w.walk(jaxpr, set())
+    return CollectiveProgram(collectives=w.out, axis_sizes=dict(w.axis_sizes))

@@ -1,0 +1,227 @@
+"""Entry points: verify a step program before it ever dispatches.
+
+:func:`verify_step_program` is the pre-dispatch gate.  It traces the
+engine's un-jitted sharded step (``ddp._build_sharded``) with
+``jax.make_jaxpr`` over abstract ``ShapeDtypeStruct`` arguments — tracing
+runs the step's Python, so the flight recorder's
+:class:`~bagua_tpu.observability.flight_recorder.capture_program` context
+captures the *dynamic* collective program in the same pass that yields the
+jaxpr for the *static* one, and nothing executes on any device.  Over the
+extracted :class:`~bagua_tpu.analysis.collective_ir.CollectiveProgram` it
+runs the four checkers (:mod:`bagua_tpu.analysis.checks`) and returns a
+:class:`VerifyReport`; ``report.raise_if_failed()`` is what
+``BAGUA_STATIC_VERIFY=strict`` calls.
+
+:func:`predict_flight_program` renders the IR into the exact record
+templates ``ddp._flight_finalize`` produces from a live capture — same
+label grammar, same enrichment fields — which is what lets check 4 compare
+the two subsystems record-for-record.
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+
+from bagua_tpu.analysis.checks import (
+    MODELED_ALGOS,
+    Finding,
+    StaticVerifyError,
+    WireModelConfig,
+    check_plan_conformance,
+    check_rank_invariance,
+    check_static_dynamic,
+    check_wire_exactness,
+)
+from bagua_tpu.analysis.collective_ir import (
+    CollectiveProgram,
+    extract_collective_ir,
+)
+from bagua_tpu.observability.flight_recorder import capture_program
+from bagua_tpu.observability.scope_grammar import format_exchange_label
+
+__all__ = [
+    "VerifyReport",
+    "collect_ir",
+    "predict_flight_program",
+    "verify_collective_program",
+    "verify_step_program",
+]
+
+
+@dataclasses.dataclass
+class VerifyReport:
+    """One verification run: every finding plus the evidence tables."""
+
+    algo: str
+    variant: str
+    findings: List[Finding]
+    wire_table: List[Dict]
+    predicted: List[Dict]
+    captured: List[Dict]
+    num_collectives: int
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def raise_if_failed(self) -> "VerifyReport":
+        if self.errors:
+            raise StaticVerifyError(self.findings)
+        return self
+
+    def summary(self) -> str:
+        if self.ok:
+            return (
+                f"static verify ok: algo={self.algo} variant={self.variant} "
+                f"{self.num_collectives} collectives, "
+                f"{len(self.wire_table)} bucket-phases"
+            )
+        return "; ".join(str(f) for f in self.errors)
+
+    def to_json(self) -> Dict:
+        return {
+            "algo": self.algo,
+            "variant": self.variant,
+            "ok": self.ok,
+            "num_collectives": self.num_collectives,
+            "findings": [f.to_json() for f in self.findings],
+            "wire_table": self.wire_table,
+            "predicted_records": len(self.predicted),
+            "captured_records": len(self.captured),
+        }
+
+
+def _abstract(tree):
+    def conv(l):
+        if isinstance(l, jax.ShapeDtypeStruct):
+            return l
+        return jax.ShapeDtypeStruct(
+            jax.numpy.shape(l), jax.numpy.result_type(l)
+        )
+
+    return jax.tree.map(conv, tree)
+
+
+def collect_ir(fn, args: Sequence, axis_sizes: Dict[str, int]):
+    """Trace ``fn(*args)`` (args may be concrete or ``ShapeDtypeStruct``
+    trees) into ``(CollectiveProgram, captured_events)`` — the static IR and
+    the flight recorder's trace-time capture from the same single trace."""
+    with capture_program() as events:
+        closed = jax.make_jaxpr(fn)(*args)
+    return extract_collective_ir(closed, axis_sizes), list(events)
+
+
+def predict_flight_program(
+    program: CollectiveProgram, cfg: WireModelConfig, variant: str = "default"
+) -> List[Dict]:
+    """The flight program the IR implies, in ``_flight_finalize``'s record
+    shape: one annotate record per ``(bucket, phase)`` exchange scope, plus
+    one ``phase="hop"`` ring record per quantized reduce-scatter/all-gather
+    leg (bytes = the leg's summed ring-model wire bytes)."""
+    plan, pv = cfg.plan, cfg.plan_version
+    records: List[Dict] = []
+    for (algo, b, phase), descs in program.by_bucket_phase().items():
+        spec = plan.specs[b] if 0 <= b < len(plan.specs) else None
+        prec = (
+            cfg.precisions[b]
+            if b < len(cfg.precisions) and spec is not None else "f32"
+        )
+        if spec is not None and spec.dtype not in ("f32", "f16", "bf16"):
+            prec = "f32"
+        records.append({
+            "algo": algo, "bucket": b, "phase": phase,
+            "nbytes": int(spec.nbytes) if spec is not None else 0,
+            "precision": prec,
+            "plan_version": pv, "variant": str(variant),
+            "label": format_exchange_label(algo, b, phase),
+        })
+        hop_descs = [d for d in descs if d.qr and d.qr["stage"] == "hop"]
+        ag_descs = [d for d in descs if d.qr and d.qr["stage"] == "ag"]
+        for ring_kind, leg in (("rs", hop_descs), ("ag", ag_descs)):
+            if not leg:
+                continue
+            bits = leg[0].qr["bits"]
+            records.append({
+                "algo": algo, "bucket": b, "phase": "hop",
+                "ring": ring_kind, "bits": bits,
+                "hops": leg[0].ring_size - 1,
+                "nbytes": sum(d.wire_bytes for d in leg),
+                "precision": f"int{bits}",
+                "plan_version": pv, "variant": str(variant),
+                "label": format_exchange_label(algo, b, "hop"),
+            })
+    return records
+
+
+def verify_collective_program(
+    program: CollectiveProgram,
+    cfg: WireModelConfig,
+    payload: Optional[Dict] = None,
+    captured: Optional[Sequence[Dict]] = None,
+    variant: str = "default",
+) -> VerifyReport:
+    """Run the four checkers over an already-extracted IR.  ``captured`` is
+    the flight recorder's (finalized) record list for the same trace; when
+    omitted — or when the algorithm's record program is not modeled — check
+    4 reports an info finding instead of comparing."""
+    findings = list(check_rank_invariance(program))
+    wire_findings, wire_table = check_wire_exactness(program, cfg)
+    findings += wire_findings
+    findings += check_plan_conformance(program, cfg, payload=payload)
+    predicted = predict_flight_program(program, cfg, variant=variant)
+    if captured is not None and cfg.algo in MODELED_ALGOS:
+        findings += check_static_dynamic(predicted, captured)
+    else:
+        findings.append(
+            Finding(
+                check="static_dynamic",
+                severity="info",
+                message=(
+                    "no flight capture supplied"
+                    if captured is None
+                    else f"record program for {cfg.algo!r} is not modeled; "
+                         "comparison skipped"
+                ),
+            )
+        )
+    return VerifyReport(
+        algo=cfg.algo,
+        variant=str(variant),
+        findings=findings,
+        wire_table=wire_table,
+        predicted=predicted,
+        captured=list(captured or ()),
+        num_collectives=len(program.collectives),
+    )
+
+
+def verify_step_program(
+    ddp,
+    state,
+    batch,
+    variant: str = "default",
+    payload: Optional[Dict] = None,
+) -> VerifyReport:
+    """Statically verify one step variant of a live engine, pre-dispatch.
+
+    Traces ``ddp._build_sharded(variant)`` over abstract shapes (no device
+    execution, no donation), extracts the IR, captures the flight program
+    from the same trace, finalizes it through the engine's own
+    ``_flight_finalize`` (single source of truth for record enrichment) and
+    runs all four checks."""
+    cfg = WireModelConfig.from_engine(ddp)
+    sharded = ddp._build_sharded(variant)
+    program, events = collect_ir(
+        sharded,
+        (_abstract(state), _abstract(batch)),
+        dict(ddp.group.mesh.shape),
+    )
+    captured = list(ddp._flight_finalize(variant, events))
+    return verify_collective_program(
+        program, cfg, payload=payload, captured=captured, variant=variant
+    )

@@ -23,6 +23,7 @@ Re-bucketing (autotune proposing a new bucket assignment) swaps the
 ``_reset_buckets`` (``bagua_distributed.py:483-496``).
 """
 
+import logging
 import time
 from typing import Any, Callable, NamedTuple, Optional
 
@@ -34,12 +35,14 @@ from jax.sharding import PartitionSpec as P
 from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
 from bagua_tpu.bucket import BucketPlan, wrap_params_for_overlap
 from bagua_tpu.communication import ALL_AXES, BaguaProcessGroup, get_default_group
-from bagua_tpu.env import get_default_bucket_size
+from bagua_tpu.env import get_default_bucket_size, get_static_verify_mode
 from bagua_tpu.observability.annotations import step_scope
 from bagua_tpu.observability.core import StepTimer
 from bagua_tpu.sharded.layout import ShardLayout, reshard_group_flat
 from bagua_tpu.sharded.updater import ShardedOptState, ShardedOptimizerUpdater
 from bagua_tpu.utils import SpeedMeter
+
+logger = logging.getLogger(__name__)
 
 
 class TrainState(NamedTuple):
@@ -163,6 +166,14 @@ class DistributedDataParallel:
         # observability/flight_recorder.py).  Keyed like _step_fns; cleared
         # with it whenever the plan (and so the collective sequence) changes.
         self._flight_programs = {}
+        # Static-verifier side tables (BAGUA_STATIC_VERIFY=warn|strict):
+        # per-variant predicted flight programs (cross-checked against the
+        # recorder's live capture on the cache-miss dispatch) and the batch
+        # shape template the pre-dispatch gate stashes so rebucket /
+        # apply_precision_plan can re-verify the *new* program before any
+        # step runs it.
+        self._predicted_programs = {}
+        self._verify_batch_template = None
         self._host_step: Optional[int] = None  # seeded from state on first step
         self.speed_meter = SpeedMeter()
         #: cumulative host-side seconds per train_step phase — the
@@ -312,11 +323,37 @@ class DistributedDataParallel:
                 "re-bucketing mid-training would desync it (the reference "
                 "likewise excludes such algorithms from autotune re-bucketing)"
             )
+        prev_plan = self.plan
+        prev_pending = self._pending_reshard
         if self._sharded_updater is not None and self._pending_reshard is None:
             # Keep the layout live state was actually built under (the FIRST
             # of a burst of rebuckets): train_step migrates optimizer shards
             # and pending updates host-side before the next dispatch.
             self._pending_reshard = self._sharded_updater.layout
+        self._adopt_plan(plan)
+        try:
+            # Re-verify the NEW program before any step can dispatch it
+            # (no-op unless BAGUA_STATIC_VERIFY is on and a step has run).
+            self._static_reverify("rebucket")
+        except Exception:
+            # Roll back so the engine keeps dispatching the last-good
+            # program (the version bumps again — uniqueness is what the
+            # consumers rely on, not density).
+            if prev_plan is not None:
+                self._adopt_plan(prev_plan)
+            self._pending_reshard = prev_pending
+            raise
+        if self.telemetry is not None:
+            self.telemetry.on_rebucket(
+                plan_version=self.plan_version,
+                n_buckets=plan.num_buckets,
+                step=self._host_step if self._host_step is not None else 0,
+                predicted_exposed_ms=predicted_exposed_ms,
+            )
+
+    def _adopt_plan(self, plan: BucketPlan) -> None:
+        """Swap the live bucket plan: rebind, rebuild the sharded updater,
+        drop every compiled step / captured program, bump the version."""
         self.plan = plan
         self.impl.bind_plan(plan)
         if self._sharded_updater is not None:
@@ -325,14 +362,8 @@ class DistributedDataParallel:
             )
         self._step_fns = {}
         self._flight_programs = {}
+        self._predicted_programs = {}
         self.plan_version += 1
-        if self.telemetry is not None:
-            self.telemetry.on_rebucket(
-                plan_version=self.plan_version,
-                n_buckets=plan.num_buckets,
-                step=self._host_step if self._host_step is not None else 0,
-                predicted_exposed_ms=predicted_exposed_ms,
-            )
 
     # -- per-bucket wire precision (planner-chosen) --------------------------
 
@@ -351,6 +382,7 @@ class DistributedDataParallel:
                 f"{type(impl).__name__} has no wire_precision knob; "
                 "precision plans apply to gradient_allreduce and zero"
             )
+        prev_raw = getattr(impl, "bucket_precision", None)
         old = impl.bucket_precisions(self.plan) if self.plan is not None else None
         impl.set_bucket_precision(precisions)
         new = impl.bucket_precisions(self.plan) if self.plan is not None else None
@@ -358,6 +390,16 @@ class DistributedDataParallel:
             return False
         self._step_fns = {}
         self._flight_programs = {}
+        self._predicted_programs = {}
+        try:
+            # Prove the re-precisioned program before any step dispatches it.
+            self._static_reverify("apply_precision_plan")
+        except Exception:
+            impl.set_bucket_precision(prev_raw)
+            self._step_fns = {}
+            self._flight_programs = {}
+            self._predicted_programs = {}
+            raise
         if self.telemetry is not None:
             self.telemetry.on_precision_switch(
                 step=self._host_step if self._host_step is not None else 0,
@@ -425,6 +467,13 @@ class DistributedDataParallel:
     # -- the step -----------------------------------------------------------
 
     def _build_step(self, variant: str):
+        return jax.jit(self._build_sharded(variant), donate_argnums=(0,))
+
+    def _build_sharded(self, variant: str):
+        """The un-jitted shard_map'd step for ``variant`` — what
+        :meth:`_build_step` compiles, and what the static verifier
+        (:mod:`bagua_tpu.analysis`) traces with ``jax.make_jaxpr`` to
+        extract the CollectiveIR without dispatching anything."""
         impl, plan, group = self.impl, self.plan, self.group
         overlap = self.overlap_enabled
         updater = self._sharded_updater  # rebucket rebuilds it + clears _step_fns
@@ -566,12 +615,62 @@ class DistributedDataParallel:
             return new_state, loss[None]
 
         n_out = 3 if health_on else 2
-        sharded = self.group.shard_map(
+        return self.group.shard_map(
             local_step,
             in_specs=(P(ALL_AXES), P(ALL_AXES)),
             out_specs=(P(ALL_AXES),) * n_out,
         )
-        return jax.jit(sharded, donate_argnums=(0,))
+
+    # -- static verification (pre-dispatch gate) -----------------------------
+
+    def _maybe_static_verify(self, variant, state, batch) -> None:
+        """The ``BAGUA_STATIC_VERIFY`` pre-dispatch gate: on a jit-cache
+        miss, trace the un-jitted step (``jax.make_jaxpr`` — nothing reaches
+        a device), extract the CollectiveIR and run the four checkers
+        (:mod:`bagua_tpu.analysis`).  ``strict`` raises before dispatch;
+        ``warn`` logs and proceeds.  The batch template is stashed so
+        :meth:`rebucket` / :meth:`apply_precision_plan` can re-verify their
+        new program immediately instead of at the next step."""
+        mode = get_static_verify_mode()
+        if mode == "off" or self.plan is None:
+            return
+        from bagua_tpu import analysis as _an
+
+        self._verify_batch_template = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(jnp.shape(l), jnp.result_type(l)),
+            batch,
+        )
+        report = _an.verify_step_program(self, state, batch, variant=variant)
+        self._predicted_programs[variant] = report.predicted
+        self._verify_report(report, mode, where=f"variant={variant!r}")
+
+    def _static_reverify(self, reason: str) -> None:
+        """Re-run the gate against the CURRENT plan/precision configuration
+        using :meth:`state_template` (the new state layout) and the stashed
+        batch template.  No-op until the gate has seen a real batch."""
+        mode = get_static_verify_mode()
+        if mode == "off" or self.plan is None or self._verify_batch_template is None:
+            return
+        from bagua_tpu import analysis as _an
+
+        variant = self.impl.step_variant(
+            self._host_step if self._host_step is not None else 0
+        )
+        report = _an.verify_step_program(
+            self, self.state_template(), self._verify_batch_template,
+            variant=variant,
+        )
+        self._predicted_programs[variant] = report.predicted
+        self._verify_report(report, mode, where=reason)
+
+    def _verify_report(self, report, mode: str, where: str) -> None:
+        if report.ok:
+            logger.debug("static verify (%s): %s", where, report.summary())
+            return
+        if mode == "strict":
+            report.raise_if_failed()
+        for f in report.errors:
+            logger.warning("static verify (%s): %s", where, f)
 
     # -- flight recorder (trace-time capture, dispatch-time replay) ----------
 
@@ -600,6 +699,7 @@ class DistributedDataParallel:
             prog = self._flight_programs[variant] = self._flight_finalize(
                 variant, events
             )
+            self._flight_crosscheck(variant, prog)
             # the capture dispatch still records; its window is the compile
             # wall, which the telemetry attributes separately
             seqs = flight.record_program(prog, step=self._host_step - 1)
@@ -612,12 +712,35 @@ class DistributedDataParallel:
         flight.retire(seqs)
         return out
 
+    def _flight_crosscheck(self, variant, prog) -> None:
+        """Static/dynamic agreement on the REAL dispatch: the program the
+        recorder just captured from the jit trace must equal the one the
+        static verifier predicted pre-dispatch.  Only active when the gate
+        ran (``BAGUA_STATIC_VERIFY`` on and the variant verified)."""
+        predicted = self._predicted_programs.get(variant)
+        mode = get_static_verify_mode()
+        if predicted is None or mode == "off":
+            return
+        from bagua_tpu.analysis import check_static_dynamic
+
+        findings = check_static_dynamic(predicted, prog)
+        if not findings:
+            return
+        if mode == "strict":
+            from bagua_tpu.analysis import StaticVerifyError
+
+            raise StaticVerifyError(findings)
+        for f in findings:
+            logger.warning(
+                "static verify (dispatch capture, variant=%r): %s", variant, f
+            )
+
     def _flight_finalize(self, variant, events):
         """Enrich the captured descriptors into replayable record templates:
         join bucket index -> plan bytes and planner-chosen wire precision,
         stamp the plan version, and render the label in the named-scope
         grammar so ring records and device-trace labels join on one key."""
-        from bagua_tpu.observability.annotations import EXCHANGE_PREFIX
+        from bagua_tpu.observability.scope_grammar import format_exchange_label
 
         plan = self.plan
         precisions = None
@@ -642,10 +765,7 @@ class DistributedDataParallel:
                 )
             rec["plan_version"] = int(self.plan_version)
             rec["variant"] = str(variant)
-            rec["label"] = (
-                f"{EXCHANGE_PREFIX}/algo={rec['algo']}/bucket={b}"
-                f"/phase={rec['phase']}"
-            )
+            rec["label"] = format_exchange_label(rec["algo"], b, rec["phase"])
             out.append(rec)
         return tuple(out)
 
@@ -678,6 +798,10 @@ class DistributedDataParallel:
             if tel is not None:
                 tel.on_compile(variant, self._host_step)
             fn = self._step_fns[variant] = self._build_step(variant)
+            # Pre-dispatch gate: prove the new program gang-consistent
+            # BEFORE the first dispatch compiles/runs it (no-op when
+            # BAGUA_STATIC_VERIFY=off).
+            self._maybe_static_verify(variant, state, batch)
         self._host_step += 1
         ov = self.host_overhead
         step_ov = {}

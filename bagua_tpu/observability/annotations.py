@@ -26,27 +26,41 @@ analyzer aggregates them into per-scope ``measured_overlap_frac`` rows.
 computation, so annotated and unannotated steps are bitwise-identical and
 the scopes stay on unconditionally.
 
-Field separators are ``/`` (the scope-nesting separator, which XLA joins
-verbatim into ``op_name``) and ``=``; characters like ``@`` are truncated
-by the MLIR location plumbing and must not appear in scope names.
+The grammar itself — prefixes, regexes, formatters and parsers — lives in
+:mod:`bagua_tpu.observability.scope_grammar`, shared with the device-trace
+joiner, the flight recorder's record templates and the static verifier
+(:mod:`bagua_tpu.analysis`); this module re-exports the parsers and adds
+the ``jax.named_scope`` factories.
 """
-
-import re
-from typing import Dict, Optional
 
 import jax
 
-#: scope-name prefixes (kept short: every annotated HLO op carries them)
-EXCHANGE_PREFIX = "bagua_ex"
-STEP_PREFIX = "bagua_step"
+from bagua_tpu.observability.scope_grammar import (
+    EXCHANGE_PREFIX,
+    STEP_PREFIX,
+    format_exchange_label,
+    format_mp_label,
+    format_step_label,
+    parse_exchange_label,
+    parse_mp_label,
+    parse_step_phase,
+)
 
-_EXCHANGE_RE = re.compile(
-    EXCHANGE_PREFIX + r"/algo=(?P<algo>[^/]+)/bucket=(?P<bucket>\d+)/phase=(?P<phase>[^/\"]+)"
-)
-_STEP_RE = re.compile(STEP_PREFIX + r"/phase=(?P<phase>[^/\"]+)")
-_MP_RE = re.compile(
-    EXCHANGE_PREFIX + r"/axis=(?P<axis>[^/=]+)/phase=(?P<phase>[^/\"]+)"
-)
+# Back-compat aliases for the pre-hoist private names.
+from bagua_tpu.observability.scope_grammar import EXCHANGE_RE as _EXCHANGE_RE  # noqa: F401
+from bagua_tpu.observability.scope_grammar import MP_RE as _MP_RE  # noqa: F401
+from bagua_tpu.observability.scope_grammar import STEP_RE as _STEP_RE  # noqa: F401
+
+__all__ = [
+    "EXCHANGE_PREFIX",
+    "STEP_PREFIX",
+    "bucket_scope",
+    "step_scope",
+    "mp_scope",
+    "parse_exchange_label",
+    "parse_mp_label",
+    "parse_step_phase",
+]
 
 
 def bucket_scope(algo: str, bucket_idx, phase: str):
@@ -55,14 +69,14 @@ def bucket_scope(algo: str, bucket_idx, phase: str):
     ``algo`` is the algorithm's registry-style name, ``phase`` distinguishes
     the monolithic tail exchange (``mono``) from the backward-anchored one
     (``overlap``).  Use as a context manager around the traced exchange."""
-    return jax.named_scope(f"{EXCHANGE_PREFIX}/algo={algo}/bucket={int(bucket_idx)}/phase={phase}")
+    return jax.named_scope(format_exchange_label(algo, bucket_idx, phase))
 
 
 def step_scope(phase: str):
     """Named scope labeling one engine phase of the train step
     (``fwd_bwd``, ``optimizer``, ``algo_start``, ``algo_end``,
     ``finalize``...)."""
-    return jax.named_scope(f"{STEP_PREFIX}/phase={phase}")
+    return jax.named_scope(format_step_label(phase))
 
 
 def mp_scope(axis: str, phase: str):
@@ -75,30 +89,4 @@ def mp_scope(axis: str, phase: str):
     ``row_allgather``, ``col_allgather``, ``dispatch``, ``combine``).  Use as
     a context manager around the collective, exactly like
     :func:`bucket_scope`."""
-    return jax.named_scope(f"{EXCHANGE_PREFIX}/axis={axis}/phase={phase}")
-
-
-def parse_mp_label(op_name: str) -> Optional[Dict]:
-    """Extract ``{axis, phase}`` from an HLO ``op_name`` carrying a
-    :func:`mp_scope` frame; None for unlabeled ops (bucket-exchange labels use
-    ``algo=``/``bucket=`` fields and never match)."""
-    m = _MP_RE.search(op_name or "")
-    if not m:
-        return None
-    return {"axis": m.group("axis"), "phase": m.group("phase")}
-
-
-def parse_exchange_label(op_name: str) -> Optional[Dict]:
-    """Extract ``{algo, bucket, phase}`` from an HLO ``op_name`` metadata
-    string (or any string containing a :func:`bucket_scope` frame); None
-    when the op is not part of a labeled bucket exchange."""
-    m = _EXCHANGE_RE.search(op_name or "")
-    if not m:
-        return None
-    return {"algo": m.group("algo"), "bucket": int(m.group("bucket")), "phase": m.group("phase")}
-
-
-def parse_step_phase(op_name: str) -> Optional[str]:
-    """The engine step phase an op was traced under, if labeled."""
-    m = _STEP_RE.search(op_name or "")
-    return m.group("phase") if m else None
+    return jax.named_scope(format_mp_label(axis, phase))

@@ -41,7 +41,11 @@ import re
 import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from bagua_tpu.observability.annotations import parse_exchange_label, parse_mp_label
+from bagua_tpu.observability.scope_grammar import (
+    hlo_op_labels,
+    parse_exchange_label,
+    parse_mp_label,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -63,8 +67,9 @@ COLLECTIVE_OPS = (
     "collective-broadcast",
 )
 
-_HLO_INSTR = re.compile(r"%([A-Za-z0-9_.\-]+) = .*metadata=\{[^}]*op_name=\"([^\"]*)\"")
-_HLO_MODULE = re.compile(r"^HloModule ([^\s,]+)", re.MULTILINE)
+# The HLO instruction → op_name join table (_HLO_INSTR/_HLO_MODULE) moved to
+# scope_grammar so the static verifier shares one parser; hlo_op_labels is
+# re-exported above for the existing callers.
 
 
 def find_trace_file(log_dir: str) -> Optional[str]:
@@ -156,14 +161,6 @@ def load_trace_events(log_dir: str) -> List[Dict]:
                 "analyzing the salvaged prefix", path, len(out), e,
             )
     return out
-
-
-def hlo_op_labels(hlo_text: str) -> Tuple[str, Dict[str, str]]:
-    """``(module_name, {instruction_name: op_name_metadata})`` from compiled
-    HLO text — the join table between trace events and named-scope labels."""
-    m = _HLO_MODULE.search(hlo_text)
-    module = m.group(1) if m else ""
-    return module, {name: op_name for name, op_name in _HLO_INSTR.findall(hlo_text)}
 
 
 def _merge_intervals(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:

@@ -101,6 +101,9 @@ def main(argv=None) -> int:
                     help="explicit glob for dump files (overrides --dir)")
     ap.add_argument("--out", default=None,
                     help="write the hang_report JSON here (default: stdout)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero (4) when the verdict is a desync — "
+                    "lets CI and watchdog wrappers gate on the forensics")
     args = ap.parse_args(argv)
 
     pattern = args.glob or os.path.join(args.dir, "flight_*.json")
@@ -133,6 +136,9 @@ def main(argv=None) -> int:
     else:
         print(text)
     print(summarize(report), file=sys.stderr)
+    if args.strict and report["verdict"] == "desync":
+        print("diagnose_hang: --strict and verdict is desync", file=sys.stderr)
+        return 4
     return 0
 
 

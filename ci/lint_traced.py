@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Retrace-hazard lint: AST rules over traced-function bodies.
+
+The static verifier (``bagua_tpu/analysis/``) proves properties of the jaxpr
+a step traces to; this lint catches the class of bug that corrupts the trace
+*before* a jaxpr exists — host Python that concretizes or branches on traced
+values, or that injects wall-clock/host-RNG nondeterminism into a function
+JAX will retrace.  Each hazard forces either a ``TracerBoolConversionError``
+at trace time or, worse, a silent per-rank trace divergence (two ranks trace
+different programs → the exact cross-rank desync the flight recorder can
+only diagnose post-mortem).
+
+Rules (all purely syntactic, so no imports of the linted code):
+
+* ``concretize-traced`` — ``int()``/``float()``/``bool()``/``len()`` applied
+  directly to a ``jnp.*``/``lax.*``/``jax.numpy.*``/``jax.lax.*`` call
+  result: forces a traced value concrete (trace error, or a silent
+  recompile-per-value if the input is a weak literal).
+* ``python-if-on-traced-call`` — an ``if``/``while`` test (or ``assert``)
+  containing a direct ``jnp.*``/``lax.*`` call: Python control flow cannot
+  branch on traced values; ranks evaluating data-dependent predicates
+  diverge.  ``jnp.where``/``lax.cond`` are the lawful forms.
+* ``wallclock-in-traced`` — ``time.time``/``perf_counter``/``monotonic``/
+  ``datetime.now`` inside a traced function: the value is baked into the
+  trace at compile time (stale forever) and differs per rank.
+* ``host-random-in-traced`` — ``random.*``/``np.random.*`` inside a traced
+  function: per-rank RNG state makes ranks trace different constants;
+  ``jax.random`` with an explicit key is the lawful form.
+
+A function is considered *traced* when a decorator mentions ``jit``,
+``custom_vjp``/``custom_jvp``/``defvjp``, ``remat``/``checkpoint``,
+``shard_map`` or ``pmap`` — or when it is lexically nested inside one that
+is.  The wall-clock/RNG rules apply only to traced functions; the
+concretize/branch rules apply everywhere (a ``jnp`` call in host code still
+round-trips through the device and is almost always a mistake in this
+codebase's host paths).
+
+Baseline workflow: existing findings live in ``ci/lint_traced_baseline.json``
+(keys ``path:qualname:rule:line``-less, so moving a function does not churn
+the baseline).  The lint fails (exit 1) only on findings NOT in the
+baseline; ``--write-baseline`` regenerates it after an accepted change.
+Stale baseline entries are reported informationally so the allowlist only
+ever shrinks.
+
+Usage::
+
+    python ci/lint_traced.py [--root bagua_tpu] [--write-baseline]
+"""
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "ci", "lint_traced_baseline.json")
+
+#: decorator substrings that mark a function as traced by JAX
+TRACED_DECORATORS = (
+    "jit",
+    "custom_vjp",
+    "custom_jvp",
+    "defvjp",
+    "remat",
+    "checkpoint",
+    "shard_map",
+    "pmap",
+)
+
+#: module attribute roots whose calls produce traced values
+TRACED_ROOTS = ("jnp", "lax")
+TRACED_DOTTED = ("jax.numpy", "jax.lax")
+
+CONCRETIZERS = ("int", "float", "bool", "len")
+
+WALLCLOCK_CALLS = (
+    "time.time",
+    "time.perf_counter",
+    "time.monotonic",
+    "time.process_time",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.datetime.now",
+)
+
+HOST_RANDOM_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Render ``a.b.c`` attribute/name chains; None for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_traced_call(node: ast.AST) -> bool:
+    """A direct call whose callee is rooted at jnp./lax./jax.numpy./jax.lax."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = _dotted(node.func)
+    if name is None:
+        return False
+    root = name.split(".", 1)[0]
+    return root in TRACED_ROOTS or any(
+        name.startswith(d + ".") for d in TRACED_DOTTED
+    )
+
+
+def _contains_traced_call(node: ast.AST) -> bool:
+    return any(_is_traced_call(n) for n in ast.walk(node))
+
+
+class Finding:
+    def __init__(self, path: str, qualname: str, rule: str, line: int, text: str):
+        self.path, self.qualname, self.rule = path, qualname, rule
+        self.line, self.text = line, text
+
+    @property
+    def key(self) -> str:
+        # line numbers deliberately excluded: reflowing a file must not
+        # churn the baseline
+        return f"{self.path}:{self.qualname}:{self.rule}"
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.qualname}: {self.text}"
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.findings: List[Finding] = []
+        #: stack of (name, is_traced) for enclosing functions
+        self.stack: List[Tuple[str, bool]] = []
+
+    # -- helpers ------------------------------------------------------------
+
+    def _qualname(self) -> str:
+        return ".".join(n for n, _ in self.stack) or "<module>"
+
+    def _in_traced(self) -> bool:
+        return any(traced for _, traced in self.stack)
+
+    def _emit(self, rule: str, node: ast.AST, text: str) -> None:
+        self.findings.append(
+            Finding(self.relpath, self._qualname(), rule,
+                    getattr(node, "lineno", 0), text)
+        )
+
+    # -- function nesting ---------------------------------------------------
+
+    def _visit_function(self, node) -> None:
+        decos = " ".join(
+            ast.unparse(d) if hasattr(ast, "unparse") else "" for d in node.decorator_list
+        )
+        traced = any(marker in decos for marker in TRACED_DECORATORS)
+        self.stack.append((node.name, traced))
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_ClassDef(self, node) -> None:
+        self.stack.append((node.name, False))
+        self.generic_visit(node)
+        self.stack.pop()
+
+    # -- rules --------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        if name in CONCRETIZERS and node.args and _is_traced_call(node.args[0]):
+            self._emit(
+                "concretize-traced", node,
+                f"{name}() applied directly to a traced "
+                f"{_dotted(node.args[0].func)}() result",
+            )
+        if name is not None and self._in_traced():
+            if name in WALLCLOCK_CALLS:
+                self._emit(
+                    "wallclock-in-traced", node,
+                    f"{name}() inside a traced function bakes a per-rank "
+                    "wall-clock constant into the trace",
+                )
+            elif any(name.startswith(p) for p in HOST_RANDOM_PREFIXES):
+                self._emit(
+                    "host-random-in-traced", node,
+                    f"{name}() inside a traced function traces per-rank "
+                    "host RNG state; use jax.random with an explicit key",
+                )
+        self.generic_visit(node)
+
+    def _check_test(self, node: ast.AST, what: str) -> None:
+        if _contains_traced_call(node):
+            self._emit(
+                "python-if-on-traced-call", node,
+                f"{what} test contains a direct jnp/lax call — Python "
+                "control flow cannot branch on traced values "
+                "(use jnp.where / lax.cond)",
+            )
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_test(node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_test(node.test, "while")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_test(node.test, "assert")
+        self.generic_visit(node)
+
+
+def lint_file(path: str, relpath: str) -> List[Finding]:
+    with open(path, "r") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(relpath, "<module>", "syntax-error", e.lineno or 0, str(e))]
+    linter = _Linter(relpath)
+    linter.visit(tree)
+    return linter.findings
+
+
+def lint_tree(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if not d.startswith((".", "__pycache__")))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            findings.extend(lint_file(path, os.path.relpath(path, REPO)))
+    return findings
+
+
+def load_baseline() -> List[str]:
+    if not os.path.exists(BASELINE):
+        return []
+    with open(BASELINE) as f:
+        data = json.load(f)
+    return list(data.get("allow", []))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=os.path.join(REPO, "bagua_tpu"),
+                    help="package root to lint (default: bagua_tpu/)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate ci/lint_traced_baseline.json from the "
+                    "current findings and exit 0")
+    args = ap.parse_args(argv)
+
+    findings = lint_tree(args.root)
+    keys = sorted({f.key for f in findings})
+
+    if args.write_baseline:
+        with open(BASELINE, "w") as f:
+            json.dump({"schema": 1, "allow": keys}, f, indent=2)
+            f.write("\n")
+        print(f"lint_traced: baseline written with {len(keys)} entries",
+              file=sys.stderr)
+        return 0
+
+    allow = set(load_baseline())
+    new = [f for f in findings if f.key not in allow]
+    stale = sorted(allow - {f.key for f in findings})
+
+    for f in findings:
+        status = "allowed" if f.key in allow else "NEW"
+        print(f"[{status}] {f}")
+    for key in stale:
+        print(f"lint_traced: stale baseline entry (fixed? remove it): {key}",
+              file=sys.stderr)
+
+    if new:
+        print(f"lint_traced: {len(new)} new retrace hazard(s) "
+              f"({len(findings) - len(new)} baselined)", file=sys.stderr)
+        return 1
+    print(f"lint_traced: ok ({len(findings)} finding(s), all baselined; "
+          f"{len(stale)} stale baseline entr(y/ies))", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

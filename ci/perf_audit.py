@@ -611,6 +611,87 @@ def hang_forensics_lane(out_prefix: str, steps: int = 8):
     }
 
 
+def static_verify_lane():
+    """Pre-dispatch static collective-program verification gate.
+
+    Runs the four-checker verifier (``bagua_tpu/analysis/``) in strict mode
+    over the modeled wire programs — gradient_allreduce (f32 + int8) and
+    zero — on the standard mlp/8-device fixture.  Everything happens at
+    trace time: the engine's sharded step is traced over abstract shapes,
+    the IR's ring-model bytes must equal the planner's analytic model
+    exactly, and the predicted flight program must equal the trace-time
+    capture record-for-record.  Nothing dispatches.  The full
+    algorithm x precision x overlap sweep is ``ci/static_verify.py``; this
+    lane is its tier-1 heartbeat.
+    """
+    import bagua_tpu
+    from bagua_tpu.algorithms import build_algorithm
+    from bagua_tpu.analysis import verify_step_program
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+    group = bagua_tpu.init_process_group(intra_size=4)
+    params = init_mlp(jax.random.PRNGKey(0), [64, 128, 128, 64])
+    rng = np.random.RandomState(0)
+    batch = (jnp.asarray(rng.randn(32, 64).astype(np.float32)),
+             jnp.asarray(rng.randn(32, 64).astype(np.float32)))
+
+    configs = [
+        ("gradient_allreduce", {}),
+        ("gradient_allreduce[int8]", {"wire_precision": "int8"}),
+        ("zero", {}),
+    ]
+    rows = []
+    for name, kwargs in configs:
+        algo = build_algorithm(name.split("[", 1)[0], lr=0.1, **kwargs)
+        ddp = DistributedDataParallel(
+            mse_loss, optax.sgd(0.01, momentum=0.9), algo,
+            process_group=group, bucket_size_bytes=1 << 12, overlap=False,
+        )
+        try:
+            state = ddp.init(params)
+            report = verify_step_program(
+                ddp, state, batch, variant=ddp.impl.step_variant(0)
+            )
+            report.raise_if_failed()  # strict: any error finding aborts CI
+            rows.append({
+                "config": name,
+                "ok": True,
+                "num_collectives": report.num_collectives,
+                "bucket_phases": len(report.wire_table),
+                "records": len(report.captured),
+            })
+        finally:
+            ddp.shutdown()
+    print(
+        "[audit] static verify lane passed ("
+        + ", ".join(f"{r['config']}: {r['num_collectives']} collectives"
+                    for r in rows)
+        + ", exact wire bytes + record-for-record flight agreement)",
+        file=sys.stderr,
+    )
+    return {"configs": rows, "mode": "strict"}
+
+
+def retrace_lint_lane():
+    """Retrace-hazard lint gate: ``ci/lint_traced.py`` over ``bagua_tpu/``
+    must report no findings beyond the committed baseline allowlist."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "ci", "lint_traced.py")],
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"retrace-hazard lint failed (rc={proc.returncode}):\n"
+            f"{proc.stdout}\n{proc.stderr}"
+        )
+    summary = proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else ""
+    print(f"[audit] retrace-hazard lint passed ({summary})", file=sys.stderr)
+    return {"ok": True, "summary": summary}
+
+
 def autotune_planner_lane(fixture_path=None):
     """Recorded-span planner gate (pure cost model, no compile — CPU-safe).
 
@@ -1650,6 +1731,15 @@ def main():
     hang_result = None
     if args.algo is None and args.wire is None:
         hang_result = hang_forensics_lane(args.out)
+    # Pre-dispatch static verification gate: strict four-checker pass over
+    # the modeled wire programs (gradient_allreduce f32 + int8, zero) plus
+    # the retrace-hazard lint.  Trace-only, so cheap enough for every full
+    # run; the focused --algo/--wire lanes skip it.
+    static_verify_result = None
+    retrace_lint_result = None
+    if args.algo is None and args.wire is None:
+        static_verify_result = static_verify_lane()
+        retrace_lint_result = retrace_lint_lane()
     # Recorded-span planner gate: DP partition must beat the greedy seed
     # plan's predicted exposed comm on the committed VGG16 fixture.
     planner_result = autotune_planner_lane()
@@ -1676,6 +1766,8 @@ def main():
              "wire": wire_result,
              "health": health_result,
              "hang_forensics": hang_result,
+             "static_verify": static_verify_result,
+             "retrace_lint": retrace_lint_result,
              "resilience": resilience_result},
             f, indent=1,
         )

@@ -41,6 +41,8 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert "fault-injection resilience lane passed" in proc.stderr
     assert "health guardrail lane passed" in proc.stderr
     assert "hang forensics lane passed" in proc.stderr
+    assert "static verify lane passed" in proc.stderr
+    assert "retrace-hazard lint passed" in proc.stderr
 
     # The telemetry smoke emits a JSONL metrics stream next to --out; hold it
     # to the event schema here too (belt and braces: the subprocess already
@@ -142,6 +144,21 @@ def test_perf_audit_quick_overlap_census(tmp_path):
         report = json.load(f)
     assert validate_hang_report(report) == []
     assert report["blocked_on"]["label"] == blocked["label"]
+
+    # The static-verify lane's artifact: strict four-checker verification of
+    # the modeled wire programs, all trace-time (nothing dispatched), plus
+    # the retrace-hazard lint holding the baseline allowlist.
+    sv = audit["static_verify"]
+    assert sv["mode"] == "strict"
+    configs = {row["config"]: row for row in sv["configs"]}
+    assert set(configs) == {
+        "gradient_allreduce", "gradient_allreduce[int8]", "zero",
+    }
+    for row in configs.values():
+        assert row["ok"] is True
+        assert row["num_collectives"] > 0
+        assert row["bucket_phases"] > 0
+    assert audit["retrace_lint"]["ok"] is True
 
 
 def test_perf_audit_quick_bytegrad_compressed_census(tmp_path):
