@@ -257,10 +257,38 @@ def check_rank_invariance(program: CollectiveProgram) -> List[Finding]:
 # ---------------------------------------------------------------------------
 
 
+def _branch_deduped_bytes(items: List[Tuple[Tuple[str, ...], int]]) -> int:
+    """Wire bytes one runtime execution of a descriptor group moves.
+
+    The walker records *every* branch of a ``cond`` but only one executes,
+    so summing naively double-counts an exchange scope whose collectives
+    appear in sibling branches.  ``items`` pairs each descriptor's
+    cond-frames path (the ``"cond#<eqn>@<branch>"`` entries of
+    ``CollectiveDescriptor.path``) with its wire bytes; descriptors in
+    sibling branches of the same cond contribute the **max** across
+    branches — exact when the branches move equal bytes (the only layout
+    the exactness contract can hold for anyway), best-effort otherwise."""
+    total = 0
+    by_cond: Dict[str, Dict[str, List[Tuple[Tuple[str, ...], int]]]] = {}
+    for path, nbytes in items:
+        if not path:
+            total += nbytes
+            continue
+        cid, _, branch = path[0].partition("@")
+        by_cond.setdefault(cid, {}).setdefault(branch, []).append(
+            (path[1:], nbytes)
+        )
+    for branches in by_cond.values():
+        total += max(_branch_deduped_bytes(sub) for sub in branches.values())
+    return total
+
+
 def check_wire_exactness(
     program: CollectiveProgram, cfg: WireModelConfig
 ) -> Tuple[List[Finding], List[Dict]]:
-    """Summed IR wire bytes per ``(bucket, phase)`` vs the analytic model.
+    """Summed IR wire bytes per ``(bucket, phase)`` vs the analytic model
+    (mutually-exclusive cond branches de-duplicated, see
+    :func:`_branch_deduped_bytes`).
 
     Returns ``(findings, table)`` — the table has one row per labeled
     bucket-phase group with ``observed``/``expected``/``modeled`` fields
@@ -268,7 +296,13 @@ def check_wire_exactness(
     findings: List[Finding] = []
     table: List[Dict] = []
     for (algo, bucket, phase), descs in program.by_bucket_phase().items():
-        observed = sum(d.wire_bytes for d in descs)
+        observed = _branch_deduped_bytes([
+            (
+                tuple(p for p in d.path if p.startswith("cond#")),
+                d.wire_bytes,
+            )
+            for d in descs
+        ])
         expected = (
             cfg.expected_bucket_bytes(bucket, phase)
             if algo == cfg.algo and bucket < len(cfg.plan.specs) else None

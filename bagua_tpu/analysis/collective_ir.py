@@ -20,17 +20,22 @@ primitive — ``psum``/``pmax``/``pmin``/``reduce_scatter``/``all_gather``/
 * its control-flow nesting path and a **rank-conditional** flag.
 
 The rank-conditional flag comes from a taint analysis run during the same
-walk: ``axis_index`` results (and anything computed from them) are tainted;
-the rank-uniformizing collectives (``psum``/``pmax``/``pmin``/
-``all_gather`` over the full axis) launder taint away, since every rank
-gets the identical result.  A ``cond``/``while`` whose predicate is tainted
-executes *different branch programs on different ranks* — any collective
-inside such a branch is the exact desync class the flight recorder (PR 10)
-can only diagnose post-mortem, so the walker marks it for
-``check_rank_invariance`` to reject at trace time.  The analysis is
-deliberately scoped to ``axis_index``-derived taint: per-rank *data* (batch
-shards) is rank-varying too, but branching on reduced data is the normal
-``is_update_step`` pattern and must stay clean.
+walk.  Taint is tracked **per mesh axis**: ``axis_index('dp')`` taints its
+result (and anything computed from it) with ``{'dp'}`` — the set of axes
+along which the value can differ between ranks.  The rank-uniformizing
+collectives (``psum``/``pmax``/``pmin``/``all_gather``) launder only the
+axes they actually span: a ``psum`` over ``'dp'`` of a ``'dp'``-tainted
+value is identical on every rank and clears the taint, but a ``psum`` over
+a *sub*-axis (say ``'tp'``) of that same value still differs across
+``'dp'`` ranks, so the residual ``{'dp'}`` taint survives.  A ``cond``/
+``while`` whose predicate carries any residual axis taint executes
+*different branch programs on different ranks* — any collective inside
+such a branch (including the ``while``'s own predicate jaxpr) is the exact
+desync class the flight recorder (PR 10) can only diagnose post-mortem, so
+the walker marks it for ``check_rank_invariance`` to reject at trace time.
+The analysis is deliberately scoped to ``axis_index``-derived taint:
+per-rank *data* (batch shards) is rank-varying too, but branching on
+reduced data is the normal ``is_update_step`` pattern and must stay clean.
 """
 
 import dataclasses
@@ -65,8 +70,9 @@ COLLECTIVE_PRIMITIVES = {
     "all_to_all": None,
 }
 
-#: collectives whose outputs are identical on every rank of the axis — they
-#: launder axis_index taint away (a branch on a psum'd value is gang-safe)
+#: collectives whose outputs are identical on every rank of the axes they
+#: span — they launder those axes' axis_index taint away (a branch on a
+#: fully-reduced value is gang-safe; taint along unreduced axes survives)
 UNIFORMIZING_PRIMITIVES = frozenset({"psum", "pmax", "pmin", "all_gather"})
 
 #: control-flow primitives whose predicate picks the executed program
@@ -109,7 +115,10 @@ class CollectiveDescriptor:
     scope: Optional[Dict]           #: parsed bucket-exchange frame
     mp: Optional[Dict]              #: parsed model-parallel frame
     qr: Optional[Dict]              #: parsed quantized-ring sub-scope
-    path: Tuple[str, ...]           #: enclosing control-flow primitives
+    path: Tuple[str, ...]           #: enclosing control-flow frames —
+                                    #: ``"while"`` or ``"cond#<eqn>@<branch>"``
+                                    #: (the ids let checkers tell sibling
+                                    #: branches of one cond apart)
     rank_conditional: bool          #: under a rank-tainted predicate
     cond_label: Optional[str]       #: label of that tainted control-flow eqn
 
@@ -192,12 +201,17 @@ def _sub_jaxprs(params) -> List[jcore.Jaxpr]:
     return subs
 
 
+_NO_AXES: frozenset = frozenset()
+
+
 class _Walk:
     def __init__(self, axis_sizes: Dict[str, int]):
         self.axis_sizes = {str(k): int(v) for k, v in axis_sizes.items()}
         self.out: List[CollectiveDescriptor] = []
-        # stack of (primitive, label, predicate_tainted)
+        # stack of (frame, label, predicate_tainted); frame is "while" or
+        # "cond#<eqn-id>@<branch>" so sibling branches are distinguishable
         self.ctrl: List[Tuple[str, str, bool]] = []
+        self._cond_ids = 0  # unique id per visited cond eqn
 
     # -- recording -----------------------------------------------------------
 
@@ -233,52 +247,82 @@ class _Walk:
         )
 
     # -- taint helpers -------------------------------------------------------
+    #
+    # ``taint`` maps Var -> frozenset of mesh-axis names the value can vary
+    # along between ranks.  An empty mapping means rank-uniform.
 
     @staticmethod
-    def _tainted(v, taint) -> bool:
-        return isinstance(v, jcore.Var) and v in taint
+    def _taint_of(v, taint: Dict) -> frozenset:
+        if isinstance(v, jcore.Var):
+            return taint.get(v, _NO_AXES)
+        return _NO_AXES
 
-    def _seed(self, sub_invars, call_invars, taint) -> set:
-        sub = set()
+    def _in_axes(self, eqn, taint: Dict) -> frozenset:
+        axes = _NO_AXES
+        for v in eqn.invars:
+            axes |= self._taint_of(v, taint)
+        return axes
+
+    def _seed(self, sub_invars, call_invars, taint: Dict) -> Dict:
+        sub: Dict[Any, frozenset] = {}
         for sv, av in zip(sub_invars, call_invars):
-            if self._tainted(av, taint):
-                sub.add(sv)
+            ax = self._taint_of(av, taint)
+            if ax:
+                sub[sv] = ax
         return sub
+
+    def _known_axes(self, eqn) -> frozenset:
+        return frozenset(
+            a for a in _axis_names(eqn) if a in self.axis_sizes
+        )
 
     # -- the walk ------------------------------------------------------------
 
-    def walk(self, jaxpr: jcore.Jaxpr, taint: set, record: bool = True) -> None:
+    def walk(self, jaxpr: jcore.Jaxpr, taint: Dict, record: bool = True) -> None:
         for eqn in jaxpr.eqns:
             name = eqn.primitive.name
             label = str(eqn.source_info.name_stack)
-            in_taint = any(self._tainted(v, taint) for v in eqn.invars)
+            in_axes = self._in_axes(eqn, taint)
 
             if name == "axis_index":
-                taint.update(eqn.outvars)
+                # Varies exactly along the indexed axis; if the axis name is
+                # unrecognized, conservatively assume every mesh axis.
+                axes = self._known_axes(eqn) or frozenset(self.axis_sizes)
+                for v in eqn.outvars:
+                    taint[v] = axes
                 continue
 
             if name in COLLECTIVE_PRIMITIVES:
                 if record:
                     self.record(eqn, label)
                 if name in UNIFORMIZING_PRIMITIVES:
-                    continue  # outputs identical on every rank: taint laundered
-                if in_taint:
-                    taint.update(eqn.outvars)
+                    # Identical on every rank of the axes it spans — launder
+                    # exactly those; taint along unreduced axes survives (a
+                    # psum over 'tp' of a 'dp'-varying value still differs
+                    # across 'dp' ranks).
+                    in_axes -= self._known_axes(eqn)
+                if in_axes:
+                    for v in eqn.outvars:
+                        taint[v] = in_axes
                 continue
 
             if name == "cond":
                 pred = eqn.invars[0]
-                pred_taint = self._tainted(pred, taint)
-                out_taint = pred_taint
-                for br in eqn.params["branches"]:
+                pred_axes = self._taint_of(pred, taint)
+                out_axes = pred_axes
+                cid = self._cond_ids
+                self._cond_ids += 1
+                for bi, br in enumerate(eqn.params["branches"]):
                     brj = br.jaxpr if isinstance(br, jcore.ClosedJaxpr) else br
                     sub = self._seed(brj.invars, eqn.invars[1:], taint)
-                    self.ctrl.append((name, label, pred_taint))
+                    self.ctrl.append((f"cond#{cid}@{bi}", label, bool(pred_axes)))
                     self.walk(brj, sub, record)
                     self.ctrl.pop()
-                    out_taint |= any(self._tainted(v, sub) for v in brj.outvars)
-                if out_taint:
-                    taint.update(eqn.outvars)
+                    for v in brj.outvars:
+                        out_axes |= self._taint_of(v, sub)
+                if out_axes:
+                    for v in eqn.outvars:
+                        taint[v] = out_axes
                 continue
 
             if name == "while":
@@ -287,22 +331,25 @@ class _Walk:
 
             subs = _sub_jaxprs(eqn.params)
             if subs:
-                out_taint = in_taint
+                out_axes = in_axes
                 for sj in subs:
                     # pjit/shard_map invars align 1:1 with the call's; for
                     # scan/custom_vjp the positional zip is a conservative
                     # best-effort seed (zip truncates on mismatch)
                     sub = self._seed(sj.invars, eqn.invars, taint)
                     self.walk(sj, sub, record)
-                    out_taint |= any(self._tainted(v, sub) for v in sj.outvars)
-                if out_taint:
-                    taint.update(eqn.outvars)
+                    for v in sj.outvars:
+                        out_axes |= self._taint_of(v, sub)
+                if out_axes:
+                    for v in eqn.outvars:
+                        taint[v] = out_axes
                 continue
 
-            if in_taint:
-                taint.update(eqn.outvars)
+            if in_axes:
+                for v in eqn.outvars:
+                    taint[v] = in_axes
 
-    def _walk_while(self, eqn, taint: set, record: bool, label: str) -> None:
+    def _walk_while(self, eqn, taint: Dict, record: bool, label: str) -> None:
         p = eqn.params
         cond_j = p["cond_jaxpr"].jaxpr
         body_j = p["body_jaxpr"].jaxpr
@@ -310,46 +357,60 @@ class _Walk:
         cond_consts = list(eqn.invars[:cn])
         body_consts = list(eqn.invars[cn:cn + bn])
         carry = list(eqn.invars[cn + bn:])
-        carry_taint = [self._tainted(v, taint) for v in carry]
+        carry_taint = [self._taint_of(v, taint) for v in carry]
 
         def seed_from(consts, sub_invars):
-            sub = set()
+            sub: Dict[Any, frozenset] = {}
             for sv, av in zip(sub_invars, consts + carry):
-                if self._tainted(av, taint):
-                    sub.add(sv)
+                ax = self._taint_of(av, taint)
+                if ax:
+                    sub[sv] = ax
             # carry slots tainted by a previous body pass
-            for sv, t in zip(sub_invars[len(consts):], carry_taint):
-                if t:
-                    sub.add(sv)
+            for sv, ax in zip(sub_invars[len(consts):], carry_taint):
+                if ax:
+                    sub[sv] = sub.get(sv, _NO_AXES) | ax
             return sub
 
         # Fixpoint approximation on the carried taint: two silent body
-        # passes (one propagation step each) before the recording pass.
-        pred_taint = False
+        # passes (one propagation step each) before the recording passes.
+        pred_axes: frozenset = _NO_AXES
         for _ in range(2):
             csub = seed_from(cond_consts, cond_j.invars)
             self.walk(cond_j, csub, record=False)
-            pred_taint = any(self._tainted(v, csub) for v in cond_j.outvars)
+            pred_axes = _NO_AXES
+            for v in cond_j.outvars:
+                pred_axes |= self._taint_of(v, csub)
             bsub = seed_from(body_consts, body_j.invars)
-            self.ctrl.append(("while", label, pred_taint))
+            self.ctrl.append(("while", label, bool(pred_axes)))
             self.walk(body_j, bsub, record=False)
             self.ctrl.pop()
-            new_carry = [
-                self._tainted(v, bsub)
-                for v in body_j.outvars
-            ]
+            new_carry = [self._taint_of(v, bsub) for v in body_j.outvars]
             if new_carry == carry_taint[: len(new_carry)]:
                 break
-            for i, t in enumerate(new_carry):
+            for i, ax in enumerate(new_carry):
                 if i < len(carry_taint):
-                    carry_taint[i] = carry_taint[i] or t
-        # recording pass with converged taint
+                    carry_taint[i] = carry_taint[i] | ax
+        # Recording passes with converged taint — cond first (it evaluates
+        # before the body), so a collective in the loop *predicate* (e.g. a
+        # psum'd convergence residual) enters the wire census and the
+        # rank-invariance check like any body collective: whether iteration
+        # k's predicate even evaluates depends on iteration k-1's result,
+        # so it inherits the same rank-conditional marking.
+        pred_t = bool(pred_axes)
+        csub = seed_from(cond_consts, cond_j.invars)
+        self.ctrl.append(("while", label, pred_t))
+        self.walk(cond_j, csub, record=record)
+        self.ctrl.pop()
         bsub = seed_from(body_consts, body_j.invars)
-        self.ctrl.append(("while", label, pred_taint))
+        self.ctrl.append(("while", label, pred_t))
         self.walk(body_j, bsub, record=record)
         self.ctrl.pop()
-        if pred_taint or any(carry_taint):
-            taint.update(eqn.outvars)
+        if pred_axes or any(carry_taint):
+            out_axes = pred_axes
+            for ax in carry_taint:
+                out_axes |= ax
+            for v in eqn.outvars:
+                taint[v] = out_axes
 
 
 def extract_collective_ir(closed_jaxpr, axis_sizes: Dict[str, int]) -> CollectiveProgram:
@@ -365,5 +426,5 @@ def extract_collective_ir(closed_jaxpr, axis_sizes: Dict[str, int]) -> Collectiv
         else closed_jaxpr
     )
     w = _Walk(axis_sizes)
-    w.walk(jaxpr, set())
+    w.walk(jaxpr, {})
     return CollectiveProgram(collectives=w.out, axis_sizes=dict(w.axis_sizes))

@@ -640,9 +640,24 @@ class DistributedDataParallel:
             lambda l: jax.ShapeDtypeStruct(jnp.shape(l), jnp.result_type(l)),
             batch,
         )
-        report = _an.verify_step_program(self, state, batch, variant=variant)
-        self._predicted_programs[variant] = report.predicted
+        # A pending host-side reshard means ``state`` still carries the OLD
+        # shard layout; the program that actually dispatches runs after
+        # _apply_pending_reshard, so trace over the current layout's
+        # template instead of the live state.
+        verify_state = (
+            self.state_template() if self._pending_reshard is not None
+            else state
+        )
+        report = self._run_verify(
+            _an, verify_state, batch, variant, mode,
+            where=f"variant={variant!r}",
+        )
+        if report is None:
+            return
         self._verify_report(report, mode, where=f"variant={variant!r}")
+        # Committed only after the gate passes (or warn-mode proceeds):
+        # a strict rejection must leave no prediction behind.
+        self._predicted_programs[variant] = report.predicted
 
     def _static_reverify(self, reason: str) -> None:
         """Re-run the gate against the CURRENT plan/precision configuration
@@ -656,12 +671,31 @@ class DistributedDataParallel:
         variant = self.impl.step_variant(
             self._host_step if self._host_step is not None else 0
         )
-        report = _an.verify_step_program(
-            self, self.state_template(), self._verify_batch_template,
-            variant=variant,
+        report = self._run_verify(
+            _an, self.state_template(), self._verify_batch_template,
+            variant, mode, where=reason,
         )
-        self._predicted_programs[variant] = report.predicted
+        if report is None:
+            return
         self._verify_report(report, mode, where=reason)
+        self._predicted_programs[variant] = report.predicted
+
+    def _run_verify(self, _an, state, batch, variant, mode, where):
+        """Trace + check one step variant, wrapping *trace* failures per
+        mode: a raw ``make_jaxpr`` error (not a checker Finding) raises
+        under strict but must not crash the step under warn — the gate is
+        advisory there.  Returns None when the trace failed in warn mode."""
+        try:
+            return _an.verify_step_program(self, state, batch, variant=variant)
+        except _an.StaticVerifyError:
+            raise
+        except Exception as e:
+            if mode == "strict":
+                raise
+            logger.warning(
+                "static verify (%s): trace failed, gate skipped: %s", where, e
+            )
+            return None
 
     def _verify_report(self, report, mode: str, where: str) -> None:
         if report.ok:
@@ -797,11 +831,16 @@ class DistributedDataParallel:
             # still shows the miss in the telemetry snapshot.
             if tel is not None:
                 tel.on_compile(variant, self._host_step)
-            fn = self._step_fns[variant] = self._build_step(variant)
+            fn = self._build_step(variant)
             # Pre-dispatch gate: prove the new program gang-consistent
             # BEFORE the first dispatch compiles/runs it (no-op when
-            # BAGUA_STATIC_VERIFY=off).
+            # BAGUA_STATIC_VERIFY=off).  The gate runs before the step is
+            # cached: under strict a rejection must leave nothing behind,
+            # or a caller that catches the error and retries (the same
+            # catch-and-continue pattern the rebucket rollback serves)
+            # would dispatch the rejected program off the cache.
             self._maybe_static_verify(variant, state, batch)
+            self._step_fns[variant] = fn
         self._host_step += 1
         ov = self.host_overhead
         step_ov = {}

@@ -136,6 +136,101 @@ def test_uniform_cond_psum_is_clean(group):
     ]
 
 
+def test_subaxis_psum_does_not_launder_taint(group):
+    """A psum over a *sub*-axis does not uniformize along the others: a
+    predicate derived from ``axis_index('inter')`` stays inter-varying
+    after a psum over 'intra' only, so branching on it around a collective
+    must still be rejected (the false-negative class of whole-set
+    laundering)."""
+
+    def body(x):
+        r = jax.lax.axis_index("inter")
+        # reduces over 'intra' only: still differs across 'inter' ranks
+        half_uniform = jax.lax.psum(r, "intra")
+
+        def exchange(v):
+            return jax.lax.psum(v, "intra")
+
+        def skip(v):
+            return v * 4.0
+
+        return jax.lax.cond(half_uniform > 0, exchange, skip, x)
+
+    fn = group.shard_map(body, in_specs=(P("intra"),), out_specs=P("intra"))
+    x = jnp.ones((8, 4), jnp.float32)
+    program, _ = collect_ir(fn, (x,), dict(group.mesh.shape))
+
+    flagged = [d for d in program.collectives if d.rank_conditional]
+    assert flagged, "sub-axis psum laundered taint it must not launder"
+    assert [f for f in check_rank_invariance(program) if f.severity == "error"]
+
+    # control: laundering over BOTH axes is rank-uniform again
+    def body_full(x):
+        r = jax.lax.axis_index("inter")
+        uniform = jax.lax.psum(jax.lax.psum(r, "intra"), "inter")
+
+        def exchange(v):
+            return jax.lax.psum(v, "intra")
+
+        def skip(v):
+            return v * 4.0
+
+        return jax.lax.cond(uniform > 0, exchange, skip, x)
+
+    fn = group.shard_map(
+        body_full, in_specs=(P("intra"),), out_specs=P("intra")
+    )
+    program, _ = collect_ir(fn, (x,), dict(group.mesh.shape))
+    assert not [d for d in program.collectives if d.rank_conditional]
+
+
+def test_while_cond_collective_recorded_and_flagged(group):
+    """Collectives in a while loop's *predicate* jaxpr must enter the IR
+    (wire census) and, under a rank-tainted predicate, the rank-invariance
+    check — they used to be invisible to all four checkers."""
+
+    def body(x):
+        def cond_fn(c):
+            i, v = c
+            # a psum'd convergence residual in the loop predicate
+            return jax.lax.psum(jnp.sum(v), "intra") > i
+
+        def body_fn(c):
+            i, v = c
+            return i + 1, v * 0.5
+
+        _, out = jax.lax.while_loop(cond_fn, body_fn, (jnp.float32(0.0), x))
+        return out
+
+    fn = group.shard_map(body, in_specs=(P("intra"),), out_specs=P("intra"))
+    x = jnp.ones((8, 4), jnp.float32)
+    program, _ = collect_ir(fn, (x,), dict(group.mesh.shape))
+    in_while = [d for d in program.collectives if "while" in d.path]
+    assert in_while, "predicate psum missing from the IR"
+    # uniform predicate (psum'd residual): legal, not rank-conditional
+    assert not [d for d in program.collectives if d.rank_conditional]
+
+    def body_tainted(x):
+        def cond_fn(c):
+            i, v = c
+            return i < jax.lax.axis_index("intra")  # rank-varying trip count
+
+        def body_fn(c):
+            i, v = c
+            return i + 1, jax.lax.psum(v, "intra")
+
+        _, out = jax.lax.while_loop(cond_fn, body_fn, (jnp.int32(0), x))
+        return out
+
+    fn = group.shard_map(
+        body_tainted, in_specs=(P("intra"),), out_specs=P("intra")
+    )
+    program, _ = collect_ir(fn, (x,), dict(group.mesh.shape))
+    flagged = [d for d in program.collectives if d.rank_conditional]
+    assert flagged, "collective under a rank-varying trip count not flagged"
+    assert [f for f in check_rank_invariance(program) if f.severity == "error"]
+
+
 def test_psum_laundering_clears_taint(group):
     """A predicate *derived from* axis_index but passed through psum is
     rank-uniform again (every rank holds the identical sum) — branching on
@@ -202,6 +297,66 @@ def test_bucket_bytes_off_by_one_rejected(group):
         ddp.shutdown()
 
 
+def test_cond_sibling_branches_not_double_counted(group):
+    """The walker records every branch of a cond but only one executes:
+    the wire census must charge sibling branches of the same cond the max,
+    not the sum (a scope duplicated across both branches used to produce a
+    false wire_exactness error)."""
+    from types import SimpleNamespace as NS
+
+    from bagua_tpu.analysis.collective_ir import (
+        CollectiveDescriptor, CollectiveProgram,
+    )
+
+    def desc(i, path, wire):
+        return CollectiveDescriptor(
+            index=i, primitive="psum", reduce_op="sum", axes=("intra",),
+            ring_size=4, shapes=((8,),), dtypes=("float32",), nbytes=32,
+            wire_bytes=wire, label=f"d{i}",
+            scope={"algo": "toy", "bucket": 0, "phase": "mono"},
+            mp=None, qr=None, path=path, rank_conditional=False,
+            cond_label=None,
+        )
+
+    program = CollectiveProgram(
+        collectives=[
+            desc(0, (), 50),                 # outside any cond: always runs
+            desc(1, ("cond#0@0",), 100),     # branch 0
+            desc(2, ("cond#0@1",), 100),     # sibling branch: exclusive
+            desc(3, ("cond#1@0",), 7),       # a second, independent cond
+        ],
+        axis_sizes={"intra": 4},
+    )
+    cfg = WireModelConfig(algo="other", plan=NS(specs=()), n=4)
+    findings, table = check_wire_exactness(program, cfg)
+    assert not [f for f in findings if f.severity == "error"]
+    (row,) = table
+    assert row["observed_bytes"] == 50 + 100 + 7, row
+
+    # and a real trace assigns sibling branches of one cond distinct ids
+    def body(x, step):
+        def a(v):
+            return jax.lax.psum(v, "intra")
+
+        def b(v):
+            return jax.lax.psum(v * 2.0, "intra")
+
+        return jax.lax.cond(step % 2 == 0, a, b, x)
+
+    fn = group.shard_map(
+        body, in_specs=(P("intra"), P()), out_specs=P("intra")
+    )
+    traced, _ = collect_ir(
+        fn, (jnp.ones((8, 4), jnp.float32), jnp.zeros((), jnp.int32)),
+        dict(group.mesh.shape),
+    )
+    frames = [d.path[-1] for d in traced.collectives if d.path]
+    cids = {f.partition("@")[0] for f in frames}
+    branches = {f.partition("@")[2] for f in frames}
+    assert len(cids) == 1, frames
+    assert branches == {"0", "1"}, frames
+
+
 # ---------------------------------------------------------------------------
 # Adversarial program 3: stale exported plan version
 # ---------------------------------------------------------------------------
@@ -265,6 +420,13 @@ def test_strict_gate_blocks_dispatch(group, monkeypatch):
             ddp.train_step(state, make_batch())
         assert tel.flight.records() == [], "collectives dispatched anyway"
         assert ddp._flight_programs == {}
+        # the rejected step must not linger in any cache: a caller that
+        # catches the error and retries re-verifies instead of dispatching
+        assert ddp._step_fns == {}, "rejected step left in the jit cache"
+        assert ddp._predicted_programs == {}
+        with pytest.raises(StaticVerifyError, match="wire_exactness"):
+            ddp.train_step(state, make_batch())
+        assert tel.flight.records() == []
     finally:
         ddp.shutdown()
 
@@ -355,6 +517,87 @@ def test_rebucket_reverifies_and_rolls_back(group, monkeypatch):
         with pytest.raises(StaticVerifyError):
             ddp.rebucket(old_plan)
         assert ddp.plan is adopted, "rejected plan was not rolled back"
+    finally:
+        ddp.shutdown()
+
+
+def test_gate_verifies_post_reshard_layout(group, monkeypatch):
+    """With a sharded updater, the first cache-miss step after rebucket()
+    carries a *pending host-side reshard*: the live state still has the old
+    shard layout while the new program expects the new one.  The gate must
+    trace over the post-reshard template — feeding the old-layout state
+    into make_jaxpr verifies a program other than the one that dispatches
+    (and crashes outright when the shapes disagree)."""
+    from bagua_tpu import analysis
+
+    monkeypatch.setenv("BAGUA_STATIC_VERIFY", "strict")
+    verified_states = []
+    orig = analysis.verify_step_program
+
+    def spy(ddp_, state_, batch_, **kw):
+        verified_states.append(state_)
+        return orig(ddp_, state_, batch_, **kw)
+
+    monkeypatch.setattr(analysis, "verify_step_program", spy)
+    ddp = make_ddp(group, build_algorithm("zero", lr=0.1))
+    try:
+        state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+        assert ddp._sharded_updater is not None
+        state, _ = ddp.train_step(state, make_batch())
+        plan2 = ddp.impl.tensors_to_buckets(
+            ddp._tree_template, 1 << 14, filter_fn=None
+        )
+        ddp.rebucket(plan2)
+        assert ddp._pending_reshard is not None
+        # cache-miss step under the pending reshard: gate + dispatch OK,
+        # and the gate traced the CURRENT layout's template, not the
+        # stale live state
+        state, losses = ddp.train_step(state, make_batch())
+        jax.block_until_ready(losses)
+        shapes = lambda t: jax.tree.map(lambda l: tuple(l.shape), t)
+        assert shapes(verified_states[-1]) == shapes(ddp.state_template())
+        # the gate handed the verifier the abstract CURRENT-layout template,
+        # not the stale live state (whose shard layout predates the plan —
+        # shapes can coincide between layouts, identity cannot)
+        assert all(
+            isinstance(l, jax.ShapeDtypeStruct)
+            for l in jax.tree_util.tree_leaves(verified_states[-1])
+        ), "gate traced the stale pre-reshard state"
+    finally:
+        ddp.shutdown()
+
+
+def test_warn_gate_survives_trace_failure(group, monkeypatch, caplog):
+    """A raw exception out of the verifier's trace (not a checker Finding)
+    must not crash train_step in warn mode — logged, gate skipped, step
+    dispatched.  Strict still propagates it."""
+    import logging
+
+    from bagua_tpu import analysis
+
+    def boom(*a, **kw):
+        raise TypeError("synthetic trace failure")
+
+    monkeypatch.setattr(analysis, "verify_step_program", boom)
+
+    monkeypatch.setenv("BAGUA_STATIC_VERIFY", "warn")
+    ddp = make_ddp(group)
+    try:
+        state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+        with caplog.at_level(logging.WARNING, logger="bagua_tpu.ddp"):
+            state, losses = ddp.train_step(state, make_batch())
+        jax.block_until_ready(losses)
+        assert any("trace failed" in r.message for r in caplog.records)
+    finally:
+        ddp.shutdown()
+
+    monkeypatch.setenv("BAGUA_STATIC_VERIFY", "strict")
+    ddp = make_ddp(group)
+    try:
+        state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+        with pytest.raises(TypeError, match="synthetic trace failure"):
+            ddp.train_step(state, make_batch())
+        assert ddp._step_fns == {}
     finally:
         ddp.shutdown()
 
