@@ -1,0 +1,99 @@
+"""The single ICI/DCN topology model shared by the perf lab and
+``ci/scaling_projection.py``.
+
+Every constant that used to live as a module global in the projection
+script is an explicit field of :class:`TopologyAssumptions` so the
+multi-pod ICI-vs-DCN split is a *stated model parameter*, refutable
+measurement-by-measurement, not two diverging copies of a cost model.
+
+The collective shapes are the planner's ring models (and the CollectiveIR's
+``primitive_wire_bytes``): an all-reduce moves ``2N(n-1)/n`` per chip, a
+reduce-scatter/all-to-all ``N(n-1)/n``, an all-gather ``N(n-1)``, and a
+neighbor permute ``N`` over one hop.  Within one pod only ICI enters;
+beyond ``pod_size`` chips the DP exchange additionally crosses DCN once per
+step, shared by each host's chips, with no overlap credit (a worst-case
+bound, not a prediction of the tuned multi-pod schedule).
+"""
+
+import dataclasses
+import math
+
+__all__ = [
+    "DEFAULT_TOPOLOGY",
+    "TopologyAssumptions",
+    "t_collective",
+    "torus_dims",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyAssumptions:
+    """Explicit, falsifiable fleet-topology parameters (v5e-flavored)."""
+
+    #: usable ICI injection bandwidth per chip, B/s (2D torus, 4×45 GB/s
+    #: links at a conservative 50% efficiency — PERF_AUDIT.md's roofline)
+    ici_bw_chip: float = 90e9
+    #: per-hop ICI latency, seconds
+    ici_lat_hop: float = 1e-6
+    #: fraction of the step a collective can hide behind (the backward)
+    overlap_window_frac: float = 2 / 3
+    #: chips in one pod; beyond this the DCN leg enters
+    pod_size: int = 256
+    #: per-host DCN bandwidth, B/s (conservative)
+    dcn_bw_host: float = 25e9
+    #: chips sharing one host's DCN links
+    chips_per_host: int = 8
+    #: async averager: steps per sync interval (amortization)
+    steps_per_interval: int = 20
+
+    def dcn_bw_chip(self) -> float:
+        """Per-chip share of the host's DCN bandwidth."""
+        return self.dcn_bw_host / self.chips_per_host
+
+    def describe(self) -> dict:
+        return {
+            "ici_bw_chip_GBps": self.ici_bw_chip / 1e9,
+            "ici_lat_per_hop_us": self.ici_lat_hop * 1e6,
+            "overlap_window_frac_of_step": self.overlap_window_frac,
+            "pod_size": self.pod_size,
+            "dcn_GBps_per_host": self.dcn_bw_host / 1e9,
+            "chips_per_host": self.chips_per_host,
+            "async_steps_per_interval": self.steps_per_interval,
+            "collective_model": (
+                "ring/torus: allreduce 2(n-1)/n, gather/a2a (n-1)/n, "
+                "permute 1 hop; multi-pod adds wire_bytes / dcn_bw_chip "
+                "with no overlap credit"
+            ),
+        }
+
+
+DEFAULT_TOPOLOGY = TopologyAssumptions()
+
+
+def torus_dims(n: int):
+    """Closest-to-square 2D factorization (v5e topology shapes)."""
+    a = max(1, int(math.sqrt(n)))
+    while n % a:
+        a -= 1
+    return a, n // a
+
+
+def t_collective(
+    kind: str,
+    bytes_per_chip: float,
+    n: int,
+    topo: TopologyAssumptions = DEFAULT_TOPOLOGY,
+) -> float:
+    """Per-chip time of one collective over ``n`` chips on the ICI torus."""
+    if n <= 1:
+        return 0.0
+    dx, dy = torus_dims(n)
+    diameter = dx / 2 + dy / 2  # torus wrap-around halves each dim
+    lat = diameter * topo.ici_lat_hop
+    if kind == "allreduce":
+        return 2 * (n - 1) / n * bytes_per_chip / topo.ici_bw_chip + 2 * lat
+    if kind in ("allgather", "alltoall", "reducescatter"):
+        return (n - 1) / n * bytes_per_chip / topo.ici_bw_chip + lat
+    if kind == "permute":  # neighbor exchange: one hop, n-independent
+        return bytes_per_chip / topo.ici_bw_chip + topo.ici_lat_hop
+    raise ValueError(kind)

@@ -12,6 +12,11 @@ on a pod slice it produces the scaling curve.
 Emission protocol shared with bench.py (`_bench_common`).  CPU smoke:
 ``BENCH_FORCE_CPU=1 BENCH_BATCH_PER_CHIP=4 BENCH_IMAGE_SIZE=64
 XLA_FLAGS=--xla_force_host_platform_device_count=8 python bench_scaling.py``.
+
+Dead-tunnel salvage: on the ``accepted-then-dropped`` relay signature the
+harness emits this metric's modeled 1→8 efficiency from the committed
+BENCH_MODELED.json (``"mode": "modeled"`` rows, provenance tagged) before
+the CPU-sim fallback; the structured error record still lands last.
 """
 
 import os

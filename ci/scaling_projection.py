@@ -17,14 +17,16 @@ Reference context: the reference proves scaling with figures only
 (`/root/reference/README.md:39-53`, 128 GPUs); its machine-checked CI floors
 are fixed-size 2x4 (`.buildkite/scripts/benchmark_master.sh:81-106`).
 
-Cost model (stated so it can be refuted measurement-by-measurement):
+Cost model (stated so it can be refuted measurement-by-measurement; every
+constant is a field of ``bagua_tpu.perflab.topology.TopologyAssumptions``,
+the single topology model shared with BENCH_MODELED.json):
 
 * v5e 2D torus, 4 ICI links/chip at 45 GB/s usable per direction; a
-  conservative 50% efficiency discount gives BW_CHIP = 90 GB/s of usable
-  injection bandwidth per chip (same assumption as PERF_AUDIT.md's
-  roofline).  Per-hop latency LAT = 1 us; a collective pays the torus
-  diameter in hops once (latency term, irrelevant at VGG16/BERT sizes but
-  stated for falsifiability).
+  conservative 50% efficiency discount gives ``ici_bw_chip`` = 90 GB/s of
+  usable injection bandwidth per chip (same assumption as PERF_AUDIT.md's
+  roofline).  Per-hop latency ``ici_lat_hop`` = 1 us; a collective pays the
+  torus diameter in hops once (latency term, irrelevant at VGG16/BERT sizes
+  but stated for falsifiability).
 * ring/torus all-reduce moves 2*(n-1)/n * bytes per chip; all-gather and
   all-to-all move (n-1)/n * bytes; a neighbor collective-permute moves
   bytes once over one hop.  XLA's per-dimension torus decomposition changes
@@ -38,8 +40,8 @@ Cost model (stated so it can be refuted measurement-by-measurement):
 * Efficiency(n) = t(8) / t(n)  (8 chips = the smallest pod-slice baseline,
   matching BASELINE.json's 8->256 framing).  n stays within one 256-chip
   v5e pod — no DCN term enters; the 512-chip sanity extension adds a
-  per-chip DCN bottleneck term  wire_bytes / (DCN_GBPS_PER_HOST /
-  CHIPS_PER_HOST)  — each host's DCN bandwidth is shared by its 8 chips'
+  per-chip DCN bottleneck term  wire_bytes / (dcn_bw_host /
+  chips_per_host)  — each host's DCN bandwidth is shared by its 8 chips'
   exchange bytes, with no overlap credit (a worst-case bound).
 
 Wire bytes per algorithm (per step, per chip, from the census patterns —
@@ -63,18 +65,25 @@ Writes SCALING_PROJECTION.json and SCALING_PROJECTION.md at the repo root.
 """
 
 import json
-import math
 import os
+import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-BW_CHIP = 90e9        # usable ICI injection bandwidth per chip, B/s
-LAT_HOP = 1e-6        # per-hop ICI latency, s
-OVERLAP_FRAC = 2 / 3  # fraction of the step a collective can hide behind
-POD_SIZE = 256        # one v5e pod; beyond this DCN enters
-DCN_GBPS_PER_HOST = 25e9  # conservative per-host DCN bandwidth, B/s
-STEPS_PER_INTERVAL = 20   # async averager: steps per sync interval (amortization)
-CHIPS_PER_HOST = 8
+from bagua_tpu.perflab.topology import (  # noqa: E402
+    DEFAULT_TOPOLOGY,
+    t_collective,
+    torus_dims,  # noqa: F401  (re-exported: pre-unification public name)
+)
+
+# The single ICI/DCN topology model, shared with the perf lab
+# (bagua_tpu/perflab/topology.py) — one set of assumptions, not two
+# diverging copies.  Aliases keep this script's formulas readable.
+TOPO = DEFAULT_TOPOLOGY
+OVERLAP_FRAC = TOPO.overlap_window_frac
+POD_SIZE = TOPO.pod_size
+STEPS_PER_INTERVAL = TOPO.steps_per_interval
 
 # Measured single-chip step times (committed artifacts; see BENCH_TPU.json /
 # BENCH_BERT_TPU.json for provenance).  batch is per chip.
@@ -113,30 +122,6 @@ MEASURED = {
         "rate_per_chip": {"gradient_allreduce": None},
     },
 }
-
-
-def torus_dims(n):
-    """Closest-to-square 2D factorization (v5e topology shapes)."""
-    a = int(math.sqrt(n))
-    while n % a:
-        a -= 1
-    return a, n // a
-
-
-def t_collective(kind, bytes_per_chip, n):
-    """Per-chip time of one collective over n chips on the ICI torus."""
-    dx, dy = torus_dims(n)
-    diameter = dx / 2 + dy / 2  # torus wrap-around halves each dim
-    lat = diameter * LAT_HOP
-    if n == 1:
-        return 0.0
-    if kind == "allreduce":
-        return 2 * (n - 1) / n * bytes_per_chip / BW_CHIP + 2 * lat
-    if kind in ("allgather", "alltoall", "reducescatter"):
-        return (n - 1) / n * bytes_per_chip / BW_CHIP + lat
-    if kind == "permute":  # neighbor exchange: one hop, n-independent
-        return bytes_per_chip / BW_CHIP + LAT_HOP
-    raise ValueError(kind)
 
 
 # Collective ISSUE COUNTS per step, from the compiled-HLO census
@@ -204,10 +189,10 @@ def project(model, spec):
                 # is amortized over its interval exactly as on ICI
                 wire = spec["params"] * (1 if algorithm in (
                     "bytegrad", "qadam", "low_precision_decentralized") else 2)
-                t_dcn = wire / (DCN_GBPS_PER_HOST / CHIPS_PER_HOST)
+                t_dcn = wire / TOPO.dcn_bw_chip()
                 if algorithm == "async":
-                    t_dcn = spec["params"] * 4 / (
-                        DCN_GBPS_PER_HOST / CHIPS_PER_HOST) / STEPS_PER_INTERVAL
+                    t_dcn = (spec["params"] * 4 / TOPO.dcn_bw_chip()
+                             / STEPS_PER_INTERVAL)
                 t_comm += t_dcn
             t_n = t_compute + max(0.0, t_comm - window)
             t_n_no_overlap = t_compute + t_comm
@@ -244,18 +229,14 @@ def main():
         all_rows.extend(project(model, spec))
     out = {
         "assumptions": {
-            "bw_chip_GBps": BW_CHIP / 1e9,
-            "lat_per_hop_us": LAT_HOP * 1e6,
-            "overlap_window_frac_of_step": OVERLAP_FRAC,
-            "pod_size": POD_SIZE,
-            "dcn_GBps_per_host": DCN_GBPS_PER_HOST / 1e9,
+            **TOPO.describe(),
             "regime": "weak scaling, fixed per-chip batch",
-            "collective_model": "ring/torus: allreduce 2(n-1)/n, "
-            "gather/a2a (n-1)/n, permute 1 hop",
         },
         "provenance": {
             "census": "PERF_AUDIT.json (compiled-HLO wire patterns)",
             "measured": ["BENCH_TPU.json", "BENCH_BERT_TPU.json"],
+            "topology_model": "bagua_tpu/perflab/topology.py "
+            "(shared with BENCH_MODELED.json)",
         },
         "rows": all_rows,
     }

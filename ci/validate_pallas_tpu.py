@@ -538,6 +538,20 @@ def main():
         "interpret": args.interpret,
         "kernels": report,
         "all_ok": all(e["ok"] for e in report),
+        "notes": {
+            "collective_matmul": (
+                "awaiting chip evidence: interpret-mode timings (pallas_ms vs"
+                " jnp_ms) measure the CPU emulator, not the Mosaic ring —"
+                " dispatch stays jnp until a backend=tpu non-interpret run"
+                " lands here"
+            ),
+            "perflab_basis": (
+                "bagua_tpu.perflab marks cells whose wire program rides"
+                " Pallas-gated kernels as basis=modeled-jnp-fallback until"
+                " this artifact carries backend=tpu, interpret=false evidence"
+                " for every gated kernel (see docs/perflab.md)"
+            ),
+        },
     }
     # Artifact first, stdout second: a closed pipe or session cap must not
     # cost the measurement.

@@ -43,6 +43,8 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert "hang forensics lane passed" in proc.stderr
     assert "static verify lane passed" in proc.stderr
     assert "retrace-hazard lint passed" in proc.stderr
+    assert "bench modeled lane passed" in proc.stderr
+    assert "fleet sim lane passed" in proc.stderr
 
     # The telemetry smoke emits a JSONL metrics stream next to --out; hold it
     # to the event schema here too (belt and braces: the subprocess already
@@ -159,6 +161,24 @@ def test_perf_audit_quick_overlap_census(tmp_path):
         assert row["num_collectives"] > 0
         assert row["bucket_phases"] > 0
     assert audit["retrace_lint"]["ok"] is True
+
+    # The perf-lab gates: the modeled step-time regression check held the
+    # committed BENCH_MODELED.json (exact census bytes, step-ms tolerance),
+    # and the fleet simulator drove the real aggregator/breaker paths against
+    # a live loopback rendezvous with both injected faults surfaced.
+    bm = audit["bench_modeled"]
+    assert bm["ok"] is True and bm["checked_cells"] >= 10
+    assert bm["artifact_summary"]["fail"] == 0
+    fleet = audit["fleet_sim"]
+    assert fleet["ok"] is True and fleet["deterministic"] is True
+    assert fleet["n_gangs"] >= 4
+    assert fleet["straggler_detections"]
+    assert all(
+        d["rank"] == 2 and d["phase"] == "wire"
+        for d in fleet["straggler_detections"]
+    )
+    assert fleet["flap_breaker"]["times_opened"] >= 1
+    assert fleet["flap_breaker"]["final_state"] == "closed"
 
 
 def test_perf_audit_quick_bytegrad_compressed_census(tmp_path):

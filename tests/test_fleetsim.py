@@ -1,0 +1,107 @@
+"""Fleet-simulator gates: determinism under a fixed seed, exact straggler
+attribution, KV-flap absorption by the breaker, preemption staleness, and
+the no-exceptions-into-the-step-loop contract — all against a live loopback
+rendezvous service driving the real GangAggregator/flight-digest paths."""
+
+import pytest
+
+from bagua_tpu.perflab.fleetsim import (
+    BandwidthCollapse,
+    FleetConfig,
+    FlakyClient,
+    KVFlap,
+    Preemption,
+    Straggler,
+    run_fleet,
+)
+
+
+def _cfg(**kw):
+    base = dict(n_gangs=2, ranks_per_gang=4, windows=3, seed=11)
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def test_fleet_deterministic_under_fixed_seed():
+    cfg = _cfg(faults=(Straggler(gang=0, rank=1, factor=3.0),))
+    a = run_fleet(cfg)
+    b = run_fleet(cfg)  # fresh server, different real port — same report
+    assert a == b
+    # and a different seed genuinely changes the modeled clocks
+    c = run_fleet(_cfg(seed=12, faults=(Straggler(gang=0, rank=1, factor=3.0),)))
+    assert c != a
+
+
+def test_straggler_attributed_to_exact_injected_rank():
+    cfg = _cfg(faults=(Straggler(gang=1, rank=3, factor=3.0, phase="wire"),))
+    report = run_fleet(cfg)
+    clean, faulty = report["gangs"][0], report["gangs"][1]
+    assert clean["straggler_detections"] == []
+    dets = faulty["straggler_detections"]
+    assert len(dets) == cfg.windows  # flagged in every window
+    for d in dets:
+        assert d["rank"] == 3
+        assert d["phase"] == "wire"
+        assert d["score"] >= cfg.straggler_factor
+    assert clean["healthy"] and faulty["healthy"]
+
+
+def test_compute_straggler_attributed_to_compute_phase():
+    cfg = _cfg(faults=(Straggler(gang=0, rank=2, factor=3.0, phase="compute"),))
+    dets = run_fleet(cfg)["gangs"][0]["straggler_detections"]
+    assert dets and all(
+        d["rank"] == 2 and d["phase"] == "compute" for d in dets
+    )
+
+
+def test_kv_flap_absorbed_by_breaker_no_training_error():
+    cfg = _cfg(faults=(KVFlap(gang=0, start_window=2, end_window=3),))
+    report = run_fleet(cfg)
+    flapped = report["gangs"][0]
+    # the flap reached the transport...
+    assert flapped["kv_injected_failures"] > 0
+    # ...opened the breaker, which re-closed on the first post-flap probe...
+    assert flapped["breaker"]["times_opened"] >= 1
+    assert flapped["breaker"]["final_state"] == "closed"
+    # ...degraded exactly the flapped window to a local-only view...
+    assert flapped["degraded_windows"] == [2]
+    # ...and not one exception reached the simulated step loop
+    assert flapped["errors"] == []
+    assert flapped["healthy"]
+    # the untouched gang saw nothing
+    assert report["gangs"][1]["degraded_windows"] == []
+    assert report["gangs"][1]["breaker"]["times_opened"] == 0
+
+
+def test_preempted_rank_surfaces_as_stale():
+    cfg = _cfg(faults=(Preemption(gang=0, rank=1, window=2),))
+    report = run_fleet(cfg)
+    windows = report["gangs"][0]["windows"]
+    assert windows[0]["stale_ranks"] == []  # pushed normally in window 1
+    for w in windows[1:]:  # ghost summary from window 1 must read stale
+        assert 1 in w["stale_ranks"], w
+
+
+def test_bandwidth_collapse_slows_gang_without_straggler_flag():
+    """A whole-gang brownout inflates every rank together: the gang median
+    moves, the skew doesn't — no false straggler attribution."""
+    cfg = _cfg(faults=(BandwidthCollapse(gang=0, factor=4.0),))
+    report = run_fleet(cfg)
+    collapsed, clean = report["gangs"][0], report["gangs"][1]
+    assert collapsed["straggler_detections"] == []
+    for w_slow, w_ok in zip(collapsed["windows"], clean["windows"]):
+        assert w_slow["p50_skew"] < cfg.straggler_factor
+        assert w_ok["p50_skew"] < cfg.straggler_factor
+    assert collapsed["healthy"]
+
+
+def test_flaky_client_contains_injection():
+    class Dead:  # the wrapped client is never reached while failing
+        def kv_set(self, k, v):
+            raise AssertionError("inner client reached during flap")
+
+    fc = FlakyClient(Dead())
+    fc.failing = True
+    with pytest.raises(ConnectionError):
+        fc.kv_set("k", "v")
+    assert fc.injected_failures == 1
