@@ -233,6 +233,50 @@ class RendezvousState:
         with self._lock:
             return self._kv.get(key)
 
+    def kv_keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._kv)
+
+    # -- durable export / restore (the fleet WAL's membership record) --------
+
+    def export_membership(self) -> dict:
+        """JSON-able dump of the durable membership machine: members,
+        generation/epoch, the published assignment, crash arbitration.
+        Deliberately excludes heartbeat ages (volatile wall-clock) and the
+        KV/blob tiers (journaled per-op by the fleet WAL)."""
+        with self._lock:
+            return {
+                "generation": self.generation,
+                "epoch": self.epoch,
+                "members": [
+                    [m.node_rank, m.nslots, m.incarnation, m.addr]
+                    for m in sorted(self._members.values(), key=lambda m: m.node_rank)
+                ],
+                "settled": None if self._settled is None else dict(self._settled),
+                "crash_epoch": self._crash_epoch,
+                "crash_origin": self._crash_origin,
+            }
+
+    def restore_membership(self, snap: dict) -> None:
+        """Inverse of :meth:`export_membership` after a server restart.
+        Every member's ``last_seen`` restarts at *now* (the pre-crash ages
+        are meaningless on a new monotonic clock, and insta-reaping a live
+        gang that rode out the outage on retries would turn one server
+        crash into a fleet-wide re-form); an unsettled state re-opens a
+        fresh settle window."""
+        with self._lock:
+            self._members = {
+                int(nr): _Member(int(nr), int(ns), int(inc), addr)
+                for nr, ns, inc, addr in snap.get("members", [])
+            }
+            self.generation = int(snap.get("generation", 0))
+            self.epoch = int(snap.get("epoch", 0))
+            settled = snap.get("settled")
+            self._settled = dict(settled) if settled is not None else None
+            self._dirty_since = None if self._settled is not None else time.monotonic()
+            self._crash_epoch = int(snap.get("crash_epoch", -1))
+            self._crash_origin = int(snap.get("crash_origin", -1))
+
     # -- blob tier (binary values; LRU-bounded) ------------------------------
 
     def blob_set(self, key: str, data: bytes) -> None:
@@ -320,6 +364,14 @@ class RendezvousState:
 
 
 class _Handler(BaseHTTPRequestHandler):
+    """The ``/rdzv/*`` route table.
+
+    Routing is factored as ``_handle_*(state, path, ...)`` methods taking
+    the target :class:`RendezvousState` and the *rdzv-relative* path
+    explicitly, so a multi-tenant front-end (``bagua_tpu.fleet.server``)
+    can reuse the whole table per gang namespace — the ``do_*`` entry
+    points here just bind them to the single configured state."""
+
     state: RendezvousState  # set on the subclass by start_rendezvous_server
     # HTTP/1.1 so keep-alive works (every reply carries Content-Length);
     # RendezvousStore relies on persistent connections — under the 1.0
@@ -330,12 +382,12 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):  # silence default stderr access log
         pass
 
-    def _blob_authorized(self) -> bool:
+    def _blob_authorized(self, state: RendezvousState) -> bool:
         """Blob routes carry arbitrary pickles — when the state has a
         ``blob_token``, require the matching header.  pickle.loads on the
         reader side means an attacker who can PUT blobs can execute code on
         every worker; membership routes carry no payloads and stay open."""
-        token = getattr(self.state, "blob_token", None)
+        token = getattr(state, "blob_token", None)
         if not token:
             return True
         if self.headers.get("X-Bagua-Store-Token") == token:
@@ -343,11 +395,14 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply({"error": "missing or bad X-Bagua-Store-Token"}, 403)
         return False
 
-    def _reply(self, payload: dict, code: int = 200):
+    def _reply(self, payload: dict, code: int = 200, headers: Optional[dict] = None):
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if headers:
+            for k, v in headers.items():
+                self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
@@ -355,28 +410,29 @@ class _Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", "0"))
         return json.loads(self.rfile.read(n) or b"{}")
 
-    def _blob_key(self) -> str:
+    @staticmethod
+    def _blob_key(path: str) -> str:
         from urllib.parse import unquote
 
-        return unquote(self.path[len("/rdzv/blob/"):])
+        return unquote(path[len("/rdzv/blob/"):])
 
-    def do_GET(self):
-        if self.path.startswith("/rdzv/assignment"):
-            self._reply(self.state.assignment())
-        elif self.path.startswith("/rdzv/kv/"):
+    def _handle_get(self, state: RendezvousState, path: str):
+        if path.startswith("/rdzv/assignment"):
+            self._reply(state.assignment())
+        elif path.startswith("/rdzv/kv/"):
             from urllib.parse import unquote
 
-            key = unquote(self.path[len("/rdzv/kv/"):])
-            value = self.state.kv_get(key)
+            key = unquote(path[len("/rdzv/kv/"):])
+            value = state.kv_get(key)
             self._reply({"key": key, "value": value, "found": value is not None})
-        elif self.path == "/rdzv/blobs":
-            if not self._blob_authorized():
+        elif path == "/rdzv/blobs":
+            if not self._blob_authorized(state):
                 return
-            self._reply({"count": self.state.blob_count()})
-        elif self.path.startswith("/rdzv/blob/"):
-            if not self._blob_authorized():
+            self._reply({"count": state.blob_count()})
+        elif path.startswith("/rdzv/blob/"):
+            if not self._blob_authorized(state):
                 return
-            data = self.state.blob_get(self._blob_key())
+            data = state.blob_get(self._blob_key(path))
             if data is None:
                 self._reply({"error": "not found"}, 404)
             else:
@@ -388,67 +444,77 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply({"error": "not found"}, 404)
 
-    def do_PUT(self):
-        # Drain the body before any reply: under HTTP/1.1 keep-alive an
-        # unread request body desyncs the connection for the next request.
-        length = int(self.headers.get("Content-Length", 0))
-        body = self.rfile.read(length)
-        if self.path.startswith("/rdzv/blob/"):
-            if not self._blob_authorized():
+    def _handle_put(self, state: RendezvousState, path: str, body: bytes):
+        if path.startswith("/rdzv/blob/"):
+            if not self._blob_authorized(state):
                 return
-            self.state.blob_set(self._blob_key(), body)
+            state.blob_set(self._blob_key(path), body)
             self._reply({"ok": True})
         else:
             self._reply({"error": "not found"}, 404)
 
-    def do_DELETE(self):
-        if self.path == "/rdzv/blobs":
-            if not self._blob_authorized():
+    def _handle_delete(self, state: RendezvousState, path: str):
+        if path == "/rdzv/blobs":
+            if not self._blob_authorized(state):
                 return
-            self.state.blob_clear()
+            state.blob_clear()
             self._reply({"ok": True})
         else:
             self._reply({"error": "not found"}, 404)
 
-    def do_POST(self):
-        try:
-            payload = self._body()
-        except (ValueError, json.JSONDecodeError):
-            return self._reply({"error": "bad json"}, 400)
-        if self.path == "/rdzv/join":
+    def _handle_post(self, state: RendezvousState, path: str, payload: dict):
+        if path == "/rdzv/join":
             self._reply(
-                self.state.join(
+                state.join(
                     int(payload["node_rank"]),
                     int(payload["nslots"]),
                     int(payload.get("incarnation", 0)),
                     payload.get("addr"),
                 )
             )
-        elif self.path == "/rdzv/leave":
+        elif path == "/rdzv/leave":
             self._reply(
-                self.state.leave(
+                state.leave(
                     int(payload["node_rank"]), bool(payload.get("completed", False))
                 )
             )
-        elif self.path == "/rdzv/heartbeat":
-            self._reply(self.state.heartbeat(int(payload["node_rank"])))
-        elif self.path == "/rdzv/restart":
-            self._reply(self.state.request_restart(int(payload["observed_epoch"])))
-        elif self.path == "/rdzv/crash":
+        elif path == "/rdzv/heartbeat":
+            self._reply(state.heartbeat(int(payload["node_rank"])))
+        elif path == "/rdzv/restart":
+            self._reply(state.request_restart(int(payload["observed_epoch"])))
+        elif path == "/rdzv/crash":
             self._reply(
-                self.state.report_crash(
+                state.report_crash(
                     int(payload["node_rank"]), int(payload["observed_epoch"])
                 )
             )
-        elif self.path.startswith("/rdzv/kv/"):
+        elif path.startswith("/rdzv/kv/"):
             from urllib.parse import unquote
 
-            self.state.kv_set(
-                unquote(self.path[len("/rdzv/kv/"):]), payload.get("value")
-            )
+            state.kv_set(unquote(path[len("/rdzv/kv/"):]), payload.get("value"))
             self._reply({"ok": True})
         else:
             self._reply({"error": "not found"}, 404)
+
+    def do_GET(self):
+        self._handle_get(self.state, self.path)
+
+    def do_PUT(self):
+        # Drain the body before any reply: under HTTP/1.1 keep-alive an
+        # unread request body desyncs the connection for the next request.
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        self._handle_put(self.state, self.path, body)
+
+    def do_DELETE(self):
+        self._handle_delete(self.state, self.path)
+
+    def do_POST(self):
+        try:
+            payload = self._body()
+        except (ValueError, json.JSONDecodeError):
+            return self._reply({"error": "bad json"}, 400)
+        self._handle_post(self.state, self.path, payload)
 
 
 def start_rendezvous_server(
@@ -490,7 +556,10 @@ class RendezvousClient:
         self.last_heartbeat_ages: dict = {}
 
     def _call_once(self, path: str, payload: Optional[dict] = None) -> dict:
+        import urllib.error
         import urllib.request
+
+        from bagua_tpu.env import get_rpc_timeout_s
 
         url = self.endpoint + path
         if payload is None:
@@ -501,8 +570,20 @@ class RendezvousClient:
                 data=json.dumps(payload).encode(),
                 headers={"Content-Type": "application/json"},
             )
-        with urllib.request.urlopen(req, timeout=10.0) as resp:
-            return json.loads(resp.read())
+        try:
+            with urllib.request.urlopen(req, timeout=get_rpc_timeout_s()) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                # Fleet-plane admission control: convert to the typed
+                # backpressure signal so retry_call paces on the hint and
+                # the breaker never counts it as a failure.
+                from bagua_tpu.resilience.retry import BackpressureError, retry_after_hint
+
+                raise BackpressureError(
+                    f"{url}: 429 backpressure", retry_after_hint(e) or 0.0
+                ) from e
+            raise
 
     def _call(self, path: str, payload: Optional[dict] = None) -> dict:
         from bagua_tpu.resilience.retry import retry_call

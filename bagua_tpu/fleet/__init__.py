@@ -1,0 +1,45 @@
+"""Fleet control plane: one multi-tenant, crash-safe rendezvous + autotune
+service for N concurrent gangs.
+
+* :mod:`bagua_tpu.fleet.control_plane` — per-gang namespaces, leases +
+  admission control, the cross-gang plan cache, the scheduler view.
+* :mod:`bagua_tpu.fleet.wal` — the write-ahead log + snapshot compaction
+  behind crash-safe restarts.
+* :mod:`bagua_tpu.fleet.server` — the HTTP front-end
+  (``python -m bagua_tpu.fleet.server``).
+* :mod:`bagua_tpu.fleet.client` — :class:`FleetClient`, per-gang client
+  factories, and the step-0 cross-gang plan warm start.
+"""
+
+from bagua_tpu.fleet.control_plane import (
+    FleetControlPlane,
+    GangNamespace,
+    TokenBucket,
+    plan_cache_key,
+)
+from bagua_tpu.fleet.client import (
+    FleetClient,
+    adopt_fleet_plan,
+    engine_plan_key,
+    gang_endpoint,
+    model_fingerprint,
+    publish_engine_plan,
+)
+from bagua_tpu.fleet.server import FleetHandler, start_fleet_server
+from bagua_tpu.fleet.wal import WriteAheadLog
+
+__all__ = [
+    "FleetControlPlane",
+    "GangNamespace",
+    "TokenBucket",
+    "plan_cache_key",
+    "FleetClient",
+    "adopt_fleet_plan",
+    "engine_plan_key",
+    "gang_endpoint",
+    "model_fingerprint",
+    "publish_engine_plan",
+    "FleetHandler",
+    "start_fleet_server",
+    "WriteAheadLog",
+]

@@ -1,0 +1,260 @@
+"""Client-side view of the fleet control plane.
+
+:class:`FleetClient` talks to the fleet endpoints (plan cache, scheduler
+view, dump) and hands out per-gang clients: the existing
+:class:`~bagua_tpu.distributed.rendezvous.RendezvousClient` and
+:class:`~bagua_tpu.service.autotune_client.AutotuneClient` work unchanged
+against a gang namespace because both concatenate paths onto a base URL —
+the namespace is just the ``/g/<gang_id>`` prefix.
+
+The cross-gang warm start: a gang that finishes tuning publishes its
+proven plan (:func:`publish_engine_plan`); a brand-new gang with the same
+(model fingerprint, topology, algorithm, wire precision) adopts it at
+step 0 (:func:`adopt_fleet_plan` → ``plan_source="fleet"``) — the
+resilience manifest's warm start, generalized across jobs.
+"""
+
+import hashlib
+import json
+import logging
+from typing import Dict, Optional
+
+logger = logging.getLogger("bagua_tpu.fleet")
+
+__all__ = [
+    "gang_endpoint",
+    "model_fingerprint",
+    "engine_plan_key",
+    "FleetClient",
+    "publish_engine_plan",
+    "adopt_fleet_plan",
+]
+
+
+def gang_endpoint(base: str, gang_id: str) -> str:
+    """The namespaced endpoint a gang's rendezvous/autotune clients use."""
+    from urllib.parse import quote
+
+    if "://" not in base:
+        base = "http://" + base
+    return f"{base.rstrip('/')}/g/{quote(str(gang_id), safe='')}"
+
+
+def model_fingerprint(declarations) -> str:
+    """Stable fingerprint of a model's communicable-tensor set: sha256 over
+    the sorted (name, num_elements, dtype) triples, independent of bucket
+    assignment (the thing the cached plan *decides*)."""
+    triples = sorted(
+        (td.name, int(td.num_elements), str(td.dtype)) for td in declarations
+    )
+    digest = hashlib.sha256(json.dumps(triples).encode()).hexdigest()
+    return digest[:16]
+
+
+def engine_plan_key(ddp, wire_precision: Optional[str] = None) -> Dict[str, str]:
+    """The plan-cache key tuple for a live engine: model fingerprint from
+    its declaration list, topology from the gang size, algorithm from the
+    impl class, wire precision from the impl knob (or the caller)."""
+    decls = [td for bucket in ddp.plan.declarations() for td in bucket]
+    if wire_precision is None:
+        wire_precision = str(getattr(ddp.impl, "wire_precision", None) or "f32")
+    return {
+        "fingerprint": model_fingerprint(decls),
+        "topology": f"ranks{ddp.group.size}",
+        "algorithm": type(ddp.impl).__name__,
+        "wire_precision": wire_precision,
+    }
+
+
+class FleetClient:
+    """Stdlib-only client for the ``/fleet/*`` endpoints, hardened on the
+    same retry/breaker machinery as every other service client."""
+
+    def __init__(self, endpoint: str, timeout_s: Optional[float] = None):
+        from bagua_tpu.env import (
+            get_rpc_breaker_cooldown_s, get_rpc_breaker_threshold,
+            get_rpc_timeout_s,
+        )
+        from bagua_tpu.resilience.retry import CircuitBreaker, RetryPolicy
+
+        if "://" not in endpoint:
+            endpoint = "http://" + endpoint
+        self.endpoint = endpoint.rstrip("/")
+        self.timeout_s = get_rpc_timeout_s() if timeout_s is None else timeout_s
+        self.retry_policy = RetryPolicy()
+        self.breaker = CircuitBreaker(
+            failure_threshold=get_rpc_breaker_threshold(),
+            cooldown_s=get_rpc_breaker_cooldown_s(),
+            name="fleet-rpc",
+        )
+
+    # -- transport -------------------------------------------------------------
+
+    def _call_once(self, path: str, payload: Optional[dict] = None) -> dict:
+        import urllib.error
+        import urllib.request
+
+        url = self.endpoint + path
+        if payload is None:
+            req = urllib.request.Request(url)
+        else:
+            req = urllib.request.Request(
+                url,
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                from bagua_tpu.resilience.retry import (
+                    BackpressureError, retry_after_hint,
+                )
+
+                raise BackpressureError(
+                    f"{url}: 429 backpressure", retry_after_hint(e) or 0.0
+                ) from e
+            raise
+
+    def _call(self, path: str, payload: Optional[dict] = None) -> dict:
+        from bagua_tpu.resilience.retry import retry_call
+
+        return retry_call(
+            self._call_once, path, payload,
+            policy=self.retry_policy, breaker=self.breaker,
+        )
+
+    # -- per-gang clients -------------------------------------------------------
+
+    def gang_endpoint(self, gang_id: str) -> str:
+        return gang_endpoint(self.endpoint, gang_id)
+
+    def rendezvous_client(self, gang_id: str, node_rank: int, **kwargs):
+        from bagua_tpu.distributed.rendezvous import RendezvousClient
+
+        return RendezvousClient(
+            self.gang_endpoint(gang_id), node_rank=node_rank, **kwargs
+        )
+
+    def autotune_client(self, gang_id: str, **kwargs):
+        from urllib.parse import quote, urlparse
+
+        from bagua_tpu.service.autotune_client import AutotuneClient
+
+        parsed = urlparse(self.endpoint)
+        return AutotuneClient(
+            host=parsed.hostname,
+            port=parsed.port,
+            prefix=f"/g/{quote(str(gang_id), safe='')}",
+            **kwargs,
+        )
+
+    # -- plan cache --------------------------------------------------------------
+
+    def publish_plan(
+        self,
+        fingerprint: str,
+        topology: str,
+        algorithm: str,
+        wire_precision: str,
+        plan: dict,
+        meta: Optional[dict] = None,
+    ) -> str:
+        out = self._call(
+            "/fleet/plan/publish",
+            {
+                "fingerprint": fingerprint,
+                "topology": topology,
+                "algorithm": algorithm,
+                "wire_precision": wire_precision,
+                "plan": plan,
+                "meta": meta or {},
+            },
+        )
+        return out["key"]
+
+    def lookup_plan(
+        self, fingerprint: str, topology: str, algorithm: str, wire_precision: str
+    ) -> Optional[dict]:
+        out = self._call(
+            "/fleet/plan/lookup",
+            {
+                "fingerprint": fingerprint,
+                "topology": topology,
+                "algorithm": algorithm,
+                "wire_precision": wire_precision,
+            },
+        )
+        return out if out.get("found") else None
+
+    # -- fleet views --------------------------------------------------------------
+
+    def scheduler_view(self) -> dict:
+        return self._call("/fleet/scheduler")
+
+    def gangs(self) -> dict:
+        return self._call("/fleet/gangs")
+
+    def dump(self) -> dict:
+        return self._call("/fleet/dump")
+
+    def health(self) -> dict:
+        return self._call("/fleet/health")
+
+
+def publish_engine_plan(
+    fleet: FleetClient, ddp, meta: Optional[dict] = None,
+    wire_precision: Optional[str] = None,
+) -> Optional[str]:
+    """Publish a live engine's proven plan to the cross-gang cache
+    (best-effort; returns the cache key, or None when the engine has no
+    exportable plan or the fleet is unreachable)."""
+    payload = ddp.export_plan_payload()
+    if payload is None:
+        return None
+    key = engine_plan_key(ddp, wire_precision=wire_precision)
+    try:
+        return fleet.publish_plan(plan=payload, meta=meta, **key)
+    except (OSError, ConnectionError) as e:
+        logger.warning("fleet plan publish failed (advisory): %s", e)
+        return None
+
+
+def adopt_fleet_plan(
+    fleet: FleetClient, ddp, telemetry=None,
+    wire_precision: Optional[str] = None,
+) -> Optional[str]:
+    """Step-0 warm start from the cross-gang plan cache.
+
+    Looks up the engine's (fingerprint, topology, algorithm, wire
+    precision) tuple; on a hit, adopts the cached plan and returns
+    ``"fleet"`` — the ``plan_source`` value generalizing the resilience
+    manifest's ``"carried"``.  Returns None on a miss, an unreachable
+    fleet, or a payload that no longer fits (all advisory: the gang just
+    runs its fresh plan)."""
+    key = engine_plan_key(ddp, wire_precision=wire_precision)
+    try:
+        entry = fleet.lookup_plan(**key)
+    except (OSError, ConnectionError) as e:
+        logger.warning("fleet plan lookup failed (advisory): %s", e)
+        return None
+    if entry is None:
+        return None
+    try:
+        adopted = ddp.adopt_plan_payload(entry["plan"])
+    except Exception as e:
+        logger.warning("fleet plan %s did not fit this engine: %s", key, e)
+        return None
+    if not adopted:
+        return None
+    logger.info("adopted fleet plan for %s at step 0 (plan_source=fleet)", key)
+    if telemetry is not None:
+        telemetry.on_restart(
+            step=0,
+            old_world_size=ddp.group.size,
+            new_world_size=ddp.group.size,
+            plan_source="fleet",
+            lost_steps=0,
+        )
+    return "fleet"

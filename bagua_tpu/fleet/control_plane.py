@@ -1,0 +1,552 @@
+"""Multi-tenant fleet control plane: per-gang namespaces over one server.
+
+One :class:`FleetControlPlane` hosts N concurrent gangs.  Each gang gets a
+:class:`GangNamespace` — its own rendezvous membership machine + KV + blob
+tier (a journaled :class:`~bagua_tpu.distributed.rendezvous.RendezvousState`)
+and its own lazily-created
+:class:`~bagua_tpu.service.autotune_service.AutotuneService` (so every gang
+tunes against its own ``AutotuneTaskManager`` pool, never a neighbor's).
+Nothing is shared across gangs except what is *meant* to be shared: the
+cross-gang plan cache.
+
+Durability tiers (what the WAL covers):
+
+* **durable** — membership/assignment/epoch, KV, blobs, gang set, the plan
+  cache.  Every mutation is journaled before the request is acknowledged;
+  a killed-and-restarted server replays to the exact pre-crash state
+  (:meth:`FleetControlPlane.dump` is the bitwise witness).
+* **advisory** — autotune tuning state.  Gangs re-register on reconnect
+  (``register_tensors`` already handles restarted gangs), and the part
+  worth keeping across jobs — the *winning plan* — is exactly what the
+  durable plan cache distills.
+* **volatile** — heartbeat ages, lease clocks, token buckets.  Replay
+  restarts member ``last_seen`` and leases at *now*: a gang that rode out
+  the outage on its retry/breaker machinery must not be reaped for the
+  server's own crash.
+
+Lock order (deadlock-free by construction): a gang state's lock and the
+fleet lock are never held while waiting on each other; the WAL's lock is a
+leaf.  Compaction (which walks every gang) runs only from
+:meth:`maybe_compact`, called by the HTTP layer with no locks held.
+"""
+
+import base64
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from bagua_tpu.distributed.rendezvous import RendezvousState
+from bagua_tpu.fleet.wal import WriteAheadLog
+
+logger = logging.getLogger("bagua_tpu.fleet")
+
+__all__ = [
+    "plan_cache_key",
+    "TokenBucket",
+    "GangNamespace",
+    "FleetControlPlane",
+]
+
+#: the plan-cache key dimensions, in canonical order
+PLAN_KEY_FIELDS = ("fingerprint", "topology", "algorithm", "wire_precision")
+
+
+def plan_cache_key(
+    fingerprint: str, topology: str, algorithm: str, wire_precision: str
+) -> str:
+    """Canonical cache key: a plan proven on (model fingerprint, topology,
+    algorithm, wire precision) is only valid for an *identical* tuple —
+    bucket boundaries depend on the declaration list, and a plan tuned for
+    a 32-rank int8 ring says nothing about 8-rank f32."""
+    from urllib.parse import quote
+
+    return "/".join(
+        quote(str(v), safe="")
+        for v in (fingerprint, topology, algorithm, wire_precision)
+    )
+
+
+class TokenBucket:
+    """Per-gang admission control (thread-safe).  ``rate`` tokens/second
+    refill up to ``burst``; a denied request gets the seconds until one
+    token exists — the Retry-After hint.  ``rate <= 0`` admits everything."""
+
+    def __init__(self, rate: float, burst: float, clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self._clock = clock
+        self._tokens = self.burst
+        self._refilled = clock()
+        self._lock = threading.Lock()
+
+    def admit(self) -> "tuple[bool, float]":
+        """(admitted, retry_after_s)."""
+        if self.rate <= 0:
+            return True, 0.0
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst, self._tokens + (now - self._refilled) * self.rate)
+            self._refilled = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True, 0.0
+            return False, (1.0 - self._tokens) / self.rate
+
+
+class _JournaledState(RendezvousState):
+    """A gang's rendezvous state wired into the fleet WAL.
+
+    KV/blob writes journal an idempotent per-op record *inside* the state
+    lock (strict replay order).  Membership-mutating entry points re-export
+    the durable membership machine after the call, under a dedicated serial
+    lock: the export is re-read at append time, so the newest WAL record
+    always reflects the newest state even under concurrent joins — full
+    replaces, last-write-wins."""
+
+    def __init__(self, gang_id: str, journal: Callable[[dict], None], **kwargs):
+        super().__init__(**kwargs)
+        self.gang_id = gang_id
+        self._journal = journal
+        self._journal_serial = threading.Lock()
+        self._last_membership: Optional[dict] = None
+
+    # -- membership (journal-after, serialized re-export) ---------------------
+
+    def _journal_membership(self):
+        with self._journal_serial:
+            snap = self.export_membership()
+            if snap != self._last_membership:
+                self._last_membership = snap
+                self._journal({"op": "rdzv", "gang": self.gang_id, "state": snap})
+
+    def join(self, *args, **kwargs) -> dict:
+        out = super().join(*args, **kwargs)
+        self._journal_membership()
+        return out
+
+    def leave(self, *args, **kwargs) -> dict:
+        out = super().leave(*args, **kwargs)
+        self._journal_membership()
+        return out
+
+    def heartbeat(self, *args, **kwargs) -> dict:
+        # A heartbeat itself is volatile, but it can reap/settle.
+        out = super().heartbeat(*args, **kwargs)
+        self._journal_membership()
+        return out
+
+    def request_restart(self, *args, **kwargs) -> dict:
+        out = super().request_restart(*args, **kwargs)
+        self._journal_membership()
+        return out
+
+    def report_crash(self, *args, **kwargs) -> dict:
+        out = super().report_crash(*args, **kwargs)
+        self._journal_membership()
+        return out
+
+    def assignment(self) -> dict:
+        out = super().assignment()
+        self._journal_membership()  # assignment() may reap/settle
+        return out
+
+    # -- KV / blobs (journal-in-lock, per-op) ---------------------------------
+
+    def kv_set(self, key: str, value) -> None:
+        with self._lock:
+            self._kv[key] = value
+            self._journal(
+                {"op": "kv", "gang": self.gang_id, "key": key, "value": value}
+            )
+
+    def blob_set(self, key: str, data: bytes) -> None:
+        with self._lock:
+            old = self._blobs.pop(key, None)
+            if old is not None:
+                self._blob_bytes -= len(old)
+            self._blobs[key] = data
+            self._blob_bytes += len(data)
+            while self._blob_bytes > self.max_blob_bytes and len(self._blobs) > 1:
+                _, evicted = self._blobs.popitem(last=False)
+                self._blob_bytes -= len(evicted)
+            self._journal(
+                {"op": "blob", "gang": self.gang_id, "key": key,
+                 "b64": base64.b64encode(data).decode("ascii")}
+            )
+
+    # -- replay (no journaling) -----------------------------------------------
+
+    def replay_kv(self, key: str, value) -> None:
+        with self._lock:
+            self._kv[key] = value
+
+    def replay_blob(self, key: str, data: bytes) -> None:
+        RendezvousState.blob_set(self, key, data)
+
+    def replay_membership(self, snap: dict) -> None:
+        self.restore_membership(snap)
+        with self._journal_serial:
+            self._last_membership = snap
+
+
+class GangNamespace:
+    """One gang's slice of the control plane."""
+
+    def __init__(
+        self,
+        gang_id: str,
+        journal: Callable[[dict], None],
+        rdzv_kwargs: Optional[dict] = None,
+        autotune_kwargs: Optional[dict] = None,
+    ):
+        self.gang_id = gang_id
+        self.rendezvous = _JournaledState(gang_id, journal, **(rdzv_kwargs or {}))
+        self._autotune_kwargs = dict(autotune_kwargs or {})
+        self._autotune = None
+        self._autotune_lock = threading.Lock()
+
+    def autotune_service(self, world_size: Optional[int] = None):
+        """This gang's private AutotuneService (own ``AutotuneTaskManager``
+        pool), created on first use.  ``world_size`` only matters at
+        creation (the sampling quorum); later calls ignore it."""
+        with self._autotune_lock:
+            if self._autotune is None:
+                from bagua_tpu.env import (
+                    get_autotune_max_samples,
+                    get_autotune_sampling_confidence_time_s,
+                    get_autotune_warmup_time_s,
+                )
+                from bagua_tpu.service.autotune_service import AutotuneService
+
+                kwargs = dict(
+                    autotune_level=1,
+                    max_samples=get_autotune_max_samples(),
+                    sampling_confidence_time_s=get_autotune_sampling_confidence_time_s(),
+                    warmup_time_s=get_autotune_warmup_time_s(),
+                )
+                kwargs.update(self._autotune_kwargs)
+                self._autotune = AutotuneService(
+                    world_size=int(world_size or 1), **kwargs
+                )
+            return self._autotune
+
+    @property
+    def autotune_models(self) -> List[str]:
+        with self._autotune_lock:
+            if self._autotune is None:
+                return []
+            return sorted(self._autotune._managers)
+
+
+class FleetControlPlane:
+    """The whole fleet's shared state: gang namespaces, leases, admission
+    control, the cross-gang plan cache, the WAL, the scheduler view."""
+
+    def __init__(
+        self,
+        wal_dir: Optional[str] = None,
+        lease_ttl_s: Optional[float] = None,
+        rate: Optional[float] = None,
+        burst: Optional[float] = None,
+        compact_every: int = 1000,
+        fsync: bool = False,
+        rdzv_kwargs: Optional[dict] = None,
+        autotune_kwargs: Optional[dict] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        from bagua_tpu.env import (
+            get_fleet_burst, get_fleet_lease_ttl_s, get_fleet_rate_limit,
+        )
+
+        self.lease_ttl_s = get_fleet_lease_ttl_s() if lease_ttl_s is None else float(lease_ttl_s)
+        self.rate = get_fleet_rate_limit() if rate is None else float(rate)
+        self.burst = get_fleet_burst() if burst is None else float(burst)
+        self.rdzv_kwargs = dict(rdzv_kwargs or {})
+        self.autotune_kwargs = dict(autotune_kwargs or {})
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._gangs: Dict[str, GangNamespace] = {}
+        self._leases: Dict[str, float] = {}  # gang_id -> lease deadline
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._plans: Dict[str, dict] = {}  # cache key -> {"plan", "meta"}
+        self._last_sweep = clock()
+        self._replaying = False
+        self.gangs_gcd = 0
+        self.backpressure_denials = 0
+        self.wal = WriteAheadLog(wal_dir, compact_every=compact_every, fsync=fsync) if wal_dir else None
+        if self.wal is not None:
+            self._replay()
+
+    # -- WAL ------------------------------------------------------------------
+
+    def journal(self, record: dict) -> None:
+        if self.wal is None or self._replaying:
+            return
+        self.wal.append(record)
+
+    def maybe_compact(self) -> bool:
+        """Fold the WAL into a snapshot when due.  Called with no locks
+        held (the HTTP layer, after replying): the full-fleet dump below
+        takes the fleet lock and every gang lock in turn."""
+        if self.wal is None or not self.wal.needs_compact():
+            return False
+        self.wal.compact(self._snapshot_state())
+        logger.info("WAL compacted (#%d)", self.wal.compactions)
+        return True
+
+    def _snapshot_state(self) -> dict:
+        with self._lock:
+            gangs = dict(self._gangs)
+            plans = {k: dict(v) for k, v in self._plans.items()}
+        state = {"plans": plans, "gangs": {}}
+        for gang_id, ns in sorted(gangs.items()):
+            st = ns.rendezvous
+            with st._lock:
+                kv = dict(st._kv)
+                blobs = {
+                    k: base64.b64encode(v).decode("ascii")
+                    for k, v in st._blobs.items()
+                }
+            state["gangs"][gang_id] = {
+                "rdzv": st.export_membership(),
+                "kv": kv,
+                "blobs": blobs,
+            }
+        return state
+
+    def _replay(self) -> None:
+        snapshot, records = self.wal.load()
+        self._replaying = True
+        try:
+            if snapshot:
+                for key, entry in snapshot.get("plans", {}).items():
+                    self._plans[key] = dict(entry)
+                for gang_id, gs in snapshot.get("gangs", {}).items():
+                    ns = self._ensure_gang(gang_id)
+                    ns.rendezvous.replay_membership(gs.get("rdzv", {}))
+                    for k, v in gs.get("kv", {}).items():
+                        ns.rendezvous.replay_kv(k, v)
+                    for k, b64 in gs.get("blobs", {}).items():
+                        ns.rendezvous.replay_blob(k, base64.b64decode(b64))
+            for rec in records:
+                self._apply(rec)
+        finally:
+            self._replaying = False
+        if snapshot or records:
+            logger.info(
+                "WAL replay: %d gangs, %d cached plans, %d records past snapshot",
+                len(self._gangs), len(self._plans), len(records),
+            )
+
+    def _apply(self, rec: dict) -> None:
+        op = rec.get("op")
+        if op == "gang":
+            self._ensure_gang(rec["gang"])
+        elif op == "gang_gc":
+            self._gangs.pop(rec["gang"], None)
+            self._leases.pop(rec["gang"], None)
+            self._buckets.pop(rec["gang"], None)
+        elif op == "rdzv":
+            self._ensure_gang(rec["gang"]).rendezvous.replay_membership(rec["state"])
+        elif op == "kv":
+            self._ensure_gang(rec["gang"]).rendezvous.replay_kv(rec["key"], rec["value"])
+        elif op == "blob":
+            self._ensure_gang(rec["gang"]).rendezvous.replay_blob(
+                rec["key"], base64.b64decode(rec["b64"])
+            )
+        elif op == "plan":
+            self._plans[rec["key"]] = dict(rec["entry"])
+        else:
+            logger.warning("WAL replay: unknown op %r (skipped)", op)
+
+    # -- gang namespaces, leases, admission -----------------------------------
+
+    def _ensure_gang(self, gang_id: str) -> GangNamespace:
+        with self._lock:
+            ns = self._gangs.get(gang_id)
+            if ns is None:
+                ns = GangNamespace(
+                    gang_id,
+                    self.journal,
+                    rdzv_kwargs=self.rdzv_kwargs,
+                    autotune_kwargs=self.autotune_kwargs,
+                )
+                self._gangs[gang_id] = ns
+                self.journal({"op": "gang", "gang": gang_id})
+                if not self._replaying:
+                    logger.info("gang %r: namespace created", gang_id)
+            self._leases[gang_id] = self._clock() + self.lease_ttl_s
+            return ns
+
+    def gang(self, gang_id: str) -> GangNamespace:
+        """Resolve (creating on first touch) a gang's namespace; touches
+        its lease and opportunistically sweeps expired neighbors."""
+        self.sweep_leases()
+        return self._ensure_gang(gang_id)
+
+    def admit(self, gang_id: str) -> "tuple[bool, float]":
+        """Token-bucket admission for one request; (admitted, retry_after_s)."""
+        with self._lock:
+            bucket = self._buckets.get(gang_id)
+            if bucket is None:
+                bucket = self._buckets[gang_id] = TokenBucket(
+                    self.rate, self.burst, clock=self._clock
+                )
+        ok, retry_after = bucket.admit()
+        if not ok:
+            with self._lock:
+                self.backpressure_denials += 1
+        return ok, retry_after
+
+    def sweep_leases(self, min_interval_s: float = 1.0) -> List[str]:
+        """Reap gangs whose lease expired: drop the namespace (KV, blobs,
+        membership, autotune managers — all of it) and journal the GC so a
+        restart doesn't resurrect the dead.  Rate-limited; returns the
+        reaped gang ids."""
+        now = self._clock()
+        reaped = []
+        with self._lock:
+            if now - self._last_sweep < min_interval_s:
+                return reaped
+            self._last_sweep = now
+            for gang_id, deadline in list(self._leases.items()):
+                if now > deadline:
+                    reaped.append(gang_id)
+                    self._gangs.pop(gang_id, None)
+                    self._leases.pop(gang_id, None)
+                    self._buckets.pop(gang_id, None)
+                    self.gangs_gcd += 1
+        for gang_id in reaped:
+            logger.warning("gang %r: lease expired; namespace GC'd", gang_id)
+            self.journal({"op": "gang_gc", "gang": gang_id})
+        return reaped
+
+    def gang_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._gangs)
+
+    # -- cross-gang plan cache -------------------------------------------------
+
+    def plan_put(
+        self,
+        fingerprint: str,
+        topology: str,
+        algorithm: str,
+        wire_precision: str,
+        plan: dict,
+        meta: Optional[dict] = None,
+    ) -> str:
+        key = plan_cache_key(fingerprint, topology, algorithm, wire_precision)
+        entry = {
+            "plan": plan,
+            "meta": dict(meta or {}),
+            "key": {
+                "fingerprint": str(fingerprint),
+                "topology": str(topology),
+                "algorithm": str(algorithm),
+                "wire_precision": str(wire_precision),
+            },
+        }
+        with self._lock:
+            self._plans[key] = entry
+            self.journal({"op": "plan", "key": key, "entry": entry})
+        logger.info("plan cache: stored %s", key)
+        return key
+
+    def plan_get(
+        self, fingerprint: str, topology: str, algorithm: str, wire_precision: str
+    ) -> Optional[dict]:
+        key = plan_cache_key(fingerprint, topology, algorithm, wire_precision)
+        with self._lock:
+            entry = self._plans.get(key)
+            return dict(entry) if entry is not None else None
+
+    def plan_count(self) -> int:
+        with self._lock:
+            return len(self._plans)
+
+    # -- scheduler view ---------------------------------------------------------
+
+    def scheduler_view(self) -> dict:
+        """Fleet-wide verdicts from the streams gangs already push: per-gang
+        ``wedged`` (a flight digest landed — some rank dumped its black box)
+        > ``straggler`` (StepSummary p50 spread past the threshold) >
+        ``healthy`` (summaries, no findings) > ``idle`` (nothing pushed)."""
+        from bagua_tpu.observability.aggregate import StepSummary, straggler_score
+
+        self.sweep_leases()
+        now = self._clock()
+        with self._lock:
+            gangs = dict(self._gangs)
+            leases = dict(self._leases)
+        view = {"gangs": {}, "n_gangs": len(gangs)}
+        for gang_id, ns in sorted(gangs.items()):
+            st = ns.rendezvous
+            # group pushed summaries by attempt nonce; judge the newest
+            # attempt (max settled step) — dead incarnations' numbers stay
+            by_attempt: Dict[str, List[StepSummary]] = {}
+            flight_ranks = []
+            for key in st.kv_keys():
+                parts = key.split("/")
+                if key.startswith("bagua/obs/") and len(parts) == 4:
+                    try:
+                        summary = StepSummary.from_payload(st.kv_get(key))
+                    except (TypeError, ValueError):
+                        continue
+                    by_attempt.setdefault(parts[2], []).append(summary)
+                elif key.startswith("bagua/flight/") and len(parts) == 4:
+                    flight_ranks.append(parts[3])
+            summaries: List[StepSummary] = []
+            if by_attempt:
+                attempt = max(by_attempt, key=lambda a: max(s.step for s in by_attempt[a]))
+                summaries = by_attempt[attempt]
+            straggler = straggler_score(summaries) if summaries else None
+            if flight_ranks:
+                verdict = "wedged"
+            elif straggler is not None:
+                verdict = "straggler"
+            elif summaries:
+                verdict = "healthy"
+            else:
+                verdict = "idle"
+            asn = st.export_membership()
+            settled = asn.get("settled")
+            view["gangs"][gang_id] = {
+                "verdict": verdict,
+                "straggler": straggler,
+                "flight_ranks": sorted(flight_ranks),
+                "ranks_reporting": len(summaries),
+                "max_step": max((s.step for s in summaries), default=-1),
+                "n_members": len(asn.get("members", [])),
+                "epoch": asn.get("epoch", 0),
+                "generation": asn.get("generation", 0),
+                "world_size": settled.get("world_size") if settled else None,
+                "lease_remaining_s": round(leases.get(gang_id, now) - now, 3),
+            }
+        return view
+
+    # -- durable-state witness --------------------------------------------------
+
+    def dump(self) -> dict:
+        """Deterministic export of every *durable* tier — the bitwise
+        witness the kill/restart tests compare.  Volatile state (heartbeat
+        ages, lease clocks, token buckets) and the advisory autotune tier
+        are excluded by design; blobs appear as sha256 digests so the dump
+        stays small."""
+        import hashlib
+
+        state = self._snapshot_state()
+        for gs in state["gangs"].values():
+            gs["blobs"] = {
+                k: hashlib.sha256(base64.b64decode(v)).hexdigest()
+                for k, v in gs["blobs"].items()
+            }
+        state["n_gangs"] = len(state["gangs"])
+        state["n_plans"] = len(state["plans"])
+        return state
+
+    def close(self) -> None:
+        if self.wal is not None:
+            self.wal.compact(self._snapshot_state())
+            self.wal.close()

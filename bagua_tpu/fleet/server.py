@@ -1,0 +1,257 @@
+"""HTTP front-end for the fleet control plane.
+
+Route map (one port serves the whole fleet):
+
+    /g/<gang_id>/rdzv/...        per-gang rendezvous/KV/blob (the full
+                                 ``distributed.rendezvous`` route table,
+                                 delegated per namespace)
+    /g/<gang_id>/api/v1/...      per-gang autotune API (the full
+                                 ``service.autotune_service`` route table)
+    /fleet/plan/publish          POST: store a proven plan in the cross-gang
+                                 cache (fingerprint/topology/algorithm/
+                                 wire_precision + plan payload)
+    /fleet/plan/lookup           POST: cache lookup by the same key
+    /fleet/scheduler             GET: per-gang healthy/wedged/straggler view
+    /fleet/gangs                 GET: gang ids + lease remainders
+    /fleet/dump                  GET: deterministic durable-state dump (the
+                                 kill/restart bitwise witness)
+    /fleet/health                GET: liveness
+
+Every ``/g/...`` request passes the gang's token bucket first — a denial
+is ``429`` + ``Retry-After`` (the contract ``retry_call`` paces on and the
+circuit breaker ignores) — and touches the gang's lease; an untouched
+lease expiring GCs the whole namespace.
+
+Run standalone (what the load lane SIGKILLs and restarts)::
+
+    python -m bagua_tpu.fleet.server --port 29500 --wal-dir /var/lib/bagua
+"""
+
+import json
+import logging
+import math
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from bagua_tpu.distributed.rendezvous import _Handler as _RdzvHandler
+from bagua_tpu.fleet.control_plane import FleetControlPlane, GangNamespace
+from bagua_tpu.service.autotune_service import AUTOTUNE_POST_ROUTES
+
+logger = logging.getLogger("bagua_tpu.fleet")
+
+__all__ = ["FleetHandler", "start_fleet_server", "main"]
+
+
+class FleetHandler(_RdzvHandler):
+    """Multi-tenant dispatcher reusing the rendezvous handler's route table
+    per gang namespace."""
+
+    fleet: FleetControlPlane  # bound by start_fleet_server
+    state = None  # the single-tenant binding is never used here
+
+    def _gang_route(self, drained: bool) -> Optional[Tuple[GangNamespace, str]]:
+        """Resolve ``/g/<gang_id>/<sub>`` → (namespace, sub-path), applying
+        admission control + the lease touch.  Replies (429/404) and returns
+        None when the request doesn't reach a namespace.  ``drained`` must
+        be True for bodied methods — under keep-alive an unread body
+        desyncs the connection, so callers drain before any early reply."""
+        assert drained or self.command == "GET", "body must be drained first"
+        from urllib.parse import unquote
+
+        rest = self.path[len("/g/"):]
+        gang_quoted, sep, sub = rest.partition("/")
+        gang_id = unquote(gang_quoted)
+        if not gang_id or not sep:
+            self._reply({"error": "bad gang route"}, 404)
+            return None
+        ok, retry_after = self.fleet.admit(gang_id)
+        if not ok:
+            self._reply(
+                {"error": "backpressure", "retry_after_s": round(retry_after, 3)},
+                429,
+                headers={"Retry-After": max(1, math.ceil(retry_after))},
+            )
+            return None
+        return self.fleet.gang(gang_id), "/" + sub
+
+    def _autotune(self, ns: GangNamespace, sub: str, payload: dict) -> None:
+        name = AUTOTUNE_POST_ROUTES.get(sub)
+        if name is None:
+            self._reply({"error": "not found"}, 404)
+            return
+        service = ns.autotune_service(world_size=payload.get("world_size"))
+        try:
+            self._reply(getattr(service, name)(payload))
+        except Exception as e:
+            logger.exception("autotune endpoint error (gang %r)", ns.gang_id)
+            self._reply({"error": str(e)}, 500)
+
+    # -- verbs ----------------------------------------------------------------
+
+    def do_GET(self):
+        try:
+            if self.path.startswith("/g/"):
+                route = self._gang_route(drained=True)
+                if route is not None:
+                    ns, sub = route
+                    if sub == "/api/v1/health_check":
+                        self._reply({"status": "ok"})
+                    else:
+                        self._handle_get(ns.rendezvous, sub)
+            elif self.path == "/fleet/scheduler":
+                self._reply(self.fleet.scheduler_view())
+            elif self.path == "/fleet/gangs":
+                self._reply({"gangs": self.fleet.gang_ids(),
+                             "gangs_gcd": self.fleet.gangs_gcd,
+                             "backpressure_denials": self.fleet.backpressure_denials})
+            elif self.path == "/fleet/dump":
+                self._reply(self.fleet.dump())
+            elif self.path == "/fleet/health":
+                self._reply({"status": "ok", "gangs": len(self.fleet.gang_ids()),
+                             "plans": self.fleet.plan_count()})
+            else:
+                self._reply({"error": "not found"}, 404)
+        finally:
+            self.fleet.maybe_compact()
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        try:
+            if self.path.startswith("/g/"):
+                route = self._gang_route(drained=True)
+                if route is not None:
+                    ns, sub = route
+                    self._handle_put(ns.rendezvous, sub, body)
+            else:
+                self._reply({"error": "not found"}, 404)
+        finally:
+            self.fleet.maybe_compact()
+
+    def do_DELETE(self):
+        try:
+            if self.path.startswith("/g/"):
+                route = self._gang_route(drained=True)
+                if route is not None:
+                    ns, sub = route
+                    self._handle_delete(ns.rendezvous, sub)
+            else:
+                self._reply({"error": "not found"}, 404)
+        finally:
+            self.fleet.maybe_compact()
+
+    def do_POST(self):
+        try:
+            payload = self._body()
+        except (ValueError, json.JSONDecodeError):
+            return self._reply({"error": "bad json"}, 400)
+        try:
+            if self.path.startswith("/g/"):
+                route = self._gang_route(drained=True)
+                if route is not None:
+                    ns, sub = route
+                    if sub.startswith("/api/v1/"):
+                        self._autotune(ns, sub, payload)
+                    else:
+                        self._handle_post(ns.rendezvous, sub, payload)
+            elif self.path == "/fleet/plan/publish":
+                try:
+                    key = self.fleet.plan_put(
+                        fingerprint=payload["fingerprint"],
+                        topology=payload["topology"],
+                        algorithm=payload["algorithm"],
+                        wire_precision=payload["wire_precision"],
+                        plan=payload["plan"],
+                        meta=payload.get("meta"),
+                    )
+                except KeyError as e:
+                    self._reply({"error": f"missing field {e}"}, 400)
+                else:
+                    self._reply({"ok": True, "key": key})
+            elif self.path == "/fleet/plan/lookup":
+                try:
+                    entry = self.fleet.plan_get(
+                        fingerprint=payload["fingerprint"],
+                        topology=payload["topology"],
+                        algorithm=payload["algorithm"],
+                        wire_precision=payload["wire_precision"],
+                    )
+                except KeyError as e:
+                    self._reply({"error": f"missing field {e}"}, 400)
+                else:
+                    if entry is None:
+                        self._reply({"found": False})
+                    else:
+                        self._reply(dict(entry, found=True))
+            else:
+                self._reply({"error": "not found"}, 404)
+        finally:
+            self.fleet.maybe_compact()
+
+
+def start_fleet_server(
+    fleet: FleetControlPlane, port: int, host: str = "0.0.0.0"
+) -> ThreadingHTTPServer:
+    """Serve the control plane in a daemon thread; returns the live server
+    (``server_address[1]`` is the bound port — pass 0 for ephemeral)."""
+    handler = type("BoundFleetHandler", (FleetHandler,), {"fleet": fleet})
+    server = ThreadingHTTPServer((host, port), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def main(argv=None) -> int:
+    """Standalone fleet control plane (the deployment mode: one of these
+    outlives every gang it serves; the load lane SIGKILLs it mid-run and
+    restarts it on the same port + WAL dir)."""
+    import argparse
+
+    p = argparse.ArgumentParser("bagua_tpu.fleet.server")
+    p.add_argument("--port", type=int, default=29500)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--wal-dir", default=None,
+                   help="durability directory (no WAL = in-memory only)")
+    p.add_argument("--lease-ttl-s", type=float, default=None,
+                   help="gang lease TTL (default BAGUA_FLEET_LEASE_TTL_S)")
+    p.add_argument("--rate", type=float, default=None,
+                   help="per-gang admitted requests/s (default BAGUA_FLEET_RATE; 0 = off)")
+    p.add_argument("--burst", type=float, default=None,
+                   help="per-gang burst capacity (default BAGUA_FLEET_BURST)")
+    p.add_argument("--compact-every", type=int, default=1000)
+    p.add_argument("--fsync", action="store_true")
+    p.add_argument("--min-nodes", type=int, default=1)
+    p.add_argument("--settle-s", type=float, default=1.0)
+    p.add_argument("--member-ttl-s", type=float, default=30.0)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="[bagua_tpu.fleet] %(message)s")
+    fleet = FleetControlPlane(
+        wal_dir=args.wal_dir,
+        lease_ttl_s=args.lease_ttl_s,
+        rate=args.rate,
+        burst=args.burst,
+        compact_every=args.compact_every,
+        fsync=args.fsync,
+        rdzv_kwargs={
+            "min_nodes": args.min_nodes,
+            "settle_s": args.settle_s,
+            "ttl_s": args.member_ttl_s,
+        },
+    )
+    server = start_fleet_server(fleet, args.port, args.host)
+    # the parent (launcher, CI lane) waits for this line before connecting
+    print(f"fleet control plane on port {server.server_address[1]}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.shutdown()
+        fleet.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

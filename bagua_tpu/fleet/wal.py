@@ -1,0 +1,138 @@
+"""Write-ahead log + snapshot compaction for the fleet control plane.
+
+The durability contract: every state mutation the control plane wants to
+survive a crash is appended (seq-numbered, one JSON object per line) to
+``wal.jsonl`` *before* the mutating request is acknowledged; a restarted
+server loads ``snapshot.json`` and replays the records past it, arriving at
+the exact pre-crash fleet state.  Compaction folds the log into a fresh
+snapshot using the snapshot.py discipline — write ``snapshot.json.tmp.<pid>``,
+``os.replace`` into place, *then* truncate the log — so every crash point
+leaves a loadable pair:
+
+* crash before the replace: old snapshot + full log (nothing lost);
+* crash between replace and truncate: new snapshot + a log whose records
+  are all ``<= last_seq`` (replay skips them — records are idempotent
+  against the snapshot that already contains them);
+* crash after truncate: new snapshot + empty log.
+
+Appends ``flush()`` to the OS page cache by default, which survives the
+process being SIGKILLed (the failure mode the fleet lane induces); set
+``fsync=True`` to also survive kernel/power loss at ~100x the write cost.
+"""
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["WriteAheadLog"]
+
+
+class WriteAheadLog:
+    """Single-writer append-only log with snapshot compaction (thread-safe)."""
+
+    def __init__(self, directory: str, compact_every: int = 1000, fsync: bool = False):
+        self.directory = directory
+        self.compact_every = max(1, int(compact_every))
+        self.fsync = fsync
+        self.snapshot_path = os.path.join(directory, "snapshot.json")
+        self.wal_path = os.path.join(directory, "wal.jsonl")
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.RLock()
+        self._fh = None
+        self._seq = 0  # newest seq ever issued (snapshot or log)
+        self._records_since_compact = 0
+        self.compactions = 0
+
+    # -- load ------------------------------------------------------------------
+
+    def load(self) -> Tuple[Optional[dict], List[dict]]:
+        """(snapshot state, records past it) — what a restarted server
+        replays.  Also primes the seq counter and re-opens the log for
+        appending.  A torn final line (crash mid-append: the record was
+        never acknowledged) is truncated away — the file must end on a
+        clean line boundary or the next append would concatenate onto the
+        torn bytes and lose itself to the same torn-tail rule on the
+        following restart.  A torn *snapshot* is impossible by construction
+        (``os.replace``)."""
+        with self._lock:
+            snapshot = None
+            last_seq = 0
+            if os.path.exists(self.snapshot_path):
+                with open(self.snapshot_path) as f:
+                    wrapped = json.load(f)
+                snapshot = wrapped["state"]
+                last_seq = int(wrapped["last_seq"])
+            records = []
+            if os.path.exists(self.wal_path):
+                with open(self.wal_path, "rb") as f:
+                    data = f.read()
+                valid_end = 0
+                for raw in data.splitlines(keepends=True):
+                    if not raw.endswith(b"\n"):
+                        break  # torn tail: the crash point, nothing after it
+                    line = raw.strip()
+                    if line:
+                        try:
+                            rec = json.loads(line)
+                        except (json.JSONDecodeError, UnicodeDecodeError):
+                            break
+                        if int(rec.get("seq", 0)) > last_seq:
+                            records.append(rec)
+                    valid_end += len(raw)
+                if valid_end < len(data):
+                    with open(self.wal_path, "rb+") as f:
+                        f.truncate(valid_end)
+            self._seq = max(last_seq, *(int(r["seq"]) for r in records)) if records else last_seq
+            self._records_since_compact = len(records)
+            self._open_locked(append=True)
+            return snapshot, records
+
+    # -- append ----------------------------------------------------------------
+
+    def append(self, record: Dict) -> int:
+        """Durably append one record; returns its assigned ``seq``."""
+        with self._lock:
+            if self._fh is None:
+                self._open_locked(append=True)
+            self._seq += 1
+            record = dict(record, seq=self._seq)
+            self._fh.write(json.dumps(record, sort_keys=True) + "\n")
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+            self._records_since_compact += 1
+            return self._seq
+
+    def needs_compact(self) -> bool:
+        with self._lock:
+            return self._records_since_compact >= self.compact_every
+
+    # -- compaction ------------------------------------------------------------
+
+    def compact(self, state: Dict) -> None:
+        """Fold the log into ``state`` (the caller's full dump, which must
+        already include every acknowledged record): atomically publish the
+        snapshot, then truncate the log."""
+        with self._lock:
+            tmp = f"{self.snapshot_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"last_seq": self._seq, "state": state}, f, sort_keys=True)
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            os.replace(tmp, self.snapshot_path)
+            if self._fh is not None:
+                self._fh.close()
+            self._open_locked(append=False)  # truncate
+            self._records_since_compact = 0
+            self.compactions += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def _open_locked(self, append: bool) -> None:
+        self._fh = open(self.wal_path, "a" if append else "w")

@@ -247,6 +247,14 @@ EVENT_PAYLOAD_FIELDS = {
         "reason": str,
         "last_phase": str,
     },
+    # one circuit-breaker state change (resilience/retry.py): states are
+    # closed / half-open / open; step is the hub's last known step (-1
+    # before the first step — breakers guard out-of-step RPC paths too)
+    "breaker_transition": {
+        "breaker": str,
+        "old_state": str,
+        "new_state": str,
+    },
 }
 
 

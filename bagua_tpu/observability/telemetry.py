@@ -483,6 +483,48 @@ class Telemetry:
                  "detail": str(detail), "actions": [str(a) for a in actions]}
             )
 
+    def bind_breaker(self, breaker) -> None:
+        """Point a :class:`~bagua_tpu.resilience.retry.CircuitBreaker`'s
+        transition hook at this hub (idempotent; an already-set listener is
+        left alone): every evented state change — closed→open,
+        open→half-open, half-open→closed/open — lands as a
+        ``breaker_transition`` JSONL event plus ``breaker_state`` gauges."""
+        if getattr(breaker, "listener", None) is None:
+            breaker.listener = self.on_breaker_transition
+
+    #: breaker state → gauge code (closed=0 half-open=1 open=2): a scrape
+    #: alerting on ``breaker_state > 0`` catches both degraded states.
+    BREAKER_STATE_CODES = {"closed": 0, "half-open": 1, "open": 2}
+
+    def on_breaker_transition(
+        self, name: str, old_state: str, new_state: str
+    ) -> None:
+        """One circuit-breaker state change (see
+        :class:`~bagua_tpu.resilience.retry.CircuitBreaker`): exported as
+        the shared ``breaker_state`` gauge, a per-breaker
+        ``breaker_state_<name>`` gauge, a ``breaker_transitions_total``
+        counter, and the schema-validated ``breaker_transition`` event."""
+        code = self.BREAKER_STATE_CODES.get(new_state, -1)
+        r = self.registry
+        r.gauge(
+            "breaker_state",
+            help="newest breaker transition (0 closed / 1 half-open / 2 open)",
+        ).set(code)
+        safe = "".join(c if c.isalnum() else "_" for c in str(name))
+        r.gauge(
+            f"breaker_state_{safe}",
+            help=f"breaker {name} state (0 closed / 1 half-open / 2 open)",
+        ).set(code)
+        r.counter(
+            "breaker_transitions_total", help="circuit-breaker state changes"
+        ).inc()
+        if self.jsonl:
+            self.jsonl.emit(
+                {"event": "breaker_transition", "step": int(self.current_step),
+                 "breaker": str(name), "old_state": str(old_state),
+                 "new_state": str(new_state)}
+            )
+
     def on_hang(self, reason: str, ctx: Optional[dict] = None,
                 dump_paths: Optional[dict] = None) -> None:
         """The watchdog (or a preemption drain) declared this rank hung:

@@ -28,7 +28,7 @@ seed (no wall-clock, no real port numbers), so two runs diff clean.
 
 import dataclasses
 import random
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from bagua_tpu.observability.aggregate import GangAggregator, StepSummary
 from bagua_tpu.observability.flight_recorder import (
@@ -221,7 +221,11 @@ def _kv_flapping(cfg: FleetConfig, gang: int, window: int) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def run_fleet(cfg: FleetConfig, endpoint: Optional[str] = None) -> Dict:
+def run_fleet(
+    cfg: FleetConfig,
+    endpoint: Optional[str] = None,
+    gang_endpoint: Optional[Callable[[int], str]] = None,
+) -> Dict:
     """Run the fleet; returns a deterministic per-gang verdict report.
 
     When ``endpoint`` is None a private rendezvous server is started on a
@@ -229,6 +233,10 @@ def run_fleet(cfg: FleetConfig, endpoint: Optional[str] = None) -> Dict:
     the KV verbs only (never ``join``), so the shared server's membership
     machine is untouched and ``heartbeat`` deterministically reports no
     member ages.
+
+    ``gang_endpoint`` maps a gang index to its own endpoint — how the fleet
+    load lane points each simulated gang at its ``/g/<gang_id>`` namespace
+    on one multi-tenant control plane.  Overrides ``endpoint`` per gang.
     """
     from bagua_tpu.distributed.rendezvous import (
         RendezvousState,
@@ -236,24 +244,32 @@ def run_fleet(cfg: FleetConfig, endpoint: Optional[str] = None) -> Dict:
     )
 
     server = None
-    if endpoint is None:
+    if endpoint is None and gang_endpoint is None:
         state = RendezvousState(min_nodes=1, settle_s=0.05)
         server = start_rendezvous_server(state, 0, host="127.0.0.1")
         endpoint = f"http://127.0.0.1:{server.server_address[1]}"
     try:
-        return _run(cfg, endpoint)
+        return _run(cfg, endpoint, gang_endpoint)
     finally:
         if server is not None:
             server.shutdown()
 
 
-def _run(cfg: FleetConfig, endpoint: str) -> Dict:
+def _run(
+    cfg: FleetConfig,
+    endpoint: Optional[str],
+    gang_endpoint: Optional[Callable[[int], str]] = None,
+) -> Dict:
     from bagua_tpu.distributed.rendezvous import RendezvousClient
 
     gangs = []
     for g in range(cfg.n_gangs):
         client = FlakyClient(
-            RendezvousClient(endpoint, node_rank=0, timeout_s=10.0)
+            RendezvousClient(
+                gang_endpoint(g) if gang_endpoint is not None else endpoint,
+                node_rank=0,
+                timeout_s=10.0,
+            )
         )
         # one aggregator per rank, all sharing the gang's transport and a
         # per-gang attempt nonce so KV keys never collide across gangs

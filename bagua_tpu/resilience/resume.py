@@ -59,6 +59,10 @@ class ElasticResumeCoordinator:
             the cross-rank snapshot agreement (multi-process gangs only).
         expert_filter: forwarded to ``remap_world_size`` (MoE leaves).
         telemetry: optional hub; a successful resume emits ``on_restart``.
+        fleet_plan_fn: optional zero-arg callable returning a plan payload
+            from the fleet's cross-gang cache (or None on a miss) — e.g.
+            ``lambda: fleet.lookup_plan(**key)["plan"]``.  Consulted by
+            :meth:`fleet_warm_start` when there is no snapshot to resume.
     """
 
     def __init__(
@@ -68,12 +72,14 @@ class ElasticResumeCoordinator:
         expert_filter=None,
         telemetry=None,
         agreement_timeout_s: float = 30.0,
+        fleet_plan_fn=None,
     ):
         self.store = store if isinstance(store, SnapshotStore) else SnapshotStore(store)
         self.rendezvous_client = rendezvous_client
         self.expert_filter = expert_filter
         self.telemetry = telemetry
         self.agreement_timeout_s = agreement_timeout_s
+        self.fleet_plan_fn = fleet_plan_fn
 
     # -- snapshot agreement --------------------------------------------------
 
@@ -241,6 +247,40 @@ class ElasticResumeCoordinator:
             step, old_world, new_world, plan_source,
         )
         return ResumeResult(state, step, old_world, new_world, plan_source)
+
+    def fleet_warm_start(self, ddp) -> Optional[str]:
+        """Step-0 plan adoption from the fleet's cross-gang cache — the
+        cold-start counterpart of :meth:`resume`'s manifest carry-over.
+
+        Call when :meth:`resume` returned None (no snapshot: a brand-new
+        gang): if ``fleet_plan_fn`` produces a payload that fits, the
+        engine adopts it before the first step and the method returns
+        ``"fleet"`` (the ``plan_source`` generalizing ``"carried"``),
+        emitting the ``restart`` telemetry event at step 0 with
+        ``plan_source="fleet"``.  Advisory: every failure path returns
+        None and the gang runs its fresh plan."""
+        if self.fleet_plan_fn is None:
+            return None
+        try:
+            payload = self.fleet_plan_fn()
+        except Exception as e:
+            logger.warning("fleet plan lookup failed (advisory): %s", e)
+            return None
+        if not payload or not self._adopt_plan(ddp, payload):
+            return None
+        if hasattr(ddp, "clear_pending_reshard"):
+            # Nothing to migrate: the gang has no live state yet.
+            ddp.clear_pending_reshard()
+        logger.info("cold start adopted a fleet-cached plan (plan_source=fleet)")
+        if self.telemetry is not None:
+            self.telemetry.on_restart(
+                step=0,
+                old_world_size=ddp.group.size,
+                new_world_size=ddp.group.size,
+                plan_source="fleet",
+                lost_steps=0,
+            )
+        return "fleet"
 
     def _adopt_plan(self, ddp, payload: Optional[Dict[str, Any]]) -> bool:
         """Re-adopt the snapshot's bucket plan (no planner cold-start).  Best

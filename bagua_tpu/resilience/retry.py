@@ -22,11 +22,56 @@ from typing import Callable, Optional, Tuple, Type
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["CircuitOpenError", "CircuitBreaker", "RetryPolicy", "retry_call"]
+__all__ = [
+    "CircuitOpenError",
+    "BackpressureError",
+    "retry_after_hint",
+    "CircuitBreaker",
+    "RetryPolicy",
+    "retry_call",
+]
 
 
 class CircuitOpenError(ConnectionError):
     """Raised (fast, no I/O) while a circuit breaker is open."""
+
+
+class BackpressureError(ConnectionError):
+    """The server answered 429: alive but shedding load.
+
+    Carries the server-supplied ``Retry-After`` hint so :func:`retry_call`
+    can pace itself to the server's recovery estimate.  Deliberately *not*
+    a breaker-counted failure — a 429 proves the service is up, and opening
+    the circuit on it would turn transient overload into a full outage for
+    this client."""
+
+    def __init__(self, message: str = "backpressure", retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+
+def retry_after_hint(exc: BaseException) -> Optional[float]:
+    """Extract a server-supplied backpressure hint from an exception.
+
+    Returns the Retry-After delay in seconds, or None when the exception
+    carries no backpressure signal.  Understands :class:`BackpressureError`
+    (``retry_after_s`` attribute) and raw ``urllib.error.HTTPError`` 429s
+    (``Retry-After`` header; absent/garbled headers degrade to 0.0 — still
+    backpressure, just no pacing hint)."""
+    hint = getattr(exc, "retry_after_s", None)
+    if hint is not None:
+        try:
+            return max(0.0, float(hint))
+        except (TypeError, ValueError):
+            return 0.0
+    if getattr(exc, "code", None) == 429:
+        headers = getattr(exc, "headers", None)
+        raw = headers.get("Retry-After") if headers is not None else None
+        try:
+            return max(0.0, float(raw))
+        except (TypeError, ValueError):
+            return 0.0
+    return None
 
 
 class CircuitBreaker:
@@ -38,6 +83,11 @@ class CircuitBreaker:
     call is admitted as a half-open probe — its success closes the circuit,
     its failure re-opens it for another cooldown.  ``failure_threshold <= 0``
     disables the breaker entirely.
+
+    ``listener`` (or :meth:`bagua_tpu.observability.telemetry.Telemetry.bind_breaker`)
+    receives ``(name, old_state, new_state)`` on every evented transition —
+    closed→open, open→half-open (probe admission), half-open→closed,
+    half-open→open — fired outside the breaker lock.
     """
 
     def __init__(
@@ -46,10 +96,12 @@ class CircuitBreaker:
         cooldown_s: float = 30.0,
         name: str = "rpc",
         clock: Callable[[], float] = time.monotonic,
+        listener: Optional[Callable[[str, str, str], None]] = None,
     ):
         self.failure_threshold = failure_threshold
         self.cooldown_s = cooldown_s
         self.name = name
+        self.listener = listener
         self._clock = clock
         self._lock = threading.Lock()
         self._consecutive_failures = 0
@@ -57,14 +109,27 @@ class CircuitBreaker:
         self._probing = False
         self.times_opened = 0
 
+    def _state_locked(self) -> str:
+        if self._opened_at is None:
+            return "closed"
+        if self._probing or self._clock() - self._opened_at >= self.cooldown_s:
+            return "half-open"
+        return "open"
+
     @property
     def state(self) -> str:
         with self._lock:
-            if self._opened_at is None:
-                return "closed"
-            if self._clock() - self._opened_at >= self.cooldown_s:
-                return "half-open"
-            return "open"
+            return self._state_locked()
+
+    def _notify(self, old_state: str, new_state: str) -> None:
+        # Called with the lock released; a listener that RPCs or logs must
+        # never be able to deadlock the breaker or its callers.
+        if self.listener is None or old_state == new_state:
+            return
+        try:
+            self.listener(self.name, old_state, new_state)
+        except Exception:
+            logger.exception("breaker %s transition listener failed", self.name)
 
     def before_call(self) -> None:
         """Gate one call attempt; raises :class:`CircuitOpenError` while
@@ -82,22 +147,28 @@ class CircuitBreaker:
                     f"failing fast for {self.cooldown_s}s cooldowns"
                 )
             self._probing = True  # half-open: admit this caller as the probe
+        self._notify("open", "half-open")
 
     def record_success(self) -> None:
         with self._lock:
+            old = self._state_locked()
             self._consecutive_failures = 0
             self._opened_at = None
             self._probing = False
+        self._notify(old, "closed")
 
     def record_failure(self) -> None:
         if self.failure_threshold <= 0:
             return
+        notify = None
         with self._lock:
+            old = self._state_locked()
             self._consecutive_failures += 1
             was_open = self._opened_at is not None
             if self._probing or self._consecutive_failures >= self.failure_threshold:
                 self._opened_at = self._clock()
                 self._probing = False
+                notify = (old, "open")
                 if not was_open or self._consecutive_failures == self.failure_threshold:
                     self.times_opened += 1
                     logger.warning(
@@ -105,6 +176,8 @@ class CircuitBreaker:
                         "degrading to local defaults for %.1fs",
                         self.name, self._consecutive_failures, self.cooldown_s,
                     )
+        if notify is not None:
+            self._notify(*notify)
 
 
 class RetryPolicy:
@@ -148,7 +221,14 @@ def retry_call(
     :class:`CircuitOpenError` from the breaker is never retried (the whole
     point is to fail fast); any other ``retry_on`` exception is retried up
     to ``policy.retries`` times with jittered backoff, and every outcome is
-    reported to the breaker so persistent flapping opens the circuit."""
+    reported to the breaker so persistent flapping opens the circuit.
+
+    Server-signalled backpressure (:func:`retry_after_hint` returns a value:
+    a :class:`BackpressureError` or a raw HTTP 429) is special-cased: it is
+    recorded as a breaker *success* (the server is alive — a 429 must never
+    push the circuit open), and the backoff becomes
+    ``min(max(hint, jitter), policy.max_s)`` so the client honors the
+    server's Retry-After estimate while the cap bounds a hostile hint."""
     policy = policy or RetryPolicy()
     last: Optional[BaseException] = None
     for attempt in range(policy.retries + 1):
@@ -157,12 +237,18 @@ def retry_call(
         try:
             out = fn(*args, **kwargs)
         except retry_on as e:
+            hint = retry_after_hint(e)
             if breaker is not None:
-                breaker.record_failure()
+                if hint is None:
+                    breaker.record_failure()
+                else:
+                    breaker.record_success()  # alive, just shedding load
             last = e
             if attempt >= policy.retries:
                 break
             delay = policy.backoff_s(attempt)
+            if hint is not None:
+                delay = min(max(hint, delay), policy.max_s)
             if on_retry is not None:
                 on_retry(attempt, e)
             logger.debug(

@@ -22,15 +22,28 @@ from bagua_tpu.env import get_bagua_service_port
 
 
 class AutotuneClient:
-    def __init__(self, host: str = "127.0.0.1", port: Optional[int] = None, timeout: float = 10.0):
+    """``prefix`` prepends every route (no trailing slash) — the fleet
+    control plane serves each gang's autotune API under
+    ``/g/<gang_id>/api/v1/...``, so a fleet-attached client passes
+    ``prefix="/g/<gang_id>"`` and everything else is unchanged.  ``timeout``
+    defaults to the shared ``BAGUA_RPC_TIMEOUT_S`` knob."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: Optional[int] = None,
+        timeout: Optional[float] = None,
+        prefix: str = "",
+    ):
         from bagua_tpu.env import (
             get_rpc_breaker_cooldown_s, get_rpc_breaker_threshold,
+            get_rpc_timeout_s,
         )
         from bagua_tpu.resilience.retry import CircuitBreaker, RetryPolicy
 
         port = port if port is not None else get_bagua_service_port()
-        self.base = f"http://{host}:{port}"
-        self.timeout = timeout
+        self.base = f"http://{host}:{port}{prefix}"
+        self.timeout = get_rpc_timeout_s() if timeout is None else timeout
         self.retry_policy = RetryPolicy()
         self.breaker = CircuitBreaker(
             failure_threshold=get_rpc_breaker_threshold(),
@@ -45,8 +58,20 @@ class AutotuneClient:
             headers={"Content-Type": "application/json"},
             method="POST",
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return json.loads(resp.read())
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                from bagua_tpu.resilience.retry import (
+                    BackpressureError, retry_after_hint,
+                )
+
+                raise BackpressureError(
+                    f"{self.base + path}: 429 backpressure",
+                    retry_after_hint(e) or 0.0,
+                ) from e
+            raise
 
     def _post(self, path: str, payload: Dict) -> Dict:
         from bagua_tpu.resilience.retry import retry_call

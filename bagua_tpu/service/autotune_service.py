@@ -27,6 +27,17 @@ from bagua_tpu.service.autotune_task_manager import AutotuneTaskManager
 
 logger = logging.getLogger(__name__)
 
+#: POST route → AutotuneService method name; shared with the fleet control
+#: plane (``bagua_tpu.fleet.server``), which serves the same API per gang
+#: namespace under ``/g/<gang_id>/api/v1/...``.
+AUTOTUNE_POST_ROUTES = {
+    "/api/v1/register_tensors": "register_tensors",
+    "/api/v1/report_metrics": "report_metrics",
+    "/api/v1/ask_hyperparameters": "ask_hyperparameters",
+    "/api/v1/report_tensor_execution_order": "report_tensor_execution_order",
+    "/api/v1/planner_trail": "planner_trail",
+}
+
 
 class AutotuneService:
     def __init__(
@@ -252,14 +263,8 @@ class AutotuneService:
                 except json.JSONDecodeError:
                     self._send({"error": "bad json"}, 400)
                     return
-                routes = {
-                    "/api/v1/register_tensors": service.register_tensors,
-                    "/api/v1/report_metrics": service.report_metrics,
-                    "/api/v1/ask_hyperparameters": service.ask_hyperparameters,
-                    "/api/v1/report_tensor_execution_order": service.report_tensor_execution_order,
-                    "/api/v1/planner_trail": service.planner_trail,
-                }
-                fn = routes.get(self.path)
+                name = AUTOTUNE_POST_ROUTES.get(self.path)
+                fn = getattr(service, name) if name is not None else None
                 if fn is None:
                     self._send({"error": "not found"}, 404)
                     return

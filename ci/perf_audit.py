@@ -1865,6 +1865,17 @@ def main():
             tempfile.mkdtemp(prefix="bagua_fault_injection_"),
             args.out + "_resilience.json",
         )
+    # Fleet control-plane load gate: 8 simulated gangs + live engines on one
+    # WAL-backed multi-tenant server, with isolation probes, 429 backpressure,
+    # a mid-run SIGKILL (bitwise WAL replay), and cross-gang plan adoption.
+    fleet_load_result = None
+    if args.algo is None and args.wire is None:
+        import fleet_load
+
+        fleet_load_result = fleet_load.run_lane(
+            tempfile.mkdtemp(prefix="bagua_fleet_load_"),
+            args.out + "_fleet_load.json",
+        )
     fsdp_result = None if args.ddp_only else audit_fsdp()[0]
 
     trace = load_trace_overlap()
@@ -1880,7 +1891,8 @@ def main():
              "retrace_lint": retrace_lint_result,
              "bench_modeled": bench_modeled_result,
              "fleet_sim": fleet_sim_result,
-             "resilience": resilience_result},
+             "resilience": resilience_result,
+             "fleet_load": fleet_load_result},
             f, indent=1,
         )
     with open(args.out + ".md", "w") as f:

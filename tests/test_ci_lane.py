@@ -45,6 +45,7 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert "retrace-hazard lint passed" in proc.stderr
     assert "bench modeled lane passed" in proc.stderr
     assert "fleet sim lane passed" in proc.stderr
+    assert "fleet load lane passed" in proc.stderr
 
     # The telemetry smoke emits a JSONL metrics stream next to --out; hold it
     # to the event schema here too (belt and braces: the subprocess already
@@ -179,6 +180,32 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     )
     assert fleet["flap_breaker"]["times_opened"] >= 1
     assert fleet["flap_breaker"]["final_state"] == "closed"
+
+    # The fleet control-plane load lane's artifact: ≥8 simulated gangs on one
+    # WAL-backed multi-tenant server — zero cross-gang leakage under the
+    # adversarial probe, raw 429s under the hammer while the paced client's
+    # breaker never counts one, p99 RPC latency inside the gate, a mid-run
+    # SIGKILL whose WAL replay lands the durable dump bitwise-identical with
+    # rider clients observing the outage and recovering, and a second engine
+    # adopting the pre-kill cached plan at step 0 with plan_source="fleet".
+    with open(str(out) + "_fleet_load.json") as f:
+        fl = json.load(f)
+    assert fl["fleet_sim"]["n_gangs"] >= 8
+    assert fl["fleet_sim"]["healthy"] == fl["fleet_sim"]["n_gangs"]
+    assert fl["fleet_sim"]["churn_stale_ranks"] == [1]  # preempted rank surfaced
+    assert fl["scheduler"]["straggler"]["rank"] == 2
+    assert fl["scheduler"]["straggler"]["phase"] == "wire"
+    assert fl["isolation"]["leaks"] == 0 and fl["isolation"]["probes"] >= 6
+    assert fl["backpressure"]["denials_429"] >= 1
+    assert fl["backpressure"]["retry_after_s_min"] >= 1
+    assert fl["backpressure"]["paced_breaker_opened"] == 0
+    assert fl["latency"]["p99_ms"] <= fl["latency"]["gate_ms"]
+    assert fl["sigkill"]["dump_bitwise_identical"] is True
+    assert fl["sigkill"]["rider_failures"] >= 1
+    assert fl["sigkill"]["rider_breaker_opened"] >= 1
+    assert fl["plan_adoption"]["plan_source"] == "fleet"
+    assert fl["plan_adoption"]["published_before_kill"] is True
+    assert audit["fleet_load"] == fl
 
 
 def test_perf_audit_quick_bytegrad_compressed_census(tmp_path):

@@ -1,0 +1,647 @@
+"""Fleet control plane: multi-tenant namespaces, crash-safe WAL replay,
+leases + backpressure, the cross-gang plan cache, and the scheduler view.
+
+Pins the PR-13 contract end to end:
+
+* per-gang isolation — rendezvous/KV/blob/autotune state scoped by gang id;
+  an adversarial cross-gang probe reads nothing and the unprefixed
+  single-tenant routes 404 on the fleet plane;
+* crash safety — a control plane killed (including SIGKILL mid-run with
+  live clients attached) and restarted on the same WAL dir replays to the
+  bitwise-identical durable dump, while the clients ride the outage out on
+  their retry/breaker machinery;
+* leases + admission — an untouched gang lease expiring GCs the whole
+  namespace (journaled, so a restart doesn't resurrect the dead); the
+  per-gang token bucket answers 429 + Retry-After, which ``retry_call``
+  paces on and the circuit breaker never counts as a failure;
+* the cross-gang plan cache — a second engine with the same (model
+  fingerprint, topology, algorithm, wire precision) adopts the first
+  gang's published plan at step 0 with ``plan_source="fleet"``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from email.message import Message
+
+import optax
+import pytest
+
+from helpers import free_port, worker_env
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.distributed.rendezvous import RendezvousClient
+from bagua_tpu.env import get_rpc_timeout_s
+from bagua_tpu.fleet import (
+    FleetClient,
+    FleetControlPlane,
+    TokenBucket,
+    WriteAheadLog,
+    adopt_fleet_plan,
+    engine_plan_key,
+    gang_endpoint,
+    model_fingerprint,
+    plan_cache_key,
+    publish_engine_plan,
+    start_fleet_server,
+)
+from bagua_tpu.models.mlp import init_mlp, mse_loss
+from bagua_tpu.observability import Telemetry, validate_metrics_file
+from bagua_tpu.observability.aggregate import StepSummary, gang_kv_key
+from bagua_tpu.observability.flight_recorder import flight_kv_key
+from bagua_tpu.resilience.retry import (
+    BackpressureError,
+    CircuitBreaker,
+    RetryPolicy,
+    retry_after_hint,
+    retry_call,
+)
+
+import jax  # noqa: E402  (after conftest pinned the CPU sim)
+
+LAYERS = [12, 16, 16, 4]
+RDZV_FAST = {"min_nodes": 1, "settle_s": 0.05}
+
+
+def _serve(plane):
+    server = start_fleet_server(plane, 0, host="127.0.0.1")
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def _get_json(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _canon(dump: dict) -> str:
+    return json.dumps(dump, sort_keys=True)
+
+
+def make_engine(group, bucket_size):
+    ddp = DistributedDataParallel(
+        mse_loss,
+        optax.sgd(0.1),
+        GradientAllReduceAlgorithm(),
+        process_group=group,
+        bucket_size_bytes=bucket_size,
+        overlap=False,
+    )
+    ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    return ddp
+
+
+def plan_names(ddp):
+    return [[td.name for td in b] for b in ddp.plan.declarations()]
+
+
+# ---------------- primitives: cache key, token bucket, retry hints -----------
+
+
+def test_plan_cache_key_is_injective_under_separators():
+    a = plan_cache_key("fp/1", "ranks8", "Algo", "f32")
+    b = plan_cache_key("fp", "1/ranks8", "Algo", "f32")
+    assert a != b  # a "/" inside a field never collides with the separator
+    assert plan_cache_key("fp", "ranks8", "Algo", "int8") != plan_cache_key(
+        "fp", "ranks8", "Algo", "f32"
+    )
+
+
+def test_token_bucket_paces_and_refills():
+    clk = [0.0]
+    bucket = TokenBucket(rate=2.0, burst=3.0, clock=lambda: clk[0])
+    assert [bucket.admit()[0] for _ in range(3)] == [True, True, True]
+    ok, retry_after = bucket.admit()
+    assert not ok and 0.0 < retry_after <= 0.5  # one token is 1/rate away
+    clk[0] += retry_after
+    assert bucket.admit()[0]
+    # rate <= 0 disables admission control entirely
+    off = TokenBucket(rate=0.0, burst=1.0, clock=lambda: clk[0])
+    assert all(off.admit() == (True, 0.0) for _ in range(100))
+
+
+def test_retry_after_hint_contract():
+    assert retry_after_hint(BackpressureError("shed", 3.5)) == 3.5
+    assert retry_after_hint(ValueError("nope")) is None
+
+    def http_error(code, headers=None):
+        hdrs = Message()
+        for k, v in (headers or {}).items():
+            hdrs[k] = v
+        return urllib.error.HTTPError("http://x", code, "msg", hdrs, None)
+
+    assert retry_after_hint(http_error(429, {"Retry-After": "2"})) == 2.0
+    assert retry_after_hint(http_error(429, {"Retry-After": "soon"})) == 0.0
+    assert retry_after_hint(http_error(429)) == 0.0  # still backpressure
+    assert retry_after_hint(http_error(503, {"Retry-After": "9"})) is None
+
+
+def test_rpc_timeout_env_knob(monkeypatch):
+    from bagua_tpu.service.autotune_client import AutotuneClient
+
+    monkeypatch.setenv("BAGUA_RPC_TIMEOUT_S", "3.5")
+    assert get_rpc_timeout_s() == 3.5
+    assert AutotuneClient(port=1).timeout == 3.5  # honors the shared knob
+    assert FleetClient("127.0.0.1:1").timeout_s == 3.5
+    assert AutotuneClient(port=1, timeout=2.0).timeout == 2.0  # explicit wins
+    monkeypatch.delenv("BAGUA_RPC_TIMEOUT_S")
+    assert get_rpc_timeout_s() == 10.0
+
+
+def test_retry_call_paces_on_hint_and_429_never_trips_the_breaker():
+    state = {"n": 0}
+
+    def shedding():
+        state["n"] += 1
+        if state["n"] <= 2:
+            raise BackpressureError("shed", retry_after_s=0.7)
+        return "ok"
+
+    sleeps = []
+    breaker = CircuitBreaker(failure_threshold=1, cooldown_s=99.0, name="bp")
+    policy = RetryPolicy(retries=3, base_s=0.001, max_s=2.0, seed=0)
+    assert retry_call(shedding, policy=policy, breaker=breaker, sleep=sleeps.append) == "ok"
+    # the server's hint floors the backoff (jitter would be ~1ms here)
+    assert len(sleeps) == 2 and all(0.7 <= s <= 2.0 for s in sleeps)
+    assert breaker.times_opened == 0 and breaker.state == "closed"
+
+    # a hostile hint is capped at the policy ceiling
+    def hostile():
+        raise BackpressureError("shed", retry_after_s=1e9)
+
+    sleeps2 = []
+    with pytest.raises(BackpressureError):
+        retry_call(
+            hostile,
+            policy=RetryPolicy(retries=2, base_s=0.001, max_s=0.25, seed=0),
+            sleep=sleeps2.append,
+        )
+    assert sleeps2 == [0.25, 0.25]
+
+    # a real connection failure still counts against the breaker
+    def down():
+        raise ConnectionRefusedError("down")
+
+    b2 = CircuitBreaker(failure_threshold=1, cooldown_s=99.0, name="down")
+    with pytest.raises(OSError):
+        retry_call(down, policy=RetryPolicy(retries=0), breaker=b2, sleep=lambda s: None)
+    assert b2.state == "open"
+
+
+# ---------------- multi-tenant isolation -------------------------------------
+
+
+def test_gang_isolation_and_unprefixed_probe_404():
+    plane = FleetControlPlane(rdzv_kwargs=RDZV_FAST)
+    server, base = _serve(plane)
+    try:
+        ep_a = gang_endpoint(base, "team-a/run1")  # "/" in the id round-trips
+        a = RendezvousClient(ep_a, node_rank=0, timeout_s=15.0)
+        b = RendezvousClient(gang_endpoint(base, "team-b"), node_rank=0, timeout_s=15.0)
+        asn = a.wait_assignment(nslots=4, incarnation=1)
+        assert asn["settled"] and asn["world_size"] == 4
+        a.kv_set("secret", "a-only")
+        req = urllib.request.Request(
+            ep_a + "/rdzv/blob/ckpt", data=b"gang-a-weights", method="PUT"
+        )
+        assert _get_json_req(req)["ok"]
+
+        # adversarial cross-gang probe: B sees none of A's state
+        assert b.kv_get("secret") is None
+        assert b._call("/rdzv/assignment")["settled"] is False
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                gang_endpoint(base, "team-b") + "/rdzv/blob/ckpt", timeout=10
+            )
+        assert ei.value.code == 404
+        # while A reads its own blob back
+        with urllib.request.urlopen(ep_a + "/rdzv/blob/ckpt", timeout=10) as resp:
+            assert resp.read() == b"gang-a-weights"
+
+        # the single-tenant route table is NOT mounted at the root
+        for probe in ("/rdzv/assignment", "/rdzv/kv/secret", "/api/v1/health_check"):
+            with pytest.raises(urllib.error.HTTPError) as e404:
+                urllib.request.urlopen(base + probe, timeout=10)
+            assert e404.value.code == 404
+
+        # each gang tunes against its own AutotuneTaskManager pool
+        from bagua_tpu.defs import TensorDeclaration
+
+        fc = FleetClient(base)
+        at_a = fc.autotune_client("team-a/run1")
+        assert at_a.wait_until_ready(max_wait_s=10.0)
+        at_a.register_tensors(
+            "mlp", [TensorDeclaration(name="w0", num_elements=128, dtype="f32")]
+        )
+        assert plane.gang("team-a/run1").autotune_models == ["mlp"]
+        assert plane.gang("team-b").autotune_models == []
+
+        health = fc.health()
+        assert health["status"] == "ok" and health["gangs"] == 2
+        assert fc.gangs()["gangs"] == ["team-a/run1", "team-b"]
+    finally:
+        server.shutdown()
+
+
+def _get_json_req(req, timeout=10.0):
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# ---------------- WAL: replay, compaction, torn tails ------------------------
+
+
+def _populate(plane):
+    ns = plane.gang("alpha")
+    ns.rendezvous.join(0, 2, 1)
+    ns.rendezvous.join(1, 2, 1)
+    time.sleep(0.08)
+    assert ns.rendezvous.assignment()["settled"]
+    for i in range(4):
+        ns.rendezvous.kv_set(f"ck/{i}", i)
+    ns.rendezvous.blob_set("weights", b"\x00\x01" * 64)
+    plane.gang("beta").rendezvous.kv_set("other", "b")
+    plane.plan_put(
+        fingerprint="fp", topology="ranks4", algorithm="A", wire_precision="f32",
+        plan={"buckets": [["w"]]}, meta={"gang": "alpha"},
+    )
+
+
+def test_wal_replay_restores_durable_state_bitwise(tmp_path):
+    wal_dir = str(tmp_path / "wal")
+    plane = FleetControlPlane(wal_dir=wal_dir, rdzv_kwargs=RDZV_FAST)
+    _populate(plane)
+    pre = plane.dump()
+    # no close(), no compaction: the "crash" leaves only the appended log
+    plane2 = FleetControlPlane(wal_dir=str(tmp_path / "wal"), rdzv_kwargs=RDZV_FAST)
+    assert _canon(plane2.dump()) == _canon(pre)
+    # the replayed store is live, not a husk: reads and writes both work
+    st = plane2.gang("alpha").rendezvous
+    assert st.kv_get("ck/3") == 3
+    assert st.blob_get("weights") == b"\x00\x01" * 64
+    asn = st.assignment()
+    assert asn["settled"] and asn["world_size"] == 4
+    plane2.gang("alpha").rendezvous.kv_set("post", "restart")
+    plane3 = FleetControlPlane(wal_dir=wal_dir, rdzv_kwargs=RDZV_FAST)
+    assert plane3.gang("alpha").rendezvous.kv_get("post") == "restart"
+
+
+def test_wal_compaction_truncates_log_and_preserves_replay(tmp_path):
+    wal_dir = str(tmp_path / "wal")
+    plane = FleetControlPlane(wal_dir=wal_dir, compact_every=3, rdzv_kwargs=RDZV_FAST)
+    _populate(plane)
+    assert plane.wal.needs_compact()
+    assert plane.maybe_compact()
+    assert plane.wal.compactions == 1
+    assert os.path.exists(plane.wal.snapshot_path)
+    assert os.path.getsize(plane.wal.wal_path) == 0  # folded into the snapshot
+    pre = plane.dump()
+
+    # writes after compaction land in the (fresh) log and replay on top
+    plane.gang("alpha").rendezvous.kv_set("late", "write")
+    plane2 = FleetControlPlane(wal_dir=wal_dir, rdzv_kwargs=RDZV_FAST)
+    assert plane2.gang("alpha").rendezvous.kv_get("late") == "write"
+
+    # crash between snapshot replace and log truncate: stale records whose
+    # seq <= the snapshot's last_seq are skipped on replay, not re-applied
+    with open(plane.wal.wal_path, "a") as f:
+        f.write(json.dumps({"op": "kv", "gang": "alpha", "key": "ck/0",
+                            "value": "stale", "seq": 1}) + "\n")
+    plane3 = FleetControlPlane(wal_dir=wal_dir, rdzv_kwargs=RDZV_FAST)
+    assert plane3.gang("alpha").rendezvous.kv_get("ck/0") == 0  # not "stale"
+    assert plane3.gang("alpha").rendezvous.kv_get("late") == "write"
+    del pre
+
+
+def test_wal_torn_tail_is_dropped(tmp_path):
+    wal_dir = str(tmp_path / "wal")
+    plane = FleetControlPlane(wal_dir=wal_dir, rdzv_kwargs=RDZV_FAST)
+    _populate(plane)
+    pre = plane.dump()
+    with open(plane.wal.wal_path, "a") as f:
+        f.write('{"op": "kv", "gang": "alpha", "key": "torn", "va')  # mid-append kill
+    plane2 = FleetControlPlane(wal_dir=wal_dir, rdzv_kwargs=RDZV_FAST)
+    assert _canon(plane2.dump()) == _canon(pre)
+    assert plane2.gang("alpha").rendezvous.kv_get("torn") is None
+    # the torn-tail store still accepts appends and replays them
+    plane2.gang("alpha").rendezvous.kv_set("after-torn", 1)
+    plane3 = FleetControlPlane(wal_dir=wal_dir, rdzv_kwargs=RDZV_FAST)
+    assert plane3.gang("alpha").rendezvous.kv_get("after-torn") == 1
+
+
+def test_wal_object_roundtrip(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "w"), compact_every=100)
+    assert wal.load() == (None, [])
+    seqs = [wal.append({"op": "kv", "key": str(i)}) for i in range(5)]
+    assert seqs == [1, 2, 3, 4, 5]
+    wal.close()
+    wal2 = WriteAheadLog(str(tmp_path / "w"))
+    snapshot, records = wal2.load()
+    assert snapshot is None and [r["key"] for r in records] == [str(i) for i in range(5)]
+    assert wal2.append({"op": "kv", "key": "next"}) == 6  # seq continues
+    wal2.compact({"folded": True})
+    snapshot, records = WriteAheadLog(str(tmp_path / "w")).load()
+    assert snapshot == {"folded": True} and records == []
+
+
+# ---------------- leases + admission control ---------------------------------
+
+
+def test_lease_expiry_gcs_namespace_and_survives_restart(tmp_path):
+    clk = [0.0]
+    wal_dir = str(tmp_path / "wal")
+    kwargs = dict(wal_dir=wal_dir, lease_ttl_s=10.0, clock=lambda: clk[0],
+                  rdzv_kwargs=RDZV_FAST)
+    plane = FleetControlPlane(**kwargs)
+    plane.gang("doomed").rendezvous.kv_set("k", "v")
+    clk[0] = 5.0
+    plane.gang("alive")  # touched at t=5: lease runs to t=15
+    clk[0] = 12.0  # "doomed"'s lease (t=10) expired, "alive"'s has not
+    assert plane.sweep_leases() == ["doomed"]
+    assert plane.gang_ids() == ["alive"] and plane.gangs_gcd == 1
+    # the GC is journaled: a restart must not resurrect the dead namespace
+    plane2 = FleetControlPlane(**kwargs)
+    assert plane2.gang_ids() == ["alive"]
+    # ...and a gang re-created after GC starts from scratch
+    assert plane2.gang("doomed").rendezvous.kv_get("k") is None
+
+
+def test_backpressure_429_and_paced_ride_through():
+    plane = FleetControlPlane(rate=50.0, burst=5.0, rdzv_kwargs=RDZV_FAST)
+    server, base = _serve(plane)
+    try:
+        # raw hammer past the burst: the contract is 429 + Retry-After
+        denied = None
+        for _ in range(40):
+            try:
+                _get_json(base + "/g/hot/rdzv/kv/k")
+            except urllib.error.HTTPError as e:
+                assert e.code == 429
+                assert int(e.headers["Retry-After"]) >= 1
+                assert retry_after_hint(e) is not None
+                denied = json.loads(e.read())
+                break
+        assert denied is not None and denied["error"] == "backpressure"
+        assert denied["retry_after_s"] > 0
+        assert plane.backpressure_denials >= 1
+
+        # a paced client rides straight through: every write lands, and the
+        # breaker never opens (429s are recorded as successes)
+        client = RendezvousClient(gang_endpoint(base, "hot"), node_rank=0, timeout_s=10.0)
+        breaker = CircuitBreaker(failure_threshold=2, cooldown_s=30.0, name="ride")
+        policy = RetryPolicy(retries=8, base_s=0.001, max_s=0.5, seed=0)
+        for i in range(25):
+            retry_call(
+                client._call_once, f"/rdzv/kv/w{i}", {"value": i},
+                policy=policy, breaker=breaker,
+            )
+        assert breaker.times_opened == 0 and breaker.state == "closed"
+        st = plane.gang("hot").rendezvous
+        assert [st.kv_get(f"w{i}") for i in range(25)] == list(range(25))
+    finally:
+        server.shutdown()
+
+
+# ---------------- scheduler view ---------------------------------------------
+
+
+def test_scheduler_view_verdicts():
+    clk = [100.0]
+    plane = FleetControlPlane(lease_ttl_s=50.0, clock=lambda: clk[0],
+                              rdzv_kwargs=RDZV_FAST)
+
+    def push(gang, attempt, rank, step, p50, phase_ms=None):
+        plane.gang(gang).rendezvous.kv_set(
+            gang_kv_key(attempt, rank),
+            StepSummary(rank=rank, step=step, p50_ms=p50,
+                        phase_ms=phase_ms or {}).payload(),
+        )
+
+    # healthy: tight p50 spread on the NEWEST attempt; the dead incarnation's
+    # wildly-skewed numbers (older attempt, lower step) must be ignored
+    push("healthy", "0", 0, 5, 100.0)
+    push("healthy", "0", 1, 5, 1.0)
+    push("healthy", "1", 0, 100, 10.0)
+    push("healthy", "1", 1, 100, 11.0)
+    # straggler: rank 1's p50 is 1.6x the gang median, slowest phase tagged
+    push("strag", "0", 0, 40, 10.0)
+    push("strag", "0", 1, 40, 40.0, phase_ms={"h2d": 30.0, "compute": 5.0})
+    # wedged: a flight digest landed (beats an otherwise-healthy summary set)
+    push("wedged", "0", 0, 7, 10.0)
+    plane.gang("wedged").rendezvous.kv_set(flight_kv_key("0", 1), {"hang": True})
+    plane.gang("idle")
+
+    view = plane.scheduler_view()
+    assert view["n_gangs"] == 4
+    gangs = view["gangs"]
+    assert gangs["healthy"]["verdict"] == "healthy"
+    assert gangs["healthy"]["max_step"] == 100
+    assert gangs["healthy"]["ranks_reporting"] == 2
+    assert gangs["healthy"]["straggler"] is None
+    assert gangs["strag"]["verdict"] == "straggler"
+    assert gangs["strag"]["straggler"]["rank"] == 1
+    assert gangs["strag"]["straggler"]["phase"] == "h2d"
+    assert gangs["wedged"]["verdict"] == "wedged"
+    assert gangs["wedged"]["flight_ranks"] == ["rank1"]
+    assert gangs["idle"]["verdict"] == "idle"
+    assert gangs["idle"]["ranks_reporting"] == 0
+    assert all(g["lease_remaining_s"] == 50.0 for g in gangs.values())
+
+
+# ---------------- cross-gang plan cache --------------------------------------
+
+
+def test_cross_gang_plan_adoption_at_step_zero(group, tmp_path):
+    plane = FleetControlPlane(rdzv_kwargs=RDZV_FAST)
+    server, base = _serve(plane)
+    ddp_a = make_engine(group, bucket_size=1 << 9)   # many small buckets
+    ddp_b = make_engine(group, bucket_size=1 << 20)  # one fat bucket
+    try:
+        assert plan_names(ddp_a) != plan_names(ddp_b)  # genuinely different plans
+        fc = FleetClient(base)
+        key = publish_engine_plan(fc, ddp_a, meta={"gang": "alpha", "step": 500})
+        assert key is not None and plane.plan_count() == 1
+
+        # same (fingerprint, topology, algorithm, wire precision) tuple: the
+        # new gang adopts the proven plan before its first step
+        assert engine_plan_key(ddp_b) == engine_plan_key(ddp_a)
+        jsonl = str(tmp_path / "m.jsonl")
+        tel = Telemetry(metrics_jsonl=jsonl)
+        assert adopt_fleet_plan(fc, ddp_b, telemetry=tel) == "fleet"
+        assert plan_names(ddp_b) == plan_names(ddp_a)
+        tel.close()
+        assert validate_metrics_file(jsonl) == []
+        (restart,) = [
+            json.loads(l) for l in open(jsonl) if '"restart"' in l
+        ]
+        assert restart["step"] == 0 and restart["plan_source"] == "fleet"
+        assert restart["lost_steps"] == 0
+        assert restart["old_world_size"] == restart["new_world_size"] == group.size
+
+        # a lookup miss (different model) is advisory: None, plan untouched
+        entry = fc.lookup_plan(
+            fingerprint=model_fingerprint([]), topology=f"ranks{group.size}",
+            algorithm="GradientAllReduceAlgorithm", wire_precision="f32",
+        )
+        assert entry is None
+
+        # the cached entry carries its key + meta for the fleet operator
+        hit = fc.lookup_plan(**engine_plan_key(ddp_a))
+        assert hit["found"] and hit["meta"] == {"gang": "alpha", "step": 500}
+        assert hit["key"]["topology"] == f"ranks{group.size}"
+    finally:
+        ddp_a.shutdown()
+        ddp_b.shutdown()
+        server.shutdown()
+
+
+def test_fleet_warm_start_via_resume_coordinator(group, tmp_path):
+    from bagua_tpu.resilience.resume import ElasticResumeCoordinator
+
+    ddp_a = make_engine(group, bucket_size=1 << 9)
+    ddp_b = make_engine(group, bucket_size=1 << 20)
+    try:
+        payload = ddp_a.export_plan_payload()
+        jsonl = str(tmp_path / "m.jsonl")
+        tel = Telemetry(metrics_jsonl=jsonl)
+        coord = ElasticResumeCoordinator(
+            str(tmp_path / "snaps"), telemetry=tel,
+            fleet_plan_fn=lambda: payload,
+        )
+        assert coord.fleet_warm_start(ddp_b) == "fleet"
+        assert plan_names(ddp_b) == plan_names(ddp_a)
+        tel.close()
+        assert validate_metrics_file(jsonl) == []
+        (restart,) = [json.loads(l) for l in open(jsonl) if '"restart"' in l]
+        assert restart["plan_source"] == "fleet" and restart["step"] == 0
+
+        # no hook / a broken hook / a miss: all advisory, all None
+        assert ElasticResumeCoordinator(
+            str(tmp_path / "s2")
+        ).fleet_warm_start(ddp_b) is None
+        assert ElasticResumeCoordinator(
+            str(tmp_path / "s3"), fleet_plan_fn=lambda: None
+        ).fleet_warm_start(ddp_b) is None
+
+        def boom():
+            raise ConnectionRefusedError("fleet down")
+
+        assert ElasticResumeCoordinator(
+            str(tmp_path / "s4"), fleet_plan_fn=boom
+        ).fleet_warm_start(ddp_b) is None
+    finally:
+        ddp_a.shutdown()
+        ddp_b.shutdown()
+
+
+# ---------------- SIGKILL + restart with live clients ------------------------
+
+
+def _server_cmd(port, wal_dir):
+    return [
+        sys.executable, "-m", "bagua_tpu.fleet.server",
+        "--port", str(port), "--host", "127.0.0.1",
+        "--wal-dir", wal_dir, "--settle-s", "0.05", "--lease-ttl-s", "600",
+    ]
+
+
+def _wait_health(port, deadline_s=120.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            out = _get_json(f"http://127.0.0.1:{port}/fleet/health", timeout=2.0)
+            if out.get("status") == "ok":
+                return
+        except (OSError, ValueError):
+            time.sleep(0.2)
+    raise TimeoutError(f"fleet server on port {port} never became healthy")
+
+
+@pytest.mark.slow
+def test_sigkill_restart_replays_wal_with_live_clients(tmp_path):
+    port = free_port()
+    wal_dir = str(tmp_path / "wal")
+    env = worker_env(JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        _server_cmd(port, wal_dir), env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    proc2 = None
+    try:
+        _wait_health(port)
+        base = f"http://127.0.0.1:{port}"
+        alpha = RendezvousClient(gang_endpoint(base, "alpha"), node_rank=0,
+                                 timeout_s=30.0)
+        alpha.wait_assignment(nslots=2, incarnation=1)
+        for i in range(5):
+            alpha.kv_set(f"ck/{i}", i)
+        req = urllib.request.Request(
+            gang_endpoint(base, "alpha") + "/rdzv/blob/weights",
+            data=b"\x07" * 256, method="PUT",
+        )
+        _get_json_req(req)
+        gamma = RendezvousClient(gang_endpoint(base, "gamma"), node_rank=0,
+                                 timeout_s=30.0)
+        gamma.kv_set("x", "y")
+        pre = _get_json(base + "/fleet/dump")
+        assert pre["n_gangs"] == 2
+
+        # a live client keeps hammering across the outage: its breaker
+        # absorbs the dead window, then it recovers on its own
+        stop, restarted = threading.Event(), threading.Event()
+        counts = {"fail": 0, "ok_after_restart": 0}
+        breaker = CircuitBreaker(failure_threshold=2, cooldown_s=0.1, name="rider")
+        policy = RetryPolicy(retries=1, base_s=0.01, max_s=0.05)
+
+        def rider():
+            while not stop.is_set():
+                try:
+                    retry_call(
+                        alpha._call_once, "/rdzv/heartbeat", {"node_rank": 0},
+                        policy=policy, breaker=breaker,
+                    )
+                    if restarted.is_set():
+                        counts["ok_after_restart"] += 1
+                except Exception:
+                    counts["fail"] += 1
+                time.sleep(0.02)
+
+        t = threading.Thread(target=rider, daemon=True)
+        t.start()
+        time.sleep(0.3)  # let the rider see the healthy server first
+        proc.kill()  # SIGKILL: no shutdown hook, no final compaction
+        proc.wait(timeout=30)
+        time.sleep(0.5)  # the rider must observe the outage
+        assert counts["fail"] >= 1
+
+        proc2 = subprocess.Popen(
+            _server_cmd(port, wal_dir), env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        _wait_health(port)
+        restarted.set()
+        deadline = time.monotonic() + 30.0
+        while counts["ok_after_restart"] < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        stop.set()
+        t.join(timeout=10)
+        assert counts["ok_after_restart"] >= 3  # the same client recovered
+        assert breaker.times_opened >= 1  # the outage was breaker-absorbed
+
+        # the WAL replay is exact: same durable dump, bit for bit
+        post = _get_json(base + "/fleet/dump")
+        assert _canon(post) == _canon(pre)
+        # and the replayed state is live
+        assert alpha.kv_get("ck/3") == 3
+        asn = alpha._call("/rdzv/assignment")
+        assert asn["settled"] and asn["world_size"] == 2
+        assert gamma.kv_get("x") == "y"
+    finally:
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)

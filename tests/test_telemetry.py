@@ -821,3 +821,67 @@ def test_trace_analyzer_per_scope_rows(tmp_path):
     assert any(op.startswith("collective-permute") for op in row["hlo_ops"])
     # the mp-labeled collectives are not double-counted as bucket exchanges
     assert report["per_bucket"] == []
+
+
+# -- circuit-breaker transition telemetry -------------------------------------
+
+
+def test_breaker_transition_event_schema():
+    ok = {"ts": 1.0, "event": "breaker_transition", "step": 2,
+          "breaker": "fleet-rpc", "old_state": "closed", "new_state": "open"}
+    assert validate_metrics_event(ok) == []
+    missing = dict(ok)
+    del missing["new_state"]
+    assert any("'new_state'" in p for p in validate_metrics_event(missing))
+    badtype = dict(ok, old_state=1)
+    assert any("'old_state'" in p for p in validate_metrics_event(badtype))
+
+
+def test_breaker_transitions_land_on_telemetry(tmp_path):
+    """A full breaker cycle (closed -> open -> half-open -> closed) lands on
+    every telemetry surface: the shared + per-breaker state gauges, the
+    transition counter, schema-valid JSONL events, and the Prometheus
+    export.  ``bind_breaker`` is idempotent and never usurps a listener."""
+    from bagua_tpu.resilience.retry import CircuitBreaker, CircuitOpenError
+
+    path = str(tmp_path / "b.jsonl")
+    tel = Telemetry(metrics_jsonl=path)
+    tel.current_step = 12
+    clk = [0.0]
+    breaker = CircuitBreaker(failure_threshold=2, cooldown_s=5.0,
+                             name="auto-rpc", clock=lambda: clk[0])
+    tel.bind_breaker(breaker)
+    assert breaker.listener == tel.on_breaker_transition
+    tel.bind_breaker(breaker)  # idempotent
+    assert breaker.listener == tel.on_breaker_transition
+    taken = CircuitBreaker(name="other", listener=lambda *a: None)
+    already = taken.listener
+    tel.bind_breaker(taken)  # an explicit listener is left alone
+    assert taken.listener is already
+
+    breaker.record_failure()  # 1/2: still closed, no transition
+    breaker.record_failure()  # 2/2: closed -> open
+    assert tel.registry.snapshot()["breaker_state"] == 2.0
+    with pytest.raises(CircuitOpenError):
+        breaker.before_call()  # still cooling down: no transition
+    clk[0] = 6.0
+    breaker.before_call()  # cooldown over: open -> half-open (the probe)
+    assert tel.registry.snapshot()["breaker_state"] == 1.0
+    breaker.record_success()  # probe landed: half-open -> closed
+    tel.close()
+
+    snap = tel.registry.snapshot()
+    assert snap["breaker_state"] == 0.0
+    assert snap["breaker_state_auto_rpc"] == 0.0  # name sanitized for the gauge
+    assert snap["breaker_transitions_total"] == 3
+
+    assert validate_metrics_file(path) == []
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    trans = [e for e in events if e["event"] == "breaker_transition"]
+    assert [(e["old_state"], e["new_state"]) for e in trans] == [
+        ("closed", "open"), ("open", "half-open"), ("half-open", "closed")]
+    assert all(e["breaker"] == "auto-rpc" and e["step"] == 12 for e in trans)
+
+    prom = tel.registry.to_prometheus()
+    assert "bagua_breaker_state 0" in prom
+    assert "bagua_breaker_transitions_total 3" in prom
