@@ -175,6 +175,15 @@ class _JournaledState(RendezvousState):
                  "b64": base64.b64encode(data).decode("ascii")}
             )
 
+    def blob_get(self, key: str) -> "Optional[bytes]":
+        # No LRU touch (unlike the single-tenant base class): reads are not
+        # journaled, so eviction order must be a pure function of the
+        # journaled sets (FIFO by insertion, re-set moves to the back — the
+        # exact order replay_blob reconstructs) or a replayed server would
+        # evict a different key than the one it ran before the crash.
+        with self._lock:
+            return self._blobs.get(key)
+
     # -- replay (no journaling) -----------------------------------------------
 
     def replay_kv(self, key: str, value) -> None:
@@ -288,10 +297,14 @@ class FleetControlPlane:
     def maybe_compact(self) -> bool:
         """Fold the WAL into a snapshot when due.  Called with no locks
         held (the HTTP layer, after replying): the full-fleet dump below
-        takes the fleet lock and every gang lock in turn."""
+        takes the fleet lock and every gang lock in turn.  The WAL cursor
+        is captured *before* the dump — handler threads keep acknowledging
+        mutations while we walk the gangs, and anything they journal past
+        the cursor must outlive the compaction in the rewritten log."""
         if self.wal is None or not self.wal.needs_compact():
             return False
-        self.wal.compact(self._snapshot_state())
+        as_of = self.wal.cursor()
+        self.wal.compact(self._snapshot_state(), as_of_seq=as_of)
         logger.info("WAL compacted (#%d)", self.wal.compactions)
         return True
 
@@ -397,6 +410,13 @@ class FleetControlPlane:
         if not ok:
             with self._lock:
                 self.backpressure_denials += 1
+                # A denial must not starve the lease: admission runs before
+                # fleet.gang(), so a live gang held in backpressure (or
+                # pacing on Retry-After) past the TTL would otherwise get
+                # its whole durable namespace reaped.  Touch known gangs
+                # only — a denied request never *creates* a namespace.
+                if gang_id in self._gangs:
+                    self._leases[gang_id] = self._clock() + self.lease_ttl_s
         return ok, retry_after
 
     def sweep_leases(self, min_interval_s: float = 1.0) -> List[str]:
@@ -417,9 +437,15 @@ class FleetControlPlane:
                     self._leases.pop(gang_id, None)
                     self._buckets.pop(gang_id, None)
                     self.gangs_gcd += 1
+                    # Journal inside the removal's critical section (the WAL
+                    # lock is a leaf, so this is deadlock-free): journaling
+                    # after releasing the fleet lock would let a concurrent
+                    # recreation journal its gang/kv records first, and
+                    # replay would then GC a gang the pre-crash server
+                    # considered alive.
+                    self.journal({"op": "gang_gc", "gang": gang_id})
         for gang_id in reaped:
             logger.warning("gang %r: lease expired; namespace GC'd", gang_id)
-            self.journal({"op": "gang_gc", "gang": gang_id})
         return reaped
 
     def gang_ids(self) -> List[str]:
@@ -548,5 +574,6 @@ class FleetControlPlane:
 
     def close(self) -> None:
         if self.wal is not None:
-            self.wal.compact(self._snapshot_state())
+            as_of = self.wal.cursor()
+            self.wal.compact(self._snapshot_state(), as_of_seq=as_of)
             self.wal.close()

@@ -6,14 +6,21 @@ survive a crash is appended (seq-numbered, one JSON object per line) to
 server loads ``snapshot.json`` and replays the records past it, arriving at
 the exact pre-crash fleet state.  Compaction folds the log into a fresh
 snapshot using the snapshot.py discipline — write ``snapshot.json.tmp.<pid>``,
-``os.replace`` into place, *then* truncate the log — so every crash point
-leaves a loadable pair:
+``os.replace`` into place, *then* rewrite the log keeping only records
+newer than the snapshot's ``last_seq`` — so every crash point leaves a
+loadable pair:
 
-* crash before the replace: old snapshot + full log (nothing lost);
-* crash between replace and truncate: new snapshot + a log whose records
-  are all ``<= last_seq`` (replay skips them — records are idempotent
-  against the snapshot that already contains them);
-* crash after truncate: new snapshot + empty log.
+* crash before the snapshot replace: old snapshot + full log (nothing lost);
+* crash between the two replaces: new snapshot + the full log; replay
+  skips records ``<= last_seq`` (they are idempotent against the snapshot
+  that already contains them) and applies the rest;
+* crash after the log replace: new snapshot + the preserved suffix.
+
+The caller's state dump is not atomic with ongoing appends, so ``compact``
+takes the seq the caller captured *before* dumping (``as_of_seq``): every
+record acknowledged after that capture may be missing from the dump and
+must survive in the rewritten log — stamping ``last_seq`` at compact time
+instead would silently drop it.
 
 Appends ``flush()`` to the OS page cache by default, which survives the
 process being SIGKILLed (the failure mode the fleet lane induces); set
@@ -85,7 +92,7 @@ class WriteAheadLog:
                         f.truncate(valid_end)
             self._seq = max(last_seq, *(int(r["seq"]) for r in records)) if records else last_seq
             self._records_since_compact = len(records)
-            self._open_locked(append=True)
+            self._open_locked()
             return snapshot, records
 
     # -- append ----------------------------------------------------------------
@@ -94,7 +101,7 @@ class WriteAheadLog:
         """Durably append one record; returns its assigned ``seq``."""
         with self._lock:
             if self._fh is None:
-                self._open_locked(append=True)
+                self._open_locked()
             self._seq += 1
             record = dict(record, seq=self._seq)
             self._fh.write(json.dumps(record, sort_keys=True) + "\n")
@@ -108,24 +115,50 @@ class WriteAheadLog:
         with self._lock:
             return self._records_since_compact >= self.compact_every
 
+    def cursor(self) -> int:
+        """Newest seq issued so far.  Capture this *before* dumping state
+        for :meth:`compact`: any record appended during the dump gets a
+        higher seq and is preserved by the compaction instead of being
+        covered by ``last_seq`` while absent from the snapshot."""
+        with self._lock:
+            return self._seq
+
     # -- compaction ------------------------------------------------------------
 
-    def compact(self, state: Dict) -> None:
-        """Fold the log into ``state`` (the caller's full dump, which must
-        already include every acknowledged record): atomically publish the
-        snapshot, then truncate the log."""
+    def compact(self, state: Dict, as_of_seq: Optional[int] = None) -> None:
+        """Fold the log into ``state`` — the caller's dump, which must
+        include every record acknowledged up to ``as_of_seq`` (default: the
+        seq at call time, only safe when no appends can race the dump).
+        Atomically publish the snapshot, then rewrite the log keeping the
+        records newer than ``as_of_seq``: they may be missing from the dump
+        and replaying them is idempotent even when the dump caught them."""
         with self._lock:
+            as_of = self._seq if as_of_seq is None else int(as_of_seq)
             tmp = f"{self.snapshot_path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
-                json.dump({"last_seq": self._seq, "state": state}, f, sort_keys=True)
+                json.dump({"last_seq": as_of, "state": state}, f, sort_keys=True)
                 f.flush()
                 if self.fsync:
                     os.fsync(f.fileno())
             os.replace(tmp, self.snapshot_path)
+            kept: List[str] = []
+            if as_of < self._seq and os.path.exists(self.wal_path):
+                with open(self.wal_path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line and int(json.loads(line).get("seq", 0)) > as_of:
+                            kept.append(line)
             if self._fh is not None:
                 self._fh.close()
-            self._open_locked(append=False)  # truncate
-            self._records_since_compact = 0
+            wal_tmp = f"{self.wal_path}.tmp.{os.getpid()}"
+            with open(wal_tmp, "w") as f:
+                f.write("".join(line + "\n" for line in kept))
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            os.replace(wal_tmp, self.wal_path)
+            self._open_locked()
+            self._records_since_compact = len(kept)
             self.compactions += 1
 
     def close(self) -> None:
@@ -134,5 +167,5 @@ class WriteAheadLog:
                 self._fh.close()
                 self._fh = None
 
-    def _open_locked(self, append: bool) -> None:
-        self._fh = open(self.wal_path, "a" if append else "w")
+    def _open_locked(self) -> None:
+        self._fh = open(self.wal_path, "a")

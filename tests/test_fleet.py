@@ -317,6 +317,38 @@ def test_wal_compaction_truncates_log_and_preserves_replay(tmp_path):
     del pre
 
 
+def test_compaction_keeps_records_acknowledged_during_dump(tmp_path):
+    """The compaction race: a mutation acknowledged between the state dump
+    and the snapshot's ``last_seq`` stamp must survive in the rewritten log
+    — covering it with a stamp taken at compact time would silently drop a
+    durable record on the next restart."""
+    wal_dir = str(tmp_path / "wal")
+    plane = FleetControlPlane(wal_dir=wal_dir, compact_every=1, rdzv_kwargs=RDZV_FAST)
+    plane.gang("alpha").rendezvous.kv_set("early", 1)
+    orig = plane._snapshot_state
+
+    def racy_dump():
+        state = orig()
+        # simulates a handler thread acknowledging a write mid-compaction
+        plane.gang("alpha").rendezvous.kv_set("late", "survives")
+        return state
+
+    plane._snapshot_state = racy_dump
+    assert plane.maybe_compact()
+    plane._snapshot_state = orig
+    # the racing record is missing from the snapshot but preserved in the log
+    snap = json.load(open(plane.wal.snapshot_path))
+    assert "late" not in snap["state"]["gangs"]["alpha"]["kv"]
+    kept = [json.loads(l) for l in open(plane.wal.wal_path)]
+    assert [r["key"] for r in kept if r["op"] == "kv"] == ["late"]
+    assert all(r["seq"] > snap["last_seq"] for r in kept)
+
+    plane2 = FleetControlPlane(wal_dir=wal_dir, rdzv_kwargs=RDZV_FAST)
+    assert plane2.gang("alpha").rendezvous.kv_get("late") == "survives"
+    assert plane2.gang("alpha").rendezvous.kv_get("early") == 1
+    assert _canon(plane2.dump()) == _canon(plane.dump())
+
+
 def test_wal_torn_tail_is_dropped(tmp_path):
     wal_dir = str(tmp_path / "wal")
     plane = FleetControlPlane(wal_dir=wal_dir, rdzv_kwargs=RDZV_FAST)
@@ -368,6 +400,73 @@ def test_lease_expiry_gcs_namespace_and_survives_restart(tmp_path):
     assert plane2.gang_ids() == ["alive"]
     # ...and a gang re-created after GC starts from scratch
     assert plane2.gang("doomed").rendezvous.kv_get("k") is None
+
+
+def test_gang_recreated_after_gc_survives_replay(tmp_path):
+    """The GC journal record is appended inside the removal's critical
+    section, so a recreation always journals *after* it — replay must end
+    with the recreated gang alive, not GC a gang the pre-crash server
+    considered living."""
+    clk = [0.0]
+    wal_dir = str(tmp_path / "wal")
+    kwargs = dict(wal_dir=wal_dir, lease_ttl_s=10.0, clock=lambda: clk[0],
+                  rdzv_kwargs=RDZV_FAST)
+    plane = FleetControlPlane(**kwargs)
+    plane.gang("g").rendezvous.kv_set("k", "old")
+    clk[0] = 12.0
+    assert plane.sweep_leases() == ["g"]
+    plane.gang("g").rendezvous.kv_set("k", "new")  # recreated after the GC
+    recs = [json.loads(l) for l in open(plane.wal.wal_path)]
+    gc_seq = next(r["seq"] for r in recs if r["op"] == "gang_gc")
+    assert gc_seq < max(r["seq"] for r in recs if r["op"] == "gang")
+
+    plane2 = FleetControlPlane(**kwargs)
+    assert plane2.gang_ids() == ["g"]
+    assert plane2.gang("g").rendezvous.kv_get("k") == "new"
+
+
+def test_blob_reads_do_not_perturb_replayed_eviction_order(tmp_path):
+    """Fleet-tier blob eviction is FIFO by *set* (reads never LRU-touch):
+    reads are not journaled, so eviction order must be a pure function of
+    the journaled ops or a replayed server evicts a different key than the
+    one it ran before the crash, breaking the bitwise dump witness."""
+    wal_dir = str(tmp_path / "wal")
+    kwargs = dict(wal_dir=wal_dir,
+                  rdzv_kwargs=dict(RDZV_FAST, max_blob_bytes=3 * 8))
+    plane = FleetControlPlane(**kwargs)
+    st = plane.gang("g").rendezvous
+    for k in ("b1", "b2", "b3"):
+        st.blob_set(k, k.encode() * 4)  # 8 bytes each: the cap holds 3
+    assert st.blob_get("b1") == b"b1b1b1b1"  # the read must not touch b1
+    st.blob_set("b4", b"b4b4b4b4")  # evicts the oldest set — b1, not b2
+    assert st.blob_get("b1") is None
+    assert sorted(st._blobs) == ["b2", "b3", "b4"]
+
+    pre = plane.dump()
+    plane2 = FleetControlPlane(**kwargs)
+    assert _canon(plane2.dump()) == _canon(pre)
+    assert plane2.gang("g").rendezvous.blob_get("b2") == b"b2b2b2b2"
+
+
+def test_backpressure_denials_keep_known_gang_lease_alive():
+    clk = [0.0]
+    plane = FleetControlPlane(lease_ttl_s=10.0, rate=0.001, burst=1.0,
+                              clock=lambda: clk[0], rdzv_kwargs=RDZV_FAST)
+    assert plane.admit("g")[0]  # burst token spent
+    plane.gang("g").rendezvous.kv_set("k", "v")  # lease runs to t=10
+    clk[0] = 8.0
+    ok, retry_after = plane.admit("g")
+    assert not ok and retry_after > 0
+    # the denial touched the lease (now t=18): a live gang held in
+    # backpressure past the TTL must not get its namespace reaped
+    clk[0] = 16.0
+    assert plane.sweep_leases() == []
+    assert plane.gang_ids() == ["g"]
+    assert plane.gang("g").rendezvous.kv_get("k") == "v"
+    # ...but a denied request for an unknown gang never creates state
+    assert plane.admit("ghost")[0]  # fresh bucket: the burst admits one
+    assert not plane.admit("ghost")[0]
+    assert plane.gang_ids() == ["g"] and "ghost" not in plane._leases
 
 
 def test_backpressure_429_and_paced_ride_through():
