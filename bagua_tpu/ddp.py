@@ -823,6 +823,11 @@ class DistributedDataParallel:
             self._step_fns = {}
         variant = self.impl.step_variant(self._host_step)
         tel = self.telemetry
+        if tel is not None:
+            # Open the sampled step's root trace span before anything the
+            # step does (compile, dispatch, RPCs) so it all hangs off one
+            # train_step trace.  Host-side only — bitwise-inert.
+            tel.on_step_start(self._host_step, variant=variant)
         fn = self._step_fns.get(variant)
         missed = fn is None
         if fn is None:

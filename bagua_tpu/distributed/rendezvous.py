@@ -560,36 +560,45 @@ class RendezvousClient:
         import urllib.request
 
         from bagua_tpu.env import get_rpc_timeout_s
+        from bagua_tpu.observability.tracing import client_span
 
         url = self.endpoint + path
-        if payload is None:
-            req = urllib.request.Request(url)
-        else:
-            req = urllib.request.Request(
-                url,
-                data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-        try:
-            with urllib.request.urlopen(req, timeout=get_rpc_timeout_s()) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            if e.code == 429:
-                # Fleet-plane admission control: convert to the typed
-                # backpressure signal so retry_call paces on the hint and
-                # the breaker never counts it as a failure.
-                from bagua_tpu.resilience.retry import BackpressureError, retry_after_hint
+        with client_span(
+            f"rpc {path}", component="rendezvous", endpoint=path
+        ) as (_sp, trace_headers):
+            if payload is None:
+                req = urllib.request.Request(url, headers=dict(trace_headers))
+            else:
+                req = urllib.request.Request(
+                    url,
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json", **trace_headers},
+                )
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=get_rpc_timeout_s()
+                ) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 429:
+                    # Fleet-plane admission control: convert to the typed
+                    # backpressure signal so retry_call paces on the hint and
+                    # the breaker never counts it as a failure.
+                    from bagua_tpu.resilience.retry import (
+                        BackpressureError, retry_after_hint,
+                    )
 
-                raise BackpressureError(
-                    f"{url}: 429 backpressure", retry_after_hint(e) or 0.0
-                ) from e
-            raise
+                    raise BackpressureError(
+                        f"{url}: 429 backpressure", retry_after_hint(e) or 0.0
+                    ) from e
+                raise
 
     def _call(self, path: str, payload: Optional[dict] = None) -> dict:
         from bagua_tpu.resilience.retry import retry_call
 
         return retry_call(
-            self._call_once, path, payload, policy=self._retry_policy
+            self._call_once, path, payload, policy=self._retry_policy,
+            label=path,
         )
 
     # -- membership ----------------------------------------------------------

@@ -140,6 +140,37 @@ def get_flight_recorder_enabled() -> bool:
     )
 
 
+def get_tracing_enabled() -> bool:
+    """``BAGUA_TRACING``: the distributed tracer — causal spans from the
+    train step through the RPC tier to the fleet control plane
+    (``observability/tracing.py``).  Off by default (unlike the flight
+    recorder: tracing writes a span stream, not just a ring); any of
+    ``1``/``true``/``on`` enables.  Bitwise-inert either way — the knob
+    trades host-side span bookkeeping for a queryable timeline."""
+    return os.environ.get("BAGUA_TRACING", "0").strip().lower() in (
+        "1", "true", "on", "yes"
+    )
+
+
+def get_trace_sample_every() -> int:
+    """``BAGUA_TRACE_SAMPLE``: step-sampling cadence for the tracer — a
+    root span is opened every Nth step (1, the default, traces every step;
+    RPCs issued outside a sampled step still get root client spans).
+    Clamped to ≥ 1."""
+    try:
+        return max(1, int(os.environ.get("BAGUA_TRACE_SAMPLE", 1)))
+    except ValueError:
+        return 1
+
+
+def get_trace_path() -> Optional[str]:
+    """``BAGUA_TRACE_PATH``: where the tracer appends its span JSONL
+    (one ``bagua.span.v1`` object per line — what ``ci/export_timeline.py``
+    renders to Perfetto).  None (default) keeps spans in the in-memory ring
+    only."""
+    return os.environ.get("BAGUA_TRACE_PATH") or None
+
+
 def get_static_verify_mode() -> str:
     """``BAGUA_STATIC_VERIFY``: the pre-dispatch static collective-program
     verifier (``bagua_tpu/analysis/``).  ``off`` (default) skips it;

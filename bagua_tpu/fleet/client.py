@@ -94,35 +94,40 @@ class FleetClient:
         import urllib.error
         import urllib.request
 
-        url = self.endpoint + path
-        if payload is None:
-            req = urllib.request.Request(url)
-        else:
-            req = urllib.request.Request(
-                url,
-                data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            if e.code == 429:
-                from bagua_tpu.resilience.retry import (
-                    BackpressureError, retry_after_hint,
-                )
+        from bagua_tpu.observability.tracing import client_span
 
-                raise BackpressureError(
-                    f"{url}: 429 backpressure", retry_after_hint(e) or 0.0
-                ) from e
-            raise
+        url = self.endpoint + path
+        with client_span(
+            f"rpc {path}", component="fleet", endpoint=path
+        ) as (_sp, trace_headers):
+            if payload is None:
+                req = urllib.request.Request(url, headers=dict(trace_headers))
+            else:
+                req = urllib.request.Request(
+                    url,
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json", **trace_headers},
+                )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 429:
+                    from bagua_tpu.resilience.retry import (
+                        BackpressureError, retry_after_hint,
+                    )
+
+                    raise BackpressureError(
+                        f"{url}: 429 backpressure", retry_after_hint(e) or 0.0
+                    ) from e
+                raise
 
     def _call(self, path: str, payload: Optional[dict] = None) -> dict:
         from bagua_tpu.resilience.retry import retry_call
 
         return retry_call(
             self._call_once, path, payload,
-            policy=self.retry_policy, breaker=self.breaker,
+            policy=self.retry_policy, breaker=self.breaker, label=path,
         )
 
     # -- per-gang clients -------------------------------------------------------
@@ -201,6 +206,35 @@ class FleetClient:
 
     def health(self) -> dict:
         return self._call("/fleet/health")
+
+    # -- tracing ------------------------------------------------------------------
+
+    def push_spans(self, gang_id: str, spans, events=None) -> dict:
+        """Ship a batch of finished client spans (``bagua.span.v1`` dicts,
+        e.g. ``Tracer.finished_spans()``) — plus optional timeline events —
+        into the gang's volatile span ring on the control plane, where
+        ``/fleet/timeline`` joins them with the server-side request spans."""
+        from urllib.parse import quote
+
+        return self._call(
+            f"/g/{quote(str(gang_id), safe='')}/spans",
+            {"spans": list(spans), "events": list(events or [])},
+        )
+
+    def timeline(self, gang_id: str) -> dict:
+        """The gang's causally ordered timeline (client spans, server spans,
+        StepSummary windows, health alerts, flight digests)."""
+        from urllib.parse import quote
+
+        return self._call(f"/fleet/timeline?gang={quote(str(gang_id), safe='')}")
+
+    def metrics_text(self) -> str:
+        """The server's ``/fleet/metrics`` Prometheus text exposition."""
+        import urllib.request
+
+        req = urllib.request.Request(self.endpoint + "/fleet/metrics")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return resp.read().decode()
 
 
 def publish_engine_plan(

@@ -34,6 +34,7 @@ import base64
 import logging
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from bagua_tpu.distributed.rendezvous import RendezvousState
@@ -283,6 +284,17 @@ class FleetControlPlane:
         self._replaying = False
         self.gangs_gcd = 0
         self.backpressure_denials = 0
+        # tracing + metrics tier — volatile by design: span rings and
+        # request counters restart empty (like leases and token buckets)
+        # and must NEVER appear in dump()/the WAL, or the kill/restart
+        # bitwise witness would diff on observability noise.
+        self._server_spans: Dict[str, deque] = {}   # gang -> finished server spans
+        self._client_spans: Dict[str, deque] = {}   # gang -> ingested client spans
+        self._timeline_events: Dict[str, deque] = {}  # gang -> ingested events
+        self._request_counts: Dict[str, int] = {}
+        self._deny_counts: Dict[str, int] = {}
+        self.plan_hits = 0
+        self.plan_misses = 0
         self.wal = WriteAheadLog(wal_dir, compact_every=compact_every, fsync=fsync) if wal_dir else None
         if self.wal is not None:
             self._replay()
@@ -486,6 +498,10 @@ class FleetControlPlane:
         key = plan_cache_key(fingerprint, topology, algorithm, wire_precision)
         with self._lock:
             entry = self._plans.get(key)
+            if entry is not None:
+                self.plan_hits += 1
+            else:
+                self.plan_misses += 1
             return dict(entry) if entry is not None else None
 
     def plan_count(self) -> int:
@@ -551,6 +567,223 @@ class FleetControlPlane:
                 "lease_remaining_s": round(leases.get(gang_id, now) - now, 3),
             }
         return view
+
+    # -- tracing (volatile tier) -------------------------------------------------
+
+    #: per-gang span-ring capacity; old spans fall off — this is a flight
+    #: recorder for the RPC tier, not an archive
+    SPAN_RING = 512
+
+    def _ring(self, store: Dict[str, deque], gang_id: str) -> deque:
+        with self._lock:
+            ring = store.get(gang_id)
+            if ring is None:
+                ring = store[gang_id] = deque(maxlen=self.SPAN_RING)
+            return ring
+
+    def record_server_span(
+        self,
+        gang_id: str,
+        route: str,
+        status: int,
+        dur_ms: float,
+        traceparent: Optional[str] = None,
+        retry_after_s: Optional[float] = None,
+    ) -> dict:
+        """One handled HTTP request as a server-side span.  With a valid
+        ``traceparent`` the span joins the caller's trace as a child of the
+        in-flight client span; without one it's a root (unattributed
+        traffic still shows on the timeline).  Also feeds the per-gang
+        request/deny counters ``/fleet/metrics`` exports."""
+        from bagua_tpu.observability.tracing import (
+            new_span_id, new_trace_id, parse_traceparent,
+        )
+
+        ctx = parse_traceparent(traceparent)
+        span = {
+            "schema": "bagua.span.v1",
+            "trace_id": ctx["trace_id"] if ctx else new_trace_id(),
+            "span_id": new_span_id(),
+            "name": f"http {route}",
+            "kind": "server",
+            "ts": round(time.time() - max(0.0, float(dur_ms)) / 1e3, 6),
+            "dur_ms": round(max(0.0, float(dur_ms)), 4),
+            "attrs": {
+                "service": "fleet-server",
+                "gang": str(gang_id),
+                "route": str(route),
+                "status": int(status),
+            },
+        }
+        if ctx:
+            span["parent_id"] = ctx["span_id"]
+        if int(status) == 429:
+            span["annotations"] = [{
+                "name": "backpressure", "ts": round(time.time(), 6),
+                "retry_after_s": round(float(retry_after_s or 0.0), 3),
+            }]
+        self._ring(self._server_spans, gang_id).append(span)
+        with self._lock:
+            self._request_counts[gang_id] = self._request_counts.get(gang_id, 0) + 1
+            if int(status) == 429:
+                self._deny_counts[gang_id] = self._deny_counts.get(gang_id, 0) + 1
+        return span
+
+    def ingest_spans(self, gang_id: str, spans, events=None) -> dict:
+        """Client-side span batch (the ``/g/<gang>/spans`` route): each
+        valid ``bagua.span.v1`` dict lands in the gang's volatile client
+        ring; malformed ones are counted and dropped (a trace must never
+        poison the control plane).  ``events`` (plain dicts with a ``ts``)
+        ride a separate ring so hang/health/rpc_retry events can appear on
+        the timeline next to the spans that caused them."""
+        from bagua_tpu.observability.tracing import validate_span
+
+        accepted = rejected = 0
+        ring = self._ring(self._client_spans, gang_id)
+        for span in spans or []:
+            if validate_span(span):
+                rejected += 1
+                continue
+            ring.append(dict(span))
+            accepted += 1
+        ev_ring = self._ring(self._timeline_events, gang_id)
+        n_events = 0
+        for ev in events or []:
+            if isinstance(ev, dict):
+                ev_ring.append(dict(ev))
+                n_events += 1
+        return {"accepted": accepted, "rejected": rejected, "events": n_events}
+
+    def timeline(self, gang_id: str) -> dict:
+        """The gang's joined, causally ordered timeline: client spans
+        (ingested), server spans (recorded per request), StepSummary
+        windows and flight digests (from the gang KV), and ingested
+        events — one flat ``items`` list ordered by wall clock, plus a
+        ``traces`` index listing each trace's spans parent-before-child
+        (the client→server chain the CI lane asserts)."""
+        from bagua_tpu.observability.aggregate import StepSummary
+
+        with self._lock:
+            ns = self._gangs.get(gang_id)
+            client = list(self._client_spans.get(gang_id, ()))
+            server = list(self._server_spans.get(gang_id, ()))
+            events = list(self._timeline_events.get(gang_id, ()))
+        items = []
+        # the discriminator is "item", not "kind" — spans already carry a
+        # "kind" of their own (internal/client/server) that must survive
+        for span in client:
+            items.append({"item": "client_span", "ts": span.get("ts"), **span})
+        for span in server:
+            items.append({"item": "server_span", "ts": span.get("ts"), **span})
+        for ev in events:
+            items.append({"item": "event", "ts": ev.get("ts"), **ev})
+        if ns is not None:
+            st = ns.rendezvous
+            for key in st.kv_keys():
+                parts = key.split("/")
+                if key.startswith("bagua/obs/") and len(parts) == 4:
+                    try:
+                        summary = StepSummary.from_payload(st.kv_get(key))
+                    except (TypeError, ValueError):
+                        continue
+                    items.append({
+                        "item": "step_summary", "ts": None,
+                        "attempt": parts[2], "rank": summary.rank,
+                        "step": summary.step, "p50_ms": summary.p50_ms,
+                        "p99_ms": summary.p99_ms, "health": summary.health,
+                    })
+                elif key.startswith("bagua/flight/") and len(parts) == 4:
+                    digest = st.kv_get(key)
+                    items.append({
+                        "item": "flight_digest", "ts": None,
+                        "attempt": parts[2], "rank": parts[3],
+                        "digest": digest if isinstance(digest, dict) else {},
+                    })
+        # wall-clock order; ts-less KV items (summaries/digests) lead —
+        # they are windows, not instants
+        items.sort(key=lambda it: (it.get("ts") is not None, it.get("ts") or 0.0))
+        # per-trace causal chains: parent before child, siblings by ts
+        by_trace: Dict[str, List[dict]] = {}
+        for span in client + server:
+            tid = span.get("trace_id")
+            if tid:
+                by_trace.setdefault(tid, []).append(span)
+        traces = {}
+        for tid, spans in by_trace.items():
+            children: Dict[Optional[str], List[dict]] = {}
+            ids = {s["span_id"] for s in spans}
+            for s in spans:
+                parent = s.get("parent_id")
+                children.setdefault(
+                    parent if parent in ids else None, []
+                ).append(s)
+            ordered: List[dict] = []
+            stack = sorted(
+                children.get(None, []),
+                key=lambda s: s.get("ts") or 0.0, reverse=True,
+            )
+            while stack:
+                s = stack.pop()
+                ordered.append(s)
+                stack.extend(sorted(
+                    children.get(s["span_id"], []),
+                    key=lambda c: c.get("ts") or 0.0, reverse=True,
+                ))
+            traces[tid] = ordered
+        return {
+            "gang": str(gang_id),
+            "items": items,
+            "traces": traces,
+            "n_client_spans": len(client),
+            "n_server_spans": len(server),
+            "n_events": len(events),
+            "n_traces": len(traces),
+        }
+
+    def metrics_registry(self):
+        """A fresh registry materializing the fleet's own counters — what
+        ``/fleet/metrics`` renders with the shared Prometheus formatter.
+        Built per scrape (the live counters are plain ints under the fleet
+        lock; a registry would be a second copy to keep coherent)."""
+        from bagua_tpu.observability.metrics import MetricsRegistry, _prom_name
+
+        self.sweep_leases()
+        now = self._clock()
+        with self._lock:
+            requests = dict(self._request_counts)
+            denials = dict(self._deny_counts)
+            leases = {g: d - now for g, d in self._leases.items() if g in self._gangs}
+            n_gangs = len(self._gangs)
+            plan_hits, plan_misses = self.plan_hits, self.plan_misses
+            total_denials = self.backpressure_denials
+            n_plans = len(self._plans)
+        r = MetricsRegistry(prefix="bagua_fleet")
+        r.gauge("gangs", help="live gang namespaces").set(n_gangs)
+        r.gauge("plans_cached", help="entries in the cross-gang plan cache").set(n_plans)
+        r.counter("plan_cache_hits_total", help="plan-cache lookup hits").inc(plan_hits)
+        r.counter("plan_cache_misses_total", help="plan-cache lookup misses").inc(plan_misses)
+        r.counter(
+            "backpressure_denials_total", help="requests denied 429 (all gangs)"
+        ).inc(total_denials)
+        r.counter("requests_total", help="gang requests handled (all gangs)").inc(
+            sum(requests.values())
+        )
+        for gang_id, n in sorted(requests.items()):
+            r.counter(
+                f"requests_total_{_prom_name(gang_id)}",
+                help=f"requests handled for gang {gang_id}",
+            ).inc(n)
+        for gang_id, n in sorted(denials.items()):
+            r.counter(
+                f"denials_429_total_{_prom_name(gang_id)}",
+                help=f"requests denied 429 for gang {gang_id}",
+            ).inc(n)
+        for gang_id, remaining in sorted(leases.items()):
+            r.gauge(
+                f"lease_remaining_s_{_prom_name(gang_id)}",
+                help=f"seconds until gang {gang_id}'s lease expires",
+            ).set(round(max(0.0, remaining), 3))
+        return r
 
     # -- durable-state witness --------------------------------------------------
 

@@ -7,15 +7,28 @@ Route map (one port serves the whole fleet):
                                  delegated per namespace)
     /g/<gang_id>/api/v1/...      per-gang autotune API (the full
                                  ``service.autotune_service`` route table)
+    /g/<gang_id>/spans           POST: ingest a batch of client-side spans
+                                 (+ timeline events) into the gang's
+                                 volatile span ring
     /fleet/plan/publish          POST: store a proven plan in the cross-gang
                                  cache (fingerprint/topology/algorithm/
                                  wire_precision + plan payload)
     /fleet/plan/lookup           POST: cache lookup by the same key
     /fleet/scheduler             GET: per-gang healthy/wedged/straggler view
     /fleet/gangs                 GET: gang ids + lease remainders
+    /fleet/timeline?gang=<id>    GET: the gang's causally ordered timeline
+                                 (client+server spans joined by trace_id,
+                                 StepSummary windows, flight digests)
+    /fleet/metrics               GET: Prometheus text exposition (per-gang
+                                 request/429 counts, lease remainders,
+                                 plan-cache hits/misses)
     /fleet/dump                  GET: deterministic durable-state dump (the
                                  kill/restart bitwise witness)
     /fleet/health                GET: liveness
+
+Every handled ``/g/...`` request is also recorded as a *server-side span*
+(child of the caller's ``traceparent`` when one arrives) in the gang's
+volatile ring — the server half of the cross-process trace join.
 
 Every ``/g/...`` request passes the gang's token bucket first — a denial
 is ``429`` + ``Retry-After`` (the contract ``retry_call`` paces on and the
@@ -51,6 +64,38 @@ class FleetHandler(_RdzvHandler):
     fleet: FleetControlPlane  # bound by start_fleet_server
     state = None  # the single-tenant binding is never used here
 
+    def _reply(self, payload: dict, code: int = 200, headers=None):
+        self._status = code  # server-span attribution (see _record_server_span)
+        super()._reply(payload, code, headers)
+
+    def _reply_text(self, text: str, content_type: str = "text/plain; version=0.0.4"):
+        body = text.encode()
+        self._status = 200
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _record_server_span(self, t0: float) -> None:
+        """Record the handled request as a server-side span in its gang's
+        volatile ring (no-op for un-ganged ``/fleet/*`` routes).  Fenced:
+        span bookkeeping must never turn a served request into a 500."""
+        gang_id = getattr(self, "_span_gang", None)
+        if gang_id is None:
+            return
+        try:
+            self.fleet.record_server_span(
+                gang_id,
+                route=self.path.split("?", 1)[0],
+                status=int(getattr(self, "_status", 200)),
+                dur_ms=(time.monotonic() - t0) * 1e3,
+                traceparent=self.headers.get("traceparent"),
+                retry_after_s=getattr(self, "_retry_after_s", None),
+            )
+        except Exception:
+            logger.exception("server-span recording failed (gang %r)", gang_id)
+
     def _gang_route(self, drained: bool) -> Optional[Tuple[GangNamespace, str]]:
         """Resolve ``/g/<gang_id>/<sub>`` → (namespace, sub-path), applying
         admission control + the lease touch.  Replies (429/404) and returns
@@ -66,8 +111,10 @@ class FleetHandler(_RdzvHandler):
         if not gang_id or not sep:
             self._reply({"error": "bad gang route"}, 404)
             return None
+        self._span_gang = gang_id
         ok, retry_after = self.fleet.admit(gang_id)
         if not ok:
+            self._retry_after_s = retry_after
             self._reply(
                 {"error": "backpressure", "retry_after_s": round(retry_after, 3)},
                 429,
@@ -91,6 +138,7 @@ class FleetHandler(_RdzvHandler):
     # -- verbs ----------------------------------------------------------------
 
     def do_GET(self):
+        t0 = time.monotonic()
         try:
             if self.path.startswith("/g/"):
                 route = self._gang_route(drained=True)
@@ -106,6 +154,16 @@ class FleetHandler(_RdzvHandler):
                 self._reply({"gangs": self.fleet.gang_ids(),
                              "gangs_gcd": self.fleet.gangs_gcd,
                              "backpressure_denials": self.fleet.backpressure_denials})
+            elif self.path == "/fleet/metrics":
+                self._reply_text(self.fleet.metrics_registry().to_prometheus())
+            elif self.path.split("?", 1)[0] == "/fleet/timeline":
+                from urllib.parse import parse_qs, urlsplit
+
+                gang = (parse_qs(urlsplit(self.path).query).get("gang") or [""])[0]
+                if not gang:
+                    self._reply({"error": "missing gang parameter"}, 400)
+                else:
+                    self._reply(self.fleet.timeline(gang))
             elif self.path == "/fleet/dump":
                 self._reply(self.fleet.dump())
             elif self.path == "/fleet/health":
@@ -114,9 +172,11 @@ class FleetHandler(_RdzvHandler):
             else:
                 self._reply({"error": "not found"}, 404)
         finally:
+            self._record_server_span(t0)
             self.fleet.maybe_compact()
 
     def do_PUT(self):
+        t0 = time.monotonic()
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
         try:
@@ -128,9 +188,11 @@ class FleetHandler(_RdzvHandler):
             else:
                 self._reply({"error": "not found"}, 404)
         finally:
+            self._record_server_span(t0)
             self.fleet.maybe_compact()
 
     def do_DELETE(self):
+        t0 = time.monotonic()
         try:
             if self.path.startswith("/g/"):
                 route = self._gang_route(drained=True)
@@ -140,9 +202,11 @@ class FleetHandler(_RdzvHandler):
             else:
                 self._reply({"error": "not found"}, 404)
         finally:
+            self._record_server_span(t0)
             self.fleet.maybe_compact()
 
     def do_POST(self):
+        t0 = time.monotonic()
         try:
             payload = self._body()
         except (ValueError, json.JSONDecodeError):
@@ -154,6 +218,12 @@ class FleetHandler(_RdzvHandler):
                     ns, sub = route
                     if sub.startswith("/api/v1/"):
                         self._autotune(ns, sub, payload)
+                    elif sub == "/spans":
+                        self._reply(self.fleet.ingest_spans(
+                            ns.gang_id,
+                            payload.get("spans") or [],
+                            payload.get("events") or [],
+                        ))
                     else:
                         self._handle_post(ns.rendezvous, sub, payload)
             elif self.path == "/fleet/plan/publish":
@@ -188,6 +258,7 @@ class FleetHandler(_RdzvHandler):
             else:
                 self._reply({"error": "not found"}, 404)
         finally:
+            self._record_server_span(t0)
             self.fleet.maybe_compact()
 
 

@@ -78,6 +78,19 @@ from bagua_tpu.observability.trace_analysis import (
     hlo_op_labels,
     load_trace_events,
 )
+from bagua_tpu.observability.tracing import (
+    SPAN_SCHEMA,
+    Span,
+    Tracer,
+    client_span,
+    format_traceparent,
+    get_global_tracer,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+    set_global_tracer,
+    validate_span,
+)
 
 __all__ = [
     # core
@@ -142,4 +155,16 @@ __all__ = [
     "find_trace_file",
     "hlo_op_labels",
     "load_trace_events",
+    # distributed tracing
+    "SPAN_SCHEMA",
+    "Span",
+    "Tracer",
+    "client_span",
+    "format_traceparent",
+    "get_global_tracer",
+    "new_span_id",
+    "new_trace_id",
+    "parse_traceparent",
+    "set_global_tracer",
+    "validate_span",
 ]

@@ -247,6 +247,17 @@ EVENT_PAYLOAD_FIELDS = {
         "reason": str,
         "last_phase": str,
     },
+    # one retry_call backoff sleep (resilience/retry.py): the attempt that
+    # failed, the delay about to be slept, and why (reason: "backpressure"
+    # when a 429 Retry-After hint shaped the delay, "error" otherwise).
+    # Optional extras: retry_after_s (the server's hint) and trace_id /
+    # span_id when a trace is active.
+    "rpc_retry": {
+        "endpoint": str,
+        "attempt": int,
+        "delay_s": (int, float),
+        "reason": str,
+    },
     # one circuit-breaker state change (resilience/retry.py): states are
     # closed / half-open / open; step is the hub's last known step (-1
     # before the first step — breakers guard out-of-step RPC paths too)

@@ -139,6 +139,15 @@ class Telemetry:
             ``"auto"`` builds one sized by ``BAGUA_FLIGHT_RING`` unless
             ``BAGUA_FLIGHT_RECORDER=0``; pass ``None`` to disable or an
             instance to adopt.  Bitwise-inert either way.
+        tracing: the distributed tracer
+            (:class:`~bagua_tpu.observability.tracing.Tracer`) the hub
+            drives: one sampled root span per step, one child per host
+            phase, client spans on every RPC.  The default ``"auto"``
+            builds one only under ``BAGUA_TRACING=1`` (sampled by
+            ``BAGUA_TRACE_SAMPLE``, span JSONL at ``BAGUA_TRACE_PATH``);
+            pass ``None`` to force off or an instance to adopt.  The hub
+            installs its tracer as the process-wide ambient tracer and a
+            retry observer so ``retry_call`` / the RPC transports see it.
     """
 
     def __init__(
@@ -150,6 +159,7 @@ class Telemetry:
         max_retraces_per_window: int = 2,
         goodput=None,
         flight="auto",
+        tracing="auto",
     ):
         self.registry = registry or MetricsRegistry()
         self.goodput = goodput
@@ -178,6 +188,31 @@ class Telemetry:
                     world_size=get_world_size(),
                 )
         self.flight = flight
+        if tracing == "auto":
+            from bagua_tpu.env import (
+                get_rank,
+                get_trace_path,
+                get_trace_sample_every,
+                get_tracing_enabled,
+            )
+
+            tracing = None
+            if get_tracing_enabled():
+                from bagua_tpu.observability.tracing import Tracer
+
+                tracing = Tracer(
+                    path=get_trace_path(),
+                    sample_every=get_trace_sample_every(),
+                    rank=get_rank(),
+                )
+        self.tracer = tracing
+        if self.tracer is not None:
+            from bagua_tpu.observability.tracing import set_global_tracer
+
+            set_global_tracer(self.tracer)
+        from bagua_tpu.resilience.retry import set_retry_observer
+
+        set_retry_observer(self.on_rpc_retry)
         self.watchdog = watchdog
         if watchdog is not None:
             self.bind_watchdog(watchdog)
@@ -210,11 +245,13 @@ class Telemetry:
             self.watchdog.beat(phase=phase)
         if self.goodput is not None:
             self.goodput.on_phase(phase)
+        if self.tracer is not None:
+            self.tracer.on_phase(phase)
 
     def snapshot(self) -> Dict:
         """The last known position + registry snapshot — embedded in the
         watchdog's timeout dump and exposed for debugging."""
-        return {
+        out = {
             "step": self.current_step,
             "phase": self.current_phase,
             "variant": self.current_variant,
@@ -222,8 +259,21 @@ class Telemetry:
             "recompile": self.recompile.report(),
             "metrics": self.registry.snapshot(),
         }
+        if self.tracer is not None:
+            # Watchdog + flight dumps embed this snapshot; the active
+            # trace/span ids let forensics join a wedged collective back to
+            # the exact in-flight trace on the fleet timeline.
+            out["trace"] = self.tracer.trace_context()
+        return out
 
     # -- engine feed ---------------------------------------------------------
+
+    def on_step_start(self, step: int, variant: str = "") -> None:
+        """The engine is about to run step ``step``: open the sampled root
+        span so the phase children (and any RPC issued inside the step)
+        hang off one ``train_step`` trace.  No-op without a tracer."""
+        if self.tracer is not None:
+            self.tracer.begin_step(int(step), variant=variant)
 
     def on_compile(self, variant: str, step: int) -> None:
         """The engine's jit cache missed: ``variant`` is being (re)built."""
@@ -307,6 +357,15 @@ class Telemetry:
         )
         sps = (n_samples / wall_s) if wall_s > 0 else 0.0
         r.gauge("samples_per_s", help="instantaneous throughput").set(round(sps, 3))
+        if self.tracer is not None:
+            # Stamp the step's vitals on the open root but do NOT close it:
+            # the trace stays open across the inter-step gap so the data
+            # phase and any RPC the fit loop issues between steps (snapshot
+            # agreement, autotune report) join the trace that just ran.
+            # The next on_step_start (or teardown) closes it.
+            self.tracer.note_step(
+                wall_ms=round(wall_s * 1e3, 3), wire_bytes=int(wire_bytes)
+            )
         if self.jsonl:
             event = {
                 "event": "step", "step": int(step),
@@ -357,6 +416,12 @@ class Telemetry:
                 "measured_exposed_comm_ms",
                 help="trace-measured exposed communication for the live plan",
             ).set(round(float(measured_exposed_ms), 4))
+        if self.tracer is not None:
+            self.tracer.record_event(
+                "rebucket",
+                attrs={"plan_version": int(plan_version),
+                       "n_buckets": int(n_buckets)},
+            )
         if self.jsonl:
             event = {
                 "event": "rebucket", "step": int(step),
@@ -393,6 +458,11 @@ class Telemetry:
                 f"buckets_at_precision_{prec}",
                 help=f"buckets exchanging at wire precision {prec}",
             ).set(new_precisions.count(prec))
+        if self.tracer is not None:
+            self.tracer.record_event(
+                "precision_switch",
+                attrs={"plan_version": int(plan_version), "reason": str(reason)},
+            )
         if self.jsonl:
             self.jsonl.emit(
                 {"event": "precision_switch", "step": int(step),
@@ -419,6 +489,12 @@ class Telemetry:
         r.gauge("snapshot_last_step", help="step of the newest snapshot").set(step)
         if self.goodput is not None:
             self.goodput.on_snapshot(kind, float(wall_ms))
+        if self.tracer is not None:
+            self.tracer.record_event(
+                "snapshot",
+                attrs={"kind": str(kind), "bytes": int(n_bytes)},
+                wall_ms=float(wall_ms),
+            )
         if self.jsonl:
             self.jsonl.emit(
                 {"event": "snapshot", "step": int(step),
@@ -477,11 +553,13 @@ class Telemetry:
             help=f"health anomalies of kind {kind}",
         ).inc()
         if self.jsonl:
-            self.jsonl.emit(
-                {"event": "health_alert", "step": int(step), "kind": str(kind),
-                 "value": float(value), "threshold": float(threshold),
-                 "detail": str(detail), "actions": [str(a) for a in actions]}
-            )
+            event = {
+                "event": "health_alert", "step": int(step), "kind": str(kind),
+                "value": float(value), "threshold": float(threshold),
+                "detail": str(detail), "actions": [str(a) for a in actions],
+            }
+            event.update(self._trace_fields())
+            self.jsonl.emit(event)
 
     def bind_breaker(self, breaker) -> None:
         """Point a :class:`~bagua_tpu.resilience.retry.CircuitBreaker`'s
@@ -518,6 +596,13 @@ class Telemetry:
         r.counter(
             "breaker_transitions_total", help="circuit-breaker state changes"
         ).inc()
+        if self.tracer is not None:
+            sp = self.tracer.current_span()
+            if sp is not None:
+                sp.annotate(
+                    "breaker_transition",
+                    breaker=str(name), old=str(old_state), new=str(new_state),
+                )
         if self.jsonl:
             self.jsonl.emit(
                 {"event": "breaker_transition", "step": int(self.current_step),
@@ -547,8 +632,56 @@ class Telemetry:
                 event["dumps"] = {k: str(v) for k, v in sorted(dump_paths.items())}
             if self.flight is not None:
                 event["flight_last_seq"] = int(self.flight.last_seq)
+            event.update(self._trace_fields())
             self.jsonl.emit(event)
             self.flush()
+
+    def _trace_fields(self) -> Dict:
+        """``{"trace_id", "span_id"}`` extras for events that should join
+        the timeline (hang, health_alert, rpc_retry); empty when no trace
+        is active."""
+        if self.tracer is None:
+            return {}
+        return self.tracer.trace_context()
+
+    def on_rpc_retry(
+        self,
+        endpoint: str,
+        attempt: int,
+        delay_s: float,
+        reason: str = "error",
+        retry_after_s: Optional[float] = None,
+    ) -> None:
+        """One ``retry_call`` backoff sleep (installed as the process-wide
+        retry observer): the otherwise-invisible dead time lands as the
+        ``rpc_retry_total`` / ``rpc_backoff_s_total`` counters and a
+        schema-validated ``rpc_retry`` event.  Emit failures are swallowed —
+        a closed sink (hub torn down mid-retry) must never break a live
+        RPC retry loop."""
+        r = self.registry
+        r.counter("rpc_retry_total", help="retry_call backoff sleeps").inc()
+        r.counter(
+            "rpc_backoff_s_total",
+            help="cumulative seconds slept in RPC retry backoff",
+        ).inc(max(0.0, float(delay_s)))
+        if reason == "backpressure":
+            r.counter(
+                "rpc_backpressure_total",
+                help="retries paced by a server Retry-After hint (429s)",
+            ).inc()
+        if self.jsonl:
+            event = {
+                "event": "rpc_retry", "step": int(self.current_step),
+                "endpoint": str(endpoint), "attempt": int(attempt),
+                "delay_s": round(float(delay_s), 4), "reason": str(reason),
+            }
+            if retry_after_s is not None:
+                event["retry_after_s"] = round(float(retry_after_s), 3)
+            event.update(self._trace_fields())
+            try:
+                self.jsonl.emit(event)
+            except ValueError:
+                pass  # sink closed under us; the counters still landed
 
     def _emit_alert(self, msg: str, retraces_in_window: int) -> None:
         self.registry.counter(
@@ -575,6 +708,18 @@ class Telemetry:
             self.jsonl.flush()
 
     def close(self) -> None:
+        from bagua_tpu.resilience.retry import get_retry_observer, set_retry_observer
+
+        if get_retry_observer() == self.on_rpc_retry:
+            set_retry_observer(None)
+        if self.tracer is not None:
+            from bagua_tpu.observability.tracing import (
+                get_global_tracer, set_global_tracer,
+            )
+
+            if get_global_tracer() is self.tracer:
+                set_global_tracer(None)
+            self.tracer.close()
         if self.jsonl:
             self.jsonl.close()
 

@@ -29,7 +29,25 @@ __all__ = [
     "CircuitBreaker",
     "RetryPolicy",
     "retry_call",
+    "set_retry_observer",
+    "get_retry_observer",
 ]
+
+
+#: process-wide retry observer: ``observer(endpoint, attempt, delay_s,
+#: reason, retry_after_s)`` called on every backoff sleep.  The Telemetry
+#: hub installs one so retry sleeps — otherwise invisible dead time — land
+#: in the metrics/event stream; None (default) keeps retry_call silent.
+_retry_observer: Optional[Callable] = None
+
+
+def set_retry_observer(observer: Optional[Callable]) -> None:
+    global _retry_observer
+    _retry_observer = observer
+
+
+def get_retry_observer() -> Optional[Callable]:
+    return _retry_observer
 
 
 class CircuitOpenError(ConnectionError):
@@ -214,6 +232,7 @@ def retry_call(
     retry_on: Tuple[Type[BaseException], ...] = (OSError, ConnectionError),
     on_retry: Optional[Callable[[int, BaseException], None]] = None,
     sleep: Callable[[float], None] = time.sleep,
+    label: str = "rpc",
     **kwargs,
 ):
     """Call ``fn(*args, **kwargs)`` under the retry policy + breaker.
@@ -228,7 +247,12 @@ def retry_call(
     recorded as a breaker *success* (the server is alive — a 429 must never
     push the circuit open), and the backoff becomes
     ``min(max(hint, jitter), policy.max_s)`` so the client honors the
-    server's Retry-After estimate while the cap bounds a hostile hint."""
+    server's Retry-After estimate while the cap bounds a hostile hint.
+
+    ``label`` names the endpoint in telemetry: every backoff sleep is
+    reported to the process-wide observer (:func:`set_retry_observer`) and
+    annotated onto the ambient trace span when tracing is on — both fenced
+    so instrumentation can never break a live retry."""
     policy = policy or RetryPolicy()
     last: Optional[BaseException] = None
     for attempt in range(policy.retries + 1):
@@ -249,6 +273,25 @@ def retry_call(
             delay = policy.backoff_s(attempt)
             if hint is not None:
                 delay = min(max(hint, delay), policy.max_s)
+            reason = "backpressure" if hint is not None else "error"
+            observer = _retry_observer
+            if observer is not None:
+                try:
+                    observer(label, attempt, delay, reason, hint)
+                except Exception:
+                    logger.exception("retry observer failed for %s", label)
+            try:
+                from bagua_tpu.observability.tracing import get_global_tracer
+
+                tracer = get_global_tracer()
+                sp = tracer.current_span() if tracer is not None else None
+                if sp is not None:
+                    ann = {"attempt": attempt, "delay_s": round(delay, 4)}
+                    if hint is not None:
+                        ann["retry_after_s"] = round(hint, 3)
+                    sp.annotate(f"retry:{reason}", **ann)
+            except Exception:
+                logger.exception("retry span annotation failed for %s", label)
             if on_retry is not None:
                 on_retry(attempt, e)
             logger.debug(

@@ -52,33 +52,38 @@ class AutotuneClient:
         )
 
     def _post_once(self, path: str, payload: Dict) -> Dict:
-        req = urllib.request.Request(
-            self.base + path,
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            if e.code == 429:
-                from bagua_tpu.resilience.retry import (
-                    BackpressureError, retry_after_hint,
-                )
+        from bagua_tpu.observability.tracing import client_span
 
-                raise BackpressureError(
-                    f"{self.base + path}: 429 backpressure",
-                    retry_after_hint(e) or 0.0,
-                ) from e
-            raise
+        with client_span(
+            f"rpc {path}", component="autotune", endpoint=path
+        ) as (_sp, trace_headers):
+            req = urllib.request.Request(
+                self.base + path,
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json", **trace_headers},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 429:
+                    from bagua_tpu.resilience.retry import (
+                        BackpressureError, retry_after_hint,
+                    )
+
+                    raise BackpressureError(
+                        f"{self.base + path}: 429 backpressure",
+                        retry_after_hint(e) or 0.0,
+                    ) from e
+                raise
 
     def _post(self, path: str, payload: Dict) -> Dict:
         from bagua_tpu.resilience.retry import retry_call
 
         return retry_call(
             self._post_once, path, payload,
-            policy=self.retry_policy, breaker=self.breaker,
+            policy=self.retry_policy, breaker=self.breaker, label=path,
         )
 
     def health_check(self) -> bool:

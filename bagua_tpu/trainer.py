@@ -386,6 +386,7 @@ class Trainer:
             ("snapshotter", lambda: self.snapshotter and self.snapshotter.close()),
             ("preemption watcher", lambda: self.preemption and self.preemption.uninstall()),
             ("watchdog", self._stop_watchdog),
+            ("tracer", self._flush_tracer),
             ("telemetry", lambda: self.telemetry and self.telemetry.flush()),
             ("ddp", self.ddp.shutdown),
         ):
@@ -393,6 +394,15 @@ class Trainer:
                 teardown()
             except Exception:
                 logger.exception("error closing %s (continuing teardown)", what)
+
+    def _flush_tracer(self) -> None:
+        """Close the open step trace (if any) and flush the span JSONL so a
+        teardown mid-step still lands its last trace on disk; the tracer
+        itself stays open — Telemetry.close() owns its lifecycle."""
+        tracer = getattr(self.telemetry, "tracer", None) if self.telemetry else None
+        if tracer is not None:
+            tracer.end_step()
+            tracer.flush()
 
     def _stop_profiler(self) -> None:
         if self._profiler is not None:  # fit() ended inside the window

@@ -67,6 +67,22 @@ def load_dumps(paths):
     return dumps, skipped
 
 
+def trace_contexts(dumps) -> dict:
+    """Per-rank active trace/span ids from the dumps' embedded telemetry
+    snapshots (present when the gang ran with ``BAGUA_TRACING=1``): the
+    join key from a wedged collective to the exact in-flight RPC on the
+    fleet's ``/fleet/timeline``."""
+    out = {}
+    for d in dumps:
+        trace = (d.get("telemetry") or {}).get("trace") or {}
+        if trace.get("trace_id"):
+            out[str(d.get("rank", -1))] = {
+                "trace_id": trace["trace_id"],
+                "span_id": trace.get("span_id"),
+            }
+    return out
+
+
 def summarize(report) -> str:
     """Human one-screen summary (stderr; the JSON is the artifact)."""
     lines = [
@@ -87,6 +103,13 @@ def summarize(report) -> str:
             f"{blocked['label']} (seq {blocked['seq']}, bucket "
             f"{blocked['bucket']}, phase {blocked['phase']}, "
             f"plan_version {blocked['plan_version']})"
+        )
+    traces = report.get("trace_by_rank") or {}
+    for rank, ctx in sorted(traces.items()):
+        lines.append(
+            f"rank {rank} in-flight trace: {ctx['trace_id']} "
+            f"(span {ctx.get('span_id')}) — query "
+            f"/fleet/timeline for the RPC chain"
         )
     if report.get("detail"):
         lines.append(f"detail: {report['detail']}")
@@ -120,6 +143,11 @@ def main(argv=None) -> int:
         return 2
 
     report = build_hang_report(dumps)
+    traces = trace_contexts(dumps)
+    if traces:
+        # extra field (the report schema checks required fields only):
+        # which trace each rank was inside when it wedged
+        report["trace_by_rank"] = traces
     problems = validate_hang_report(report)
     if problems:
         print("diagnose_hang: internal error — report failed its own "

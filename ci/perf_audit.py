@@ -611,6 +611,243 @@ def hang_forensics_lane(out_prefix: str, steps: int = 8):
     }
 
 
+def tracing_lane(out_prefix: str, steps: int = 6):
+    """Executed distributed-tracing gate: one traced gang against a live
+    fleet server, held to the subsystem's four contracts.
+
+    Two short gradient_allreduce[overlap] runs on the 4-rank mesh pin the
+    hot path: tracing-on vs tracing-off training state must be **bitwise
+    identical** (every hook is host-side — phase transitions, RPC
+    transports, step boundaries) and the tracing-on step-wall p50 must sit
+    within noise of tracing-off.  The traced run issues one fleet KV RPC
+    per step from inside the open step trace, against a
+    ``python -m bagua_tpu.fleet.server`` subprocess whose token bucket is
+    sized to shed a deliberate burst: the 429s must land as client spans
+    with ``status: 429`` + the server's Retry-After hint, with the
+    ``retry_call`` backoff annotated on the enclosing span.  The pushed
+    spans then join the server's own request spans on ``/fleet/timeline``
+    — the cross-process parent→child chain (train_step → phase → client
+    rpc → server http) asserted span id by span id — ``/fleet/metrics``
+    exports the per-gang request/denial counters, and
+    ``ci/export_timeline.py`` must render the whole thing as schema-valid
+    Chrome trace-event JSON.  tests/test_ci_lane.py greps the sentinel and
+    re-checks the artifact.
+    """
+    import hashlib
+    import shutil
+    import socket
+    import statistics
+    import subprocess
+    import urllib.request
+
+    import bagua_tpu
+    from bagua_tpu.algorithms import build_algorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.fleet.client import FleetClient
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+    from bagua_tpu.observability import Telemetry, Tracer
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from export_timeline import validate_chrome_trace
+
+    workdir = tempfile.mkdtemp(prefix="bagua_tracing_lane_")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    log = open(os.path.join(workdir, "server.log"), "ab")
+    # rate/burst sized so the per-step RPCs pass but a rapid burst sheds
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "bagua_tpu.fleet.server",
+         "--port", str(port), "--host", "127.0.0.1",
+         "--wal-dir", os.path.join(workdir, "wal"),
+         "--settle-s", "0.05", "--lease-ttl-s", "600",
+         "--member-ttl-s", "600", "--rate", "4", "--burst", "3"],
+        stdout=log, stderr=log, env=env, cwd=REPO,
+    )
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + 120.0
+    while True:
+        try:
+            with urllib.request.urlopen(base + "/fleet/health", timeout=2.0) as r:
+                if json.loads(r.read()).get("status") == "ok":
+                    break
+        except (OSError, ValueError):
+            pass
+        assert time.monotonic() < deadline, "fleet server never became healthy"
+        time.sleep(0.1)
+
+    try:
+        group = bagua_tpu.init_process_group(intra_size=4)
+        params = init_mlp(jax.random.PRNGKey(0), [64, 128, 128, 64])
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(8 * group.size, 64).astype(np.float32))
+        y = jnp.asarray(rng.rand(8 * group.size, 64).astype(np.float32))
+        gang = "tracing-lane"
+
+        def run(tracer, with_rpcs):
+            tel = Telemetry(tracing=tracer, flight=None)
+            ddp = DistributedDataParallel(
+                loss_fn=mse_loss, optimizer=optax.sgd(0.01, momentum=0.9),
+                algorithm=build_algorithm("gradient_allreduce"),
+                process_group=group, bucket_size_bytes=1 << 16, overlap=True,
+                telemetry=tel,
+            )
+            state = ddp.init(params)
+            state, losses = ddp.train_step(state, (x, y))  # compile outside timing
+            jax.block_until_ready(losses)
+            rc = FleetClient(base).rendezvous_client(gang, 0) if with_rpcs else None
+            walls = []
+            for i in range(steps):
+                t0 = time.monotonic()
+                state, losses = ddp.train_step(state, (x, y))
+                jax.block_until_ready(losses)
+                walls.append(time.monotonic() - t0)
+                if rc is not None:
+                    # issued while the step trace is still open: the RPC
+                    # client span must hang off this step's phase span
+                    rc.kv_set(f"step-{i}", i)
+            if rc is not None:
+                # the deliberate burst: more requests than the bucket holds,
+                # so some 429 and retry_call paces on the Retry-After hint
+                for j in range(6):
+                    rc.kv_set("burst", j)
+            digest = hashlib.sha256()
+            for leaf in jax.tree.leaves((state.params, state.opt_state)):
+                digest.update(np.asarray(leaf).tobytes())
+            ddp.shutdown()
+            tel.close()
+            return digest.hexdigest(), statistics.median(walls)
+
+        sha_off, p50_off = run(None, with_rpcs=False)
+        spans_path = os.path.join(workdir, "spans.jsonl")
+        tracer = Tracer(path=spans_path, sample_every=1)
+        sha_on, p50_on = run(tracer, with_rpcs=True)
+
+        # Bitwise-inert: tracing on vs off trains the same bits.
+        assert sha_on == sha_off, (
+            f"tracing perturbed training state: {sha_on} != {sha_off}"
+        )
+        # Hot-path overhead: within noise (spans are a few dict writes).
+        assert p50_on <= p50_off * 1.5 + 2e-3, (
+            f"tracing overhead out of noise: p50 on={p50_on:.4f}s "
+            f"off={p50_off:.4f}s"
+        )
+
+        spans = tracer.finished_spans()
+        by_id = {s["span_id"]: s for s in spans}
+        roots = [s for s in spans if s["name"] == "train_step"]
+        assert len(roots) == steps + 1, f"{len(roots)} roots for {steps + 1} steps"
+        # every timed step issued an in-step RPC that eventually succeeded
+        # (shed attempts show up as extra spans with the same name), each
+        # attempt threaded through a phase span to its step root
+        step_rpcs = [s for s in spans if s["name"].startswith("rpc /rdzv/kv/step-")]
+        ok_rpcs = [s for s in step_rpcs
+                   if (s.get("attrs") or {}).get("status") != 429]
+        assert len({s["name"] for s in ok_rpcs}) == steps, step_rpcs
+        for sp in step_rpcs:
+            phase = by_id[sp["parent_id"]]
+            assert phase["name"].startswith("phase:"), phase
+            root = by_id[phase["parent_id"]]
+            assert root["name"] == "train_step"
+            assert sp["trace_id"] == phase["trace_id"] == root["trace_id"]
+        # the induced 429s: shed attempts land as client spans with the
+        # server's hint, and the backoff annotates the enclosing span
+        shed = [s for s in spans if (s.get("attrs") or {}).get("status") == 429]
+        assert shed, "tiny token bucket never shed a traced request"
+        hints = [a for s in shed for a in s.get("annotations", ())
+                 if a["name"] == "backpressure"]
+        assert hints and all(a["retry_after_s"] > 0 for a in hints), hints
+        retried = [a for s in spans for a in s.get("annotations", ())
+                   if a["name"] == "retry:backpressure"]
+        assert retried and all(a["retry_after_s"] > 0 for a in retried), retried
+
+        # The cross-process join: push the client spans, then the server's
+        # timeline must chain them ahead of its own request spans.
+        fc = FleetClient(base)
+        pushed = fc.push_spans(gang, spans)
+        assert pushed["accepted"] == len(spans) and pushed["rejected"] == 0
+        tl = fc.timeline(gang)
+        probe = ok_rpcs[-1]
+        chain = tl["traces"].get(probe["trace_id"])
+        assert chain, f"trace {probe['trace_id']} missing from /fleet/timeline"
+        ids = [s["span_id"] for s in chain]
+        server_children = [
+            s for s in chain
+            if s["kind"] == "server" and s.get("parent_id") == probe["span_id"]
+        ]
+        assert server_children, (
+            f"no server span child of client span {probe['span_id']}: {chain}"
+        )
+        assert ids.index(probe["span_id"]) < ids.index(
+            server_children[0]["span_id"]
+        ), "timeline not parent-before-child"
+        assert any(
+            i["item"] == "server_span" and i["attrs"]["status"] == 429
+            for i in tl["items"]
+        ), "shed requests missing from the server-side timeline"
+
+        metrics_text = fc.metrics_text()
+        for needle in (
+            "bagua_fleet_requests_total",
+            "bagua_fleet_denials_429_total_tracing_lane",
+            "bagua_fleet_backpressure_denials_total",
+        ):
+            assert needle in metrics_text, f"{needle!r} missing:\n{metrics_text}"
+
+        # Perfetto export: the exporter must accept its own output (it
+        # self-validates and exits nonzero otherwise) and we re-validate
+        # here, checking the cross-process spans made it into the render.
+        tl_path = os.path.join(workdir, "timeline.json")
+        with open(tl_path, "w") as f:
+            json.dump(tl, f)
+        trace_path = out_prefix + "_trace.json"
+        exp = subprocess.run(
+            [sys.executable, os.path.join(REPO, "ci", "export_timeline.py"),
+             "--spans", spans_path, "--timeline", tl_path, "--out", trace_path],
+            capture_output=True, text=True,
+        )
+        assert exp.returncode == 0, (
+            f"export_timeline failed ({exp.returncode}):\n{exp.stderr}"
+        )
+        with open(trace_path) as f:
+            chrome = json.load(f)
+        problems = validate_chrome_trace(chrome)
+        assert not problems, f"chrome trace failed schema: {problems}"
+        names = {e["name"] for e in chrome["traceEvents"] if e["ph"] == "X"}
+        assert "train_step" in names
+        assert any(n.startswith("http /g/") for n in names), names
+        n_flows = sum(1 for e in chrome["traceEvents"] if e["ph"] == "s")
+        assert n_flows >= steps, f"only {n_flows} flow links rendered"
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+        log.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    print(
+        f"[audit] tracing lane passed ({len(spans)} spans, "
+        f"{len(shed)} shed 429s joined client->server on /fleet/timeline, "
+        f"bitwise-inert, p50 on/off {p50_on * 1e3:.2f}/{p50_off * 1e3:.2f} ms)",
+        file=sys.stderr,
+    )
+    return {
+        "bitwise_identical": True,
+        "n_spans": len(spans),
+        "n_step_traces": len(roots),
+        "n_shed_429": len(shed),
+        "n_retry_annotations": len(retried),
+        "n_server_spans": tl["n_server_spans"],
+        "n_flow_links": n_flows,
+        "p50_ms_tracing_on": round(p50_on * 1e3, 3),
+        "p50_ms_tracing_off": round(p50_off * 1e3, 3),
+        "trace_path": os.path.basename(trace_path),
+    }
+
+
 def static_verify_lane():
     """Pre-dispatch static collective-program verification gate.
 
@@ -1832,6 +2069,14 @@ def main():
     hang_result = None
     if args.algo is None and args.wire is None:
         hang_result = hang_forensics_lane(args.out)
+    # Executed distributed-tracing gate: tracing bitwise-inert + overhead-
+    # in-noise, one traced gang against a live fleet server, induced 429s
+    # attributed on the spans, the client->server chain joined on
+    # /fleet/timeline, and the Perfetto export schema-valid.  The focused
+    # --algo/--wire lanes skip it.
+    tracing_result = None
+    if args.algo is None and args.wire is None:
+        tracing_result = tracing_lane(args.out)
     # Pre-dispatch static verification gate: strict four-checker pass over
     # the modeled wire programs (gradient_allreduce f32 + int8, zero) plus
     # the retrace-hazard lint.  Trace-only, so cheap enough for every full
@@ -1887,6 +2132,7 @@ def main():
              "wire": wire_result,
              "health": health_result,
              "hang_forensics": hang_result,
+             "tracing": tracing_result,
              "static_verify": static_verify_result,
              "retrace_lint": retrace_lint_result,
              "bench_modeled": bench_modeled_result,

@@ -41,6 +41,7 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert "fault-injection resilience lane passed" in proc.stderr
     assert "health guardrail lane passed" in proc.stderr
     assert "hang forensics lane passed" in proc.stderr
+    assert "tracing lane passed" in proc.stderr
     assert "static verify lane passed" in proc.stderr
     assert "retrace-hazard lint passed" in proc.stderr
     assert "bench modeled lane passed" in proc.stderr
@@ -147,6 +148,29 @@ def test_perf_audit_quick_overlap_census(tmp_path):
         report = json.load(f)
     assert validate_hang_report(report) == []
     assert report["blocked_on"]["label"] == blocked["label"]
+
+    # The tracing lane's artifact: tracing-on bitwise-identical to off and
+    # within noise, the induced 429s attributed, the cross-process client->
+    # server chain joined on /fleet/timeline, and the Perfetto export
+    # re-validating against the Chrome trace-event schema.
+    tr = audit["tracing"]
+    assert tr["bitwise_identical"] is True
+    assert tr["n_step_traces"] >= 2 and tr["n_spans"] > tr["n_step_traces"]
+    assert tr["n_shed_429"] >= 1 and tr["n_retry_annotations"] >= 1
+    assert tr["n_server_spans"] >= 1 and tr["n_flow_links"] >= 1
+    assert tr["p50_ms_tracing_on"] > 0 and tr["p50_ms_tracing_off"] > 0
+    trace_path = str(out) + "_trace.json"
+    assert os.path.exists(trace_path), "tracing lane did not emit its export"
+    sys.path.insert(0, os.path.join(REPO, "ci"))
+    try:
+        from export_timeline import validate_chrome_trace
+    finally:
+        sys.path.pop(0)
+    with open(trace_path) as f:
+        chrome = json.load(f)
+    assert validate_chrome_trace(chrome) == []
+    assert any(e["ph"] == "X" and e["name"] == "train_step"
+               for e in chrome["traceEvents"])
 
     # The static-verify lane's artifact: strict four-checker verification of
     # the modeled wire programs, all trace-time (nothing dispatched), plus

@@ -51,7 +51,12 @@ from bagua_tpu.fleet import (
     start_fleet_server,
 )
 from bagua_tpu.models.mlp import init_mlp, mse_loss
-from bagua_tpu.observability import Telemetry, validate_metrics_file
+from bagua_tpu.observability import (
+    Telemetry,
+    Tracer,
+    set_global_tracer,
+    validate_metrics_file,
+)
 from bagua_tpu.observability.aggregate import StepSummary, gang_kv_key
 from bagua_tpu.observability.flight_recorder import flight_kv_key
 from bagua_tpu.resilience.retry import (
@@ -744,3 +749,120 @@ def test_sigkill_restart_replays_wal_with_live_clients(tmp_path):
             if p is not None and p.poll() is None:
                 p.kill()
                 p.wait(timeout=30)
+
+
+# ---------------- scheduler verdict precedence + tracing tier ----------------
+
+
+def test_scheduler_view_verdict_precedence_conflicting_signals():
+    """The verdict ladder is wedged > straggler > healthy > idle: a gang
+    carrying BOTH a flight digest and a straggler-grade p50 spread must
+    come back wedged, with the losing straggler signal still reported."""
+    plane = FleetControlPlane(lease_ttl_s=50.0, clock=lambda: 10.0,
+                              rdzv_kwargs=RDZV_FAST)
+
+    def push(gang, rank, p50, phase_ms=None):
+        plane.gang(gang).rendezvous.kv_set(
+            gang_kv_key("0", rank),
+            StepSummary(rank=rank, step=3, p50_ms=p50,
+                        phase_ms=phase_ms or {}).payload(),
+        )
+
+    # conflicting signals on one gang: a 4x p50 spread AND a flight digest
+    push("conflict", 0, 10.0)
+    push("conflict", 1, 40.0, phase_ms={"h2d": 30.0, "compute": 5.0})
+    plane.gang("conflict").rendezvous.kv_set(flight_kv_key("0", 1), {"hang": True})
+    # the same summaries without the digest sit one rung down
+    push("strag", 0, 10.0)
+    push("strag", 1, 40.0, phase_ms={"h2d": 30.0, "compute": 5.0})
+    push("ok", 0, 10.0)
+    push("ok", 1, 11.0)
+    plane.gang("empty")
+
+    gangs = plane.scheduler_view()["gangs"]
+    assert gangs["conflict"]["verdict"] == "wedged"
+    assert gangs["conflict"]["flight_ranks"] == ["rank1"]
+    # the digest outranks — but does not erase — the straggler finding
+    assert gangs["conflict"]["straggler"] is not None
+    assert gangs["conflict"]["straggler"]["rank"] == 1
+    assert gangs["strag"]["verdict"] == "straggler"
+    assert gangs["ok"]["verdict"] == "healthy"
+    assert gangs["empty"]["verdict"] == "idle"
+    order = ("empty", "ok", "strag", "conflict")
+    assert [gangs[g]["verdict"] for g in order] == [
+        "idle", "healthy", "straggler", "wedged",
+    ]
+
+
+def test_fleet_tracing_timeline_join_and_metrics():
+    """End to end over HTTP: a traced client RPC produces a server span
+    that is a *child* of the client span (traceparent propagated), the
+    pushed client spans join it on /fleet/timeline in parent-before-child
+    order, /fleet/metrics exports the per-gang counters, and none of the
+    volatile span state leaks into the durable dump."""
+    plane = FleetControlPlane(rdzv_kwargs=RDZV_FAST)
+    server, base = _serve(plane)
+    tracer = Tracer(sample_every=1)
+    set_global_tracer(tracer)
+    try:
+        fc = FleetClient(base)
+        tracer.begin_step(0, variant="full")
+        rc = fc.rendezvous_client("tr", 0)
+        rc.kv_set("warm", 1)
+        tracer.end_step()
+        client_spans = tracer.finished_spans()
+        rpc_span = next(
+            s for s in client_spans if s["name"] == "rpc /rdzv/kv/warm"
+        )
+        root = next(s for s in client_spans if s["name"] == "train_step")
+        assert rpc_span["trace_id"] == root["trace_id"]
+        assert rpc_span["parent_id"] == root["span_id"]
+
+        # push the finished spans (plus one junk span and one event); the
+        # junk must be counted and dropped, never ingested
+        pushed = fc.push_spans(
+            "tr", client_spans + [{"trace_id": "nope"}],
+            events=[{"event": "health_alert", "ts": time.time(), "rank": 0}],
+        )
+        assert pushed["accepted"] == len(client_spans)
+        assert pushed["rejected"] == 1
+        assert pushed["events"] == 1
+
+        tl = fc.timeline("tr")
+        assert tl["gang"] == "tr"
+        assert tl["n_server_spans"] >= 1 and tl["n_events"] == 1
+        server_spans = [i for i in tl["items"] if i["item"] == "server_span"]
+        joined = [
+            s for s in server_spans if s.get("parent_id") == rpc_span["span_id"]
+        ]
+        assert joined, server_spans
+        assert joined[0]["trace_id"] == rpc_span["trace_id"]
+        assert joined[0]["attrs"]["service"] == "fleet-server"
+        assert joined[0]["attrs"]["status"] == 200
+        # the causal index walks each trace parent-before-child
+        chain = [s["span_id"] for s in tl["traces"][root["trace_id"]]]
+        assert chain.index(root["span_id"]) < chain.index(rpc_span["span_id"])
+        assert chain.index(rpc_span["span_id"]) < chain.index(joined[0]["span_id"])
+        assert any(i["item"] == "event" and i["event"] == "health_alert"
+                   for i in tl["items"])
+
+        text = fc.metrics_text()
+        assert "bagua_fleet_requests_total_tr" in text
+        assert "bagua_fleet_lease_remaining_s_tr" in text
+        assert "bagua_fleet_plan_cache_hits_total" in text
+        assert "bagua_fleet_plan_cache_misses_total" in text
+
+        # /fleet/timeline without a gang is a client error, not a crash
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(base + "/fleet/timeline")
+        assert err.value.code == 400
+
+        # the span rings are volatile: not a byte of them in the durable
+        # dump, so the kill/restart bitwise witness is untouched
+        dump = _get_json(base + "/fleet/dump")
+        assert "span" not in json.dumps(dump)
+        assert "trace_id" not in json.dumps(dump)
+    finally:
+        set_global_tracer(None)
+        tracer.close()
+        server.shutdown()

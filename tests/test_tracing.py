@@ -1,0 +1,388 @@
+"""Distributed tracing: span model, W3C context propagation, sampling,
+the Telemetry wiring, retry attribution, the Perfetto exporter — and the
+acceptance criterion that turning it all on is bitwise-inert.
+
+The subsystem's contracts, each pinned here:
+
+* **span model** — ``bagua.span.v1`` dicts validate, parent/child links
+  carry one trace_id, and the W3C ``traceparent`` header round-trips
+  (malformed / all-zero / version-ff headers degrade to None, never
+  raise);
+* **context** — the thread-local stack parents RPC client spans under the
+  step's active phase span; ``client_span`` is a verbatim no-op when no
+  tracer is installed;
+* **attribution** — a 429 raised inside a client span lands as
+  ``status: 429`` plus a ``backpressure`` annotation with the server's
+  Retry-After hint; ``retry_call`` backoffs annotate the in-flight span
+  and feed the ``rpc_retry_total`` / ``rpc_backoff_s_total`` counters and
+  the schema-validated ``rpc_retry`` event;
+* **bitwise-inert** — BAGUA_TRACING on vs off trains *bit-identical*
+  params + optimizer state, overlap on, for gradient_allreduce AND zero
+  (every hook is host-side: phase transitions, RPC transports, step
+  boundaries — never the traced computation).
+"""
+
+import hashlib
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bagua_tpu.algorithms import build_algorithm
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.models.mlp import init_mlp, mse_loss
+from bagua_tpu.observability import (
+    SPAN_SCHEMA,
+    Span,
+    Telemetry,
+    Tracer,
+    client_span,
+    format_traceparent,
+    get_global_tracer,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+    set_global_tracer,
+    validate_metrics_file,
+    validate_span,
+)
+from bagua_tpu.resilience.retry import (
+    BackpressureError,
+    RetryPolicy,
+    get_retry_observer,
+    retry_call,
+)
+
+LAYERS = [12, 16, 16, 4]
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    """Every test starts and ends with no ambient tracer / observer —
+    these are process-wide and must never leak across tests."""
+    set_global_tracer(None)
+    yield
+    set_global_tracer(None)
+    from bagua_tpu.resilience.retry import set_retry_observer
+
+    set_retry_observer(None)
+
+
+# -- ids + traceparent --------------------------------------------------------
+
+
+def test_ids_and_traceparent_roundtrip():
+    tid, sid = new_trace_id(), new_span_id()
+    assert len(tid) == 32 and len(sid) == 16 and tid != new_trace_id()
+    header = format_traceparent(tid, sid)
+    assert header == f"00-{tid}-{sid}-01"
+    ctx = parse_traceparent(header)
+    assert ctx == {"trace_id": tid, "span_id": sid, "sampled": True}
+    assert parse_traceparent(format_traceparent(tid, sid, sampled=False))[
+        "sampled"
+    ] is False
+
+
+@pytest.mark.parametrize("header", [
+    None,
+    "",
+    "not-a-traceparent",
+    "00-zz-zz-01",                                    # non-hex
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",        # all-zero trace id
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",        # all-zero span id
+    "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",        # forbidden version
+    "00-" + "1" * 31 + "-" + "2" * 16 + "-01",        # short trace id
+    "00-" + "1" * 32 + "-" + "2" * 16,                # missing flags
+])
+def test_parse_traceparent_rejects_garbage(header):
+    assert parse_traceparent(header) is None  # degrade, never raise
+
+
+def test_span_serialization_validates():
+    root = Span("train_step", attrs={"step": 3})
+    child = Span("phase:dispatch", trace_id=root.trace_id,
+                 parent_id=root.span_id)
+    child.annotate("retry:backpressure", attempt=1, retry_after_s=0.5)
+    child.dur_ms = 1.25
+    for span in (root, child):
+        d = span.to_dict()
+        assert d["schema"] == SPAN_SCHEMA
+        assert validate_span(d) == []
+    d = child.to_dict()
+    assert d["parent_id"] == root.span_id
+    assert d["trace_id"] == root.trace_id
+    assert d["annotations"][0]["name"] == "retry:backpressure"
+    assert parse_traceparent(child.traceparent)["span_id"] == child.span_id
+    # the validator actually rejects
+    assert validate_span({"trace_id": "nope"})
+    assert validate_span({**root.to_dict(), "kind": "weird"})
+    assert validate_span({**root.to_dict(), "ts": "yesterday"})
+
+
+# -- tracer context + sampling ------------------------------------------------
+
+
+def test_step_phases_and_rpc_spans_share_one_trace():
+    tracer = Tracer(sample_every=1, service="trainer", rank=0)
+    root = tracer.begin_step(7, variant="full")
+    tracer.on_phase("dispatch")
+    with tracer.span("rpc /autotune/report", kind="client") as sp:
+        assert tracer.current_span() is sp
+    tracer.on_phase("wait")
+    tracer.end_step(wall_ms=12.5)
+    spans = {s["name"]: s for s in tracer.finished_spans()}
+    assert set(spans) == {
+        "train_step", "phase:dispatch", "rpc /autotune/report", "phase:wait",
+    }
+    assert spans["train_step"]["span_id"] == root.span_id
+    assert all(s["trace_id"] == root.trace_id for s in spans.values())
+    assert spans["phase:dispatch"]["parent_id"] == root.span_id
+    assert spans["rpc /autotune/report"]["parent_id"] == (
+        spans["phase:dispatch"]["span_id"]
+    )
+    assert spans["train_step"]["attrs"]["wall_ms"] == 12.5
+    assert all(validate_span(s) == [] for s in spans.values())
+
+
+def test_step_sampling_drops_whole_steps():
+    tracer = Tracer(sample_every=2)
+    for step in range(4):
+        assert (tracer.begin_step(step) is not None) == (step % 2 == 0)
+        tracer.on_phase("dispatch")
+        tracer.end_step()
+    names = [s["name"] for s in tracer.finished_spans()]
+    # steps 1 and 3 left nothing at all — not even phase children
+    assert names.count("train_step") == 2
+    assert names.count("phase:dispatch") == 2
+    assert tracer.n_dropped_unsampled == 2
+
+
+def test_tracer_context_is_thread_local():
+    tracer = Tracer()
+    tracer.begin_step(0)
+    seen = {}
+
+    def worker():
+        seen["current"] = tracer.current_span()
+        with tracer.span("bg write") as sp:
+            seen["own"] = tracer.current_span() is sp
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    tracer.end_step()
+    # the background thread never saw the fit loop's context, and its own
+    # span is a fresh root
+    assert seen["current"] is None and seen["own"]
+    bg = next(s for s in tracer.finished_spans() if s["name"] == "bg write")
+    assert bg.get("parent_id") is None
+
+
+def test_span_jsonl_file_is_line_valid(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    tracer = Tracer(path=path)
+    tracer.begin_step(0)
+    tracer.on_phase("dispatch")
+    tracer.end_step()
+    tracer.close()
+    with open(path) as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    assert len(lines) == 2
+    assert all(validate_span(s) == [] for s in lines)
+
+
+# -- client_span + 429 attribution --------------------------------------------
+
+
+def test_client_span_is_noop_without_tracer():
+    assert get_global_tracer() is None
+    with client_span("rpc /x", component="fleet") as (sp, headers):
+        assert sp is None and headers == {}
+
+
+def test_client_span_injects_context_and_attributes_429():
+    tracer = Tracer()
+    set_global_tracer(tracer)
+    tracer.begin_step(0)
+    with client_span("rpc /ok", component="fleet", endpoint="/ok") as (sp, h):
+        ctx = parse_traceparent(h["traceparent"])
+        assert ctx["trace_id"] == sp.trace_id
+        assert ctx["span_id"] == sp.span_id
+    with pytest.raises(BackpressureError):
+        with client_span("rpc /shed", component="fleet") as (sp, _h):
+            raise BackpressureError("shed", retry_after_s=1.5)
+    tracer.end_step()
+    spans = {s["name"]: s for s in tracer.finished_spans()}
+    assert spans["rpc /ok"]["kind"] == "client"
+    assert spans["rpc /ok"]["attrs"]["component"] == "fleet"
+    shed = spans["rpc /shed"]
+    assert shed["attrs"]["status"] == 429
+    (ann,) = shed["annotations"]
+    assert ann["name"] == "backpressure" and ann["retry_after_s"] == 1.5
+    # a non-429 failure is tagged, not mistaken for backpressure
+    with pytest.raises(ValueError):
+        with client_span("rpc /boom", component="fleet"):
+            raise ValueError("nope")
+    boom = next(s for s in tracer.finished_spans() if s["name"] == "rpc /boom")
+    assert boom["attrs"]["error"] == "ValueError"
+    assert not boom.get("annotations")
+
+
+# -- telemetry wiring + retry integration -------------------------------------
+
+
+def test_env_gate_builds_and_tears_down_the_tracer(monkeypatch, tmp_path):
+    monkeypatch.setenv("BAGUA_TRACING", "1")
+    monkeypatch.setenv("BAGUA_TRACE_SAMPLE", "3")
+    monkeypatch.setenv("BAGUA_TRACE_PATH", str(tmp_path / "spans.jsonl"))
+    tel = Telemetry()
+    assert tel.tracer is not None and tel.tracer.sample_every == 3
+    assert get_global_tracer() is tel.tracer
+    assert get_retry_observer() == tel.on_rpc_retry
+    tel.close()
+    assert get_global_tracer() is None
+    assert get_retry_observer() is None
+    # and default-off: no env, no tracer, no global
+    monkeypatch.delenv("BAGUA_TRACING")
+    tel2 = Telemetry()
+    assert tel2.tracer is None and get_global_tracer() is None
+    tel2.close()
+
+
+def test_retry_call_feeds_counters_events_and_span_annotations(tmp_path):
+    events_path = str(tmp_path / "metrics.jsonl")
+    tel = Telemetry(metrics_jsonl=events_path, tracing=Tracer())
+    state = {"n": 0}
+
+    def shedding():
+        state["n"] += 1
+        if state["n"] <= 2:
+            raise BackpressureError("shed", retry_after_s=0.01)
+        return "ok"
+
+    tel.tracer.begin_step(0)
+    tel.enter_phase("dispatch")
+    assert retry_call(
+        shedding, policy=RetryPolicy(retries=3, base_s=0.001, seed=0),
+        sleep=lambda s: None, label="/rdzv/heartbeat",
+    ) == "ok"
+    tel.tracer.end_step()
+    reg = tel.registry.snapshot()
+    assert reg["rpc_retry_total"] == 2
+    assert reg["rpc_backpressure_total"] == 2
+    assert reg["rpc_backoff_s_total"] >= 0.02
+    tel.close()
+    assert validate_metrics_file(events_path) == []
+    events = [json.loads(line) for line in open(events_path)]
+    retries = [e for e in events if e["event"] == "rpc_retry"]
+    assert len(retries) == 2
+    for ev in retries:
+        assert ev["endpoint"] == "/rdzv/heartbeat"
+        assert ev["reason"] == "backpressure"
+        assert ev["retry_after_s"] == 0.01
+        assert len(ev["trace_id"]) == 32  # joins the timeline
+    # the in-flight phase span carries the backoff annotations too
+    phase = next(s for s in tel.tracer.finished_spans()
+                 if s["name"] == "phase:dispatch")
+    anns = [a for a in phase["annotations"]
+            if a["name"] == "retry:backpressure"]
+    assert [a["attempt"] for a in anns] == [0, 1]
+    assert all(a["retry_after_s"] == 0.01 for a in anns)
+
+
+def test_snapshot_and_events_carry_trace_context(tmp_path):
+    events_path = str(tmp_path / "metrics.jsonl")
+    tel = Telemetry(metrics_jsonl=events_path, tracing=Tracer())
+    tel.on_step_start(4, variant="full")
+    snap = tel.snapshot()
+    assert snap["trace"]["trace_id"] == tel.tracer.current_span().trace_id
+    tel.on_health_alert(step=4, kind="loss_spike", value=9.0, threshold=3.0)
+    tel.close()
+    assert validate_metrics_file(events_path) == []
+    (alert,) = [json.loads(line) for line in open(events_path)
+                if '"health_alert"' in line]
+    assert alert["trace_id"] == snap["trace"]["trace_id"]
+
+
+# -- exporter -----------------------------------------------------------------
+
+
+def test_chrome_trace_export_validates_and_links():
+    import sys as _sys
+    _sys.path.insert(0, "ci")
+    try:
+        from export_timeline import build_chrome_trace, validate_chrome_trace
+    finally:
+        _sys.path.pop(0)
+    tracer = Tracer()
+    tracer.begin_step(0)
+    tracer.on_phase("dispatch")
+    with tracer.span("rpc /rdzv/kv/x", kind="client") as sp:
+        sp.annotate("retry:backpressure", attempt=0, retry_after_s=0.2)
+    tracer.end_step()
+    trace = build_chrome_trace(tracer.finished_spans())
+    assert validate_chrome_trace(trace) == []
+    evs = trace["traceEvents"]
+    names = {e["name"] for e in evs if e["ph"] == "X"}
+    assert {"train_step", "phase:dispatch", "rpc /rdzv/kv/x"} <= names
+    # 2 parent->child links -> 2 matched flow pairs, annotation -> instant
+    assert sum(1 for e in evs if e["ph"] == "s") == 2
+    assert sum(1 for e in evs if e["ph"] == "f") == 2
+    assert any(e["ph"] == "i" and e["name"] == "retry:backpressure"
+               for e in evs)
+    # the validator rejects a dangling flow arrow
+    broken = {"traceEvents": [e for e in evs if e["ph"] != "f"]}
+    assert any("unmatched flow" in p for p in validate_chrome_trace(broken))
+
+
+# -- the acceptance criterion: bitwise inert ----------------------------------
+
+
+def make_batch(seed=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(32, LAYERS[0]).astype(np.float32))
+    y = jnp.asarray(rng.randn(32, LAYERS[-1]).astype(np.float32))
+    return x, y
+
+
+def run_steps(group, algo_name, tracer, steps=3):
+    tel = Telemetry(tracing=tracer, flight=None)
+    ddp = DistributedDataParallel(
+        mse_loss, optax.sgd(0.1, momentum=0.9), build_algorithm(algo_name),
+        process_group=group, bucket_size_bytes=1 << 9, overlap=True,
+        telemetry=tel,
+    )
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    batch = make_batch()
+    losses = None
+    for _ in range(steps):
+        state, losses = ddp.train_step(state, batch)
+    jax.block_until_ready(losses)
+    ddp.shutdown()
+    tel.close()
+    return state
+
+
+def state_sha(state):
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves((state.params, state.opt_state)):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+@pytest.mark.parametrize("algo_name", ["gradient_allreduce", "zero"])
+def test_tracing_is_bitwise_inert(group, algo_name):
+    """The acceptance criterion: tracing on (sampling every step, every
+    phase instrumented) vs off trains bit-identical params + optimizer
+    state, overlap on, for the all-reduce AND the sharded (zero) paths."""
+    state_off = run_steps(group, algo_name, None, steps=3)
+    tracer = Tracer(sample_every=1)
+    state_on = run_steps(group, algo_name, tracer, steps=3)
+    names = [s["name"] for s in tracer.finished_spans()]
+    assert names.count("train_step") == 3  # it actually traced
+    assert any(n.startswith("phase:") for n in names)
+    assert state_sha(state_on) == state_sha(state_off)
