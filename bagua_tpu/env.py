@@ -171,6 +171,49 @@ def get_trace_path() -> Optional[str]:
     return os.environ.get("BAGUA_TRACE_PATH") or None
 
 
+def get_regression_sentinel_enabled() -> bool:
+    """``BAGUA_REGRESSION_SENTINEL``: the performance-regression sentinel —
+    per-step budget attribution plus CUSUM changepoint detection over the
+    step-wall and goodput streams (``observability/regression.py``).  Off
+    by default (it emits ``perf_regression`` incidents, an operator-facing
+    stream); any of ``1``/``true``/``on`` enables.  Bitwise-inert either
+    way — the knob trades host-side arithmetic for a slowdown verdict."""
+    return os.environ.get("BAGUA_REGRESSION_SENTINEL", "0").strip().lower() in (
+        "1", "true", "on", "yes"
+    )
+
+
+def get_regression_warmup() -> int:
+    """``BAGUA_REGRESSION_WARMUP``: steps the sentinel's CUSUM baselines
+    settle before a trip is possible (the health monitor's warmup
+    discipline).  Clamped to ≥ 1."""
+    try:
+        return max(1, int(os.environ.get("BAGUA_REGRESSION_WARMUP", 30)))
+    except ValueError:
+        return 30
+
+
+def get_regression_threshold() -> float:
+    """``BAGUA_REGRESSION_THRESHOLD``: the CUSUM trip threshold ``h`` in
+    baseline-σ units of accumulated drift.  Higher = fewer, surer
+    incidents; the default (8) holds a clean jittery run tripless while a
+    sustained few-σ shift still trips within a handful of steps."""
+    try:
+        return max(1.0, float(os.environ.get("BAGUA_REGRESSION_THRESHOLD", 8.0)))
+    except ValueError:
+        return 8.0
+
+
+def get_regression_cooldown() -> int:
+    """``BAGUA_REGRESSION_COOLDOWN``: steps after a sentinel trip before
+    it may trip again — one sustained regression becomes one incident, not
+    a stream of them."""
+    try:
+        return max(0, int(os.environ.get("BAGUA_REGRESSION_COOLDOWN", 50)))
+    except ValueError:
+        return 50
+
+
 def get_static_verify_mode() -> str:
     """``BAGUA_STATIC_VERIFY``: the pre-dispatch static collective-program
     verifier (``bagua_tpu/analysis/``).  ``off`` (default) skips it;

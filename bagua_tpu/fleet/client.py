@@ -223,10 +223,34 @@ class FleetClient:
 
     def timeline(self, gang_id: str) -> dict:
         """The gang's causally ordered timeline (client spans, server spans,
-        StepSummary windows, health alerts, flight digests)."""
+        StepSummary windows, health alerts, flight digests, incidents)."""
         from urllib.parse import quote
 
         return self._call(f"/fleet/timeline?gang={quote(str(gang_id), safe='')}")
+
+    # -- incidents ----------------------------------------------------------------
+
+    def push_incidents(self, gang_id: str, incidents) -> dict:
+        """Ship a batch of regression-sentinel ``perf_regression``
+        incidents (e.g. ``RegressionSentinel.drain_incidents()``) into the
+        gang's volatile incident ring — what ``/fleet/scheduler`` folds
+        into the ``regressed`` verdict and ``/fleet/incidents`` lists."""
+        from urllib.parse import quote
+
+        return self._call(
+            f"/g/{quote(str(gang_id), safe='')}/incidents",
+            {"incidents": list(incidents)},
+        )
+
+    def incidents(self, gang_id: Optional[str] = None) -> dict:
+        """The fleet's volatile incident tier — every gang's recent
+        ``perf_regression`` events, or one gang's when ``gang_id`` is
+        given."""
+        from urllib.parse import quote
+
+        if gang_id is None:
+            return self._call("/fleet/incidents")
+        return self._call(f"/fleet/incidents?gang={quote(str(gang_id), safe='')}")
 
     def metrics_text(self) -> str:
         """The server's ``/fleet/metrics`` Prometheus text exposition."""

@@ -291,8 +291,10 @@ class FleetControlPlane:
         self._server_spans: Dict[str, deque] = {}   # gang -> finished server spans
         self._client_spans: Dict[str, deque] = {}   # gang -> ingested client spans
         self._timeline_events: Dict[str, deque] = {}  # gang -> ingested events
+        self._incidents: Dict[str, deque] = {}  # gang -> perf_regression events
         self._request_counts: Dict[str, int] = {}
         self._deny_counts: Dict[str, int] = {}
+        self._incident_counts: Dict[str, int] = {}
         self.plan_hits = 0
         self.plan_misses = 0
         self.wal = WriteAheadLog(wal_dir, compact_every=compact_every, fsync=fsync) if wal_dir else None
@@ -514,7 +516,9 @@ class FleetControlPlane:
         """Fleet-wide verdicts from the streams gangs already push: per-gang
         ``wedged`` (a flight digest landed — some rank dumped its black box)
         > ``straggler`` (StepSummary p50 spread past the threshold) >
-        ``healthy`` (summaries, no findings) > ``idle`` (nothing pushed)."""
+        ``regressed`` (the gang's regression sentinel pushed a
+        ``perf_regression`` incident) > ``healthy`` (summaries, no
+        findings) > ``idle`` (nothing pushed)."""
         from bagua_tpu.observability.aggregate import StepSummary, straggler_score
 
         self.sweep_leases()
@@ -522,6 +526,7 @@ class FleetControlPlane:
         with self._lock:
             gangs = dict(self._gangs)
             leases = dict(self._leases)
+            incidents_by_gang = {g: list(ring) for g, ring in self._incidents.items()}
         view = {"gangs": {}, "n_gangs": len(gangs)}
         for gang_id, ns in sorted(gangs.items()):
             st = ns.rendezvous
@@ -544,19 +549,30 @@ class FleetControlPlane:
                 attempt = max(by_attempt, key=lambda a: max(s.step for s in by_attempt[a]))
                 summaries = by_attempt[attempt]
             straggler = straggler_score(summaries) if summaries else None
+            incidents = incidents_by_gang.get(gang_id, [])
             if flight_ranks:
                 verdict = "wedged"
             elif straggler is not None:
                 verdict = "straggler"
+            elif incidents:
+                verdict = "regressed"
             elif summaries:
                 verdict = "healthy"
             else:
                 verdict = "idle"
+            last = incidents[-1] if incidents else None
             asn = st.export_membership()
             settled = asn.get("settled")
             view["gangs"][gang_id] = {
                 "verdict": verdict,
                 "straggler": straggler,
+                "regressed": bool(incidents),
+                "incidents": len(incidents),
+                "last_incident": (
+                    {"step": last.get("step"), "dominant": last.get("dominant"),
+                     "stream": last.get("stream")}
+                    if isinstance(last, dict) else None
+                ),
                 "flight_ranks": sorted(flight_ranks),
                 "ranks_reporting": len(summaries),
                 "max_step": max((s.step for s in summaries), default=-1),
@@ -654,6 +670,44 @@ class FleetControlPlane:
                 n_events += 1
         return {"accepted": accepted, "rejected": rejected, "events": n_events}
 
+    def ingest_incidents(self, gang_id: str, incidents) -> dict:
+        """A batch of regression-sentinel ``perf_regression`` incidents
+        (the ``POST /g/<gang>/incidents`` route).  Same volatile contract
+        as the span rings: a bounded per-gang deque, never in the WAL or
+        ``dump()``, restarts empty.  An incident must at least carry a
+        ``step`` and a ``dominant`` component; anything else is counted
+        and dropped (a malformed verdict must never poison the control
+        plane)."""
+        accepted = rejected = 0
+        ring = self._ring(self._incidents, gang_id)
+        for inc in incidents or []:
+            if (not isinstance(inc, dict) or "step" not in inc
+                    or not isinstance(inc.get("dominant"), str)):
+                rejected += 1
+                continue
+            ring.append(dict(inc))
+            accepted += 1
+        if accepted:
+            with self._lock:
+                self._incident_counts[gang_id] = (
+                    self._incident_counts.get(gang_id, 0) + accepted
+                )
+        return {"accepted": accepted, "rejected": rejected}
+
+    def incidents(self, gang_id: Optional[str] = None) -> dict:
+        """The volatile incident tier (the ``GET /fleet/incidents`` route):
+        every gang's recent ``perf_regression`` events, or one gang's when
+        ``gang_id`` is given."""
+        with self._lock:
+            if gang_id is not None:
+                rows = list(self._incidents.get(gang_id, ()))
+                return {"gang": str(gang_id), "incidents": rows,
+                        "n_incidents": len(rows)}
+            gangs = {g: list(ring) for g, ring in sorted(self._incidents.items())
+                     if ring}
+        return {"gangs": gangs,
+                "n_incidents": sum(len(v) for v in gangs.values())}
+
     def timeline(self, gang_id: str) -> dict:
         """The gang's joined, causally ordered timeline: client spans
         (ingested), server spans (recorded per request), StepSummary
@@ -668,6 +722,7 @@ class FleetControlPlane:
             client = list(self._client_spans.get(gang_id, ()))
             server = list(self._server_spans.get(gang_id, ()))
             events = list(self._timeline_events.get(gang_id, ()))
+            incidents = list(self._incidents.get(gang_id, ()))
         items = []
         # the discriminator is "item", not "kind" — spans already carry a
         # "kind" of their own (internal/client/server) that must survive
@@ -677,6 +732,8 @@ class FleetControlPlane:
             items.append({"item": "server_span", "ts": span.get("ts"), **span})
         for ev in events:
             items.append({"item": "event", "ts": ev.get("ts"), **ev})
+        for inc in incidents:
+            items.append({"item": "incident", "ts": inc.get("ts"), **inc})
         if ns is not None:
             st = ns.rendezvous
             for key in st.kv_keys():
@@ -737,6 +794,7 @@ class FleetControlPlane:
             "n_client_spans": len(client),
             "n_server_spans": len(server),
             "n_events": len(events),
+            "n_incidents": len(incidents),
             "n_traces": len(traces),
         }
 
@@ -752,6 +810,7 @@ class FleetControlPlane:
         with self._lock:
             requests = dict(self._request_counts)
             denials = dict(self._deny_counts)
+            incidents = dict(self._incident_counts)
             leases = {g: d - now for g, d in self._leases.items() if g in self._gangs}
             n_gangs = len(self._gangs)
             plan_hits, plan_misses = self.plan_hits, self.plan_misses
@@ -777,6 +836,15 @@ class FleetControlPlane:
             r.counter(
                 f"denials_429_total_{_prom_name(gang_id)}",
                 help=f"requests denied 429 for gang {gang_id}",
+            ).inc(n)
+        r.counter(
+            "incidents_total",
+            help="perf_regression incidents ingested (all gangs)",
+        ).inc(sum(incidents.values()))
+        for gang_id, n in sorted(incidents.items()):
+            r.counter(
+                f"incidents_total_{_prom_name(gang_id)}",
+                help=f"perf_regression incidents ingested for gang {gang_id}",
             ).inc(n)
         for gang_id, remaining in sorted(leases.items()):
             r.gauge(

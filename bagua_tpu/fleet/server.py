@@ -10,11 +10,17 @@ Route map (one port serves the whole fleet):
     /g/<gang_id>/spans           POST: ingest a batch of client-side spans
                                  (+ timeline events) into the gang's
                                  volatile span ring
+    /g/<gang_id>/incidents       POST: ingest a batch of regression-sentinel
+                                 ``perf_regression`` incidents into the
+                                 gang's volatile incident ring
     /fleet/plan/publish          POST: store a proven plan in the cross-gang
                                  cache (fingerprint/topology/algorithm/
                                  wire_precision + plan payload)
     /fleet/plan/lookup           POST: cache lookup by the same key
-    /fleet/scheduler             GET: per-gang healthy/wedged/straggler view
+    /fleet/scheduler             GET: per-gang wedged/straggler/regressed/
+                                 healthy/idle verdict view
+    /fleet/incidents[?gang=<id>] GET: the volatile perf_regression incident
+                                 tier (every gang, or one gang's ring)
     /fleet/gangs                 GET: gang ids + lease remainders
     /fleet/timeline?gang=<id>    GET: the gang's causally ordered timeline
                                  (client+server spans joined by trace_id,
@@ -156,6 +162,11 @@ class FleetHandler(_RdzvHandler):
                              "backpressure_denials": self.fleet.backpressure_denials})
             elif self.path == "/fleet/metrics":
                 self._reply_text(self.fleet.metrics_registry().to_prometheus())
+            elif self.path.split("?", 1)[0] == "/fleet/incidents":
+                from urllib.parse import parse_qs, urlsplit
+
+                gang = (parse_qs(urlsplit(self.path).query).get("gang") or [None])[0]
+                self._reply(self.fleet.incidents(gang))
             elif self.path.split("?", 1)[0] == "/fleet/timeline":
                 from urllib.parse import parse_qs, urlsplit
 
@@ -223,6 +234,10 @@ class FleetHandler(_RdzvHandler):
                             ns.gang_id,
                             payload.get("spans") or [],
                             payload.get("events") or [],
+                        ))
+                    elif sub == "/incidents":
+                        self._reply(self.fleet.ingest_incidents(
+                            ns.gang_id, payload.get("incidents") or [],
                         ))
                     else:
                         self._handle_post(ns.rendezvous, sub, payload)

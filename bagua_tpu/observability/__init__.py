@@ -37,6 +37,12 @@ from bagua_tpu.observability.metrics import (
     validate_metrics_file,
 )
 from bagua_tpu.observability.telemetry import RecompileDetector, Telemetry
+from bagua_tpu.observability.attribution import (
+    BUDGET_COMPONENTS,
+    BudgetModel,
+    StepBudget,
+)
+from bagua_tpu.observability.regression import Cusum, RegressionSentinel
 from bagua_tpu.observability.goodput import (
     GoodputLedger,
     GoodputMeter,
@@ -119,6 +125,12 @@ __all__ = [
     # telemetry
     "RecompileDetector",
     "Telemetry",
+    # budget attribution / regression sentinel
+    "BUDGET_COMPONENTS",
+    "BudgetModel",
+    "StepBudget",
+    "Cusum",
+    "RegressionSentinel",
     # goodput / MFU
     "GoodputLedger",
     "GoodputMeter",

@@ -202,7 +202,8 @@ class GangAggregator:
 
     def __init__(self, client, rank: int = 0, world_size: int = 1,
                  attempt: Optional[str] = None, window: int = 20,
-                 straggler_factor: float = 1.5, registry=None, breaker=None):
+                 straggler_factor: float = 1.5, registry=None, breaker=None,
+                 incident_push=None):
         from bagua_tpu.env import get_rpc_breaker_cooldown_s, get_rpc_breaker_threshold
         from bagua_tpu.resilience.retry import CircuitBreaker
 
@@ -219,6 +220,10 @@ class GangAggregator:
             cooldown_s=get_rpc_breaker_cooldown_s(),
             name="gang-obs",
         )
+        # best-effort sink for regression-sentinel incidents, e.g.
+        # ``lambda incs: fleet.push_incidents(gang_id, incs)`` — same
+        # degradation contract as the KV pushes: failures count, never raise
+        self.incident_push = incident_push
         self.last_view: Optional[GangView] = None
         self._last_summary: Optional[StepSummary] = None
 
@@ -331,4 +336,26 @@ class GangAggregator:
             return None
         summary = summarize_telemetry(telemetry, self.rank, step,
                                       window=self.window, phase_ms=phase_ms)
-        return self.aggregate(summary)
+        view = self.aggregate(summary)
+        sentinel = getattr(telemetry, "regression", None)
+        if sentinel is not None:
+            # the gang view is the only place straggler evidence exists:
+            # feed the attributed excess (and rank) into the budget model so
+            # the sentinel's next incident names it
+            if view is not None and view.straggler is not None:
+                excess = max(0.0, float(view.straggler["p50_ms"])
+                             - float(view.straggler["gang_median_ms"]))
+                sentinel.note_straggler(excess, rank=view.straggler["rank"])
+            if self.incident_push is not None:
+                pending = sentinel.drain_incidents()
+                if pending:
+                    try:
+                        self.incident_push(pending)
+                    except Exception as exc:
+                        logger.debug("gang incident push failed: %s", exc)
+                        if self.registry is not None:
+                            self.registry.counter(
+                                "gang_incident_push_failures_total",
+                                help="fleet incident pushes that failed",
+                            ).inc()
+        return view

@@ -266,6 +266,23 @@ EVENT_PAYLOAD_FIELDS = {
         "old_state": str,
         "new_state": str,
     },
+    # the regression sentinel tripped (observability/regression.py): the
+    # CUSUM stream that fired ("step_wall" / "goodput"), the budget
+    # attribution verdict over the recent window — components is the full
+    # named partition summing to residual_ms by construction, dominant its
+    # largest member — plus the live plan_version and the active trace_id
+    # ("" with tracing off).  Optional extra: straggler_rank when the gang
+    # aggregator attributed the window to a specific rank.
+    "perf_regression": {
+        "stream": str,
+        "dominant": str,
+        "components": dict,
+        "residual_ms": (int, float),
+        "expected_ms": (int, float),
+        "measured_ms": (int, float),
+        "plan_version": int,
+        "trace_id": str,
+    },
 }
 
 

@@ -1,0 +1,272 @@
+"""The performance-regression sentinel: online changepoint detection over
+the step-wall and goodput streams, with a budget-attribution verdict.
+
+A perf regression is the failure aggregate dashboards confirm and nobody
+explains: the step wall drifts 20% and the job keeps training.  The
+sentinel watches the two streams the hub already produces — step wall (up
+is bad) and goodput fraction (down is bad) — with one-sided standardized
+**CUSUM** detectors: each sample's deviation from an EWMA baseline, in
+baseline-σ units, accumulates into ``s = max(0, s + z − k)``; ``s > h``
+trips.  CUSUM catches the small-but-sustained drift a single-sample
+z-threshold misses, while the drift allowance ``k`` ignores ordinary
+jitter; warmup suppresses everything until the baseline settles (the
+health monitor's discipline), and a cooldown re-arms the trip so one
+incident doesn't become a stream of them.
+
+On trip the sentinel aggregates the recent window of
+:class:`~bagua_tpu.observability.attribution.StepBudget` rows, names the
+**dominant** component, and emits one schema-validated ``perf_regression``
+JSONL event carrying the full component partition, the residual, the live
+``plan_version`` and the active ``trace_id`` — the attribution verdict the
+fleet scheduler view and the autopilot consume.  Incidents queue in
+:meth:`drain_incidents` for the gang's best-effort push to the fleet
+control plane's volatile incident tier.
+
+Everything is host-side arithmetic: sentinel on vs off trains
+bitwise-identical state (pinned in CI for ``gradient_allreduce`` and
+``zero`` with overlap on, the health-monitor/flight-recorder contract).
+"""
+
+import collections
+import logging
+import math
+import time
+from typing import Dict, List, Optional
+
+from bagua_tpu.observability.attribution import (
+    BUDGET_COMPONENTS,
+    BudgetModel,
+    StepBudget,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Cusum", "RegressionSentinel"]
+
+
+class Cusum:
+    """One-sided standardized CUSUM over a scalar stream.
+
+    The baseline mean/variance are EWMAs fed only by in-family samples
+    (``z < h``) — a sustained shift must trip the detector, not get
+    absorbed into the baseline.  ``direction=+1`` watches for upward
+    shifts (step wall), ``-1`` for downward (goodput).  The σ floor
+    (``rel_floor`` of the mean, plus ``abs_floor``) keeps a near-constant
+    clean stream from hair-triggering on numerically tiny variance.
+    """
+
+    def __init__(self, k: float = 1.0, h: float = 8.0, warmup: int = 30,
+                 alpha: float = 0.05, direction: int = 1,
+                 rel_floor: float = 0.02, abs_floor: float = 1e-6):
+        self.k = float(k)
+        self.h = float(h)
+        self.warmup = max(1, int(warmup))
+        self.alpha = float(alpha)
+        self.direction = 1 if direction >= 0 else -1
+        self.rel_floor = float(rel_floor)
+        self.abs_floor = float(abs_floor)
+        self.mean: Optional[float] = None
+        self.var = 0.0
+        self.n = 0
+        self.s = 0.0
+        self.trips = 0
+
+    def _sigma(self) -> float:
+        sigma = math.sqrt(max(0.0, self.var))
+        floor = max(self.abs_floor, self.rel_floor * abs(self.mean or 0.0))
+        return max(sigma, floor)
+
+    def update(self, x: float) -> bool:
+        """Feed one sample; True when the accumulated drift trips ``h``
+        (the accumulator resets so the caller's cooldown owns re-arming)."""
+        x = float(x)
+        self.n += 1
+        if self.mean is None:
+            self.mean = x
+            return False
+        z = self.direction * (x - self.mean) / self._sigma()
+        in_family = z < self.h
+        if in_family or self.n <= self.warmup:
+            delta = x - self.mean
+            self.mean += self.alpha * delta
+            self.var = (1.0 - self.alpha) * (self.var + self.alpha * delta * delta)
+        if self.n <= self.warmup:
+            return False
+        self.s = max(0.0, self.s + z - self.k)
+        if self.s > self.h:
+            self.s = 0.0
+            self.trips += 1
+            return True
+        return False
+
+
+class RegressionSentinel:
+    """Watches the per-step stream, attributes regressions, emits incidents.
+
+    Args:
+        budget: the :class:`~bagua_tpu.observability.attribution.BudgetModel`
+            pricing the expected step (default: a self-calibrating one).
+        sink: a :class:`~bagua_tpu.observability.metrics.JsonlSink` for the
+            schema-validated ``perf_regression`` events (None = incidents
+            only accumulate in memory).
+        registry: a :class:`~bagua_tpu.observability.metrics.MetricsRegistry`
+            for the ``perf_regressions_total`` counter.
+        warmup / threshold / drift_k / alpha: CUSUM knobs (shared by both
+            streams; env defaults ``BAGUA_REGRESSION_WARMUP`` /
+            ``BAGUA_REGRESSION_THRESHOLD``).
+        cooldown: steps after a trip before the sentinel can trip again.
+        window: how many recent budgets an incident's verdict aggregates.
+    """
+
+    def __init__(self, budget: Optional[BudgetModel] = None, sink=None,
+                 registry=None, warmup: int = 30, threshold: float = 8.0,
+                 drift_k: float = 1.0, alpha: float = 0.05,
+                 cooldown: int = 50, window: int = 20,
+                 max_incidents: int = 256):
+        self.budget = budget or BudgetModel()
+        self.sink = sink
+        self.registry = registry
+        self.cooldown = max(0, int(cooldown))
+        self.window = max(1, int(window))
+        self.max_incidents = max(1, int(max_incidents))
+        self._wall = Cusum(k=drift_k, h=threshold, warmup=warmup,
+                           alpha=alpha, direction=+1)
+        self._goodput = Cusum(k=drift_k, h=threshold, warmup=warmup,
+                              alpha=alpha, direction=-1)
+        self._budgets: collections.deque = collections.deque(maxlen=self.window)
+        self._cooldown_until = -1
+        self._steps_seen = 0
+        self.plan_version = 0
+        self.incidents: List[Dict] = []
+        self._pending: List[Dict] = []
+
+    # -- evidence hooks (delegated to the budget model) -----------------------
+
+    def note_compile(self, wall_ms: float) -> None:
+        self.budget.note_compile(wall_ms)
+
+    def note_snapshot(self, wall_ms: float) -> None:
+        self.budget.note_snapshot(wall_ms)
+
+    def note_backpressure(self, delay_s: float) -> None:
+        self.budget.note_backpressure(delay_s)
+
+    def note_straggler(self, excess_ms: float, rank: int = -1) -> None:
+        self.budget.note_straggler(excess_ms, rank=rank)
+
+    def note_wire(self, measured_wire_ms: float) -> None:
+        self.budget.note_wire(measured_wire_ms)
+
+    # -- the per-step entry point ---------------------------------------------
+
+    def observe_step(
+        self,
+        step: int,
+        wall_ms: float,
+        host_ms: Optional[float] = None,
+        wire_bytes: Optional[float] = None,
+        goodput_frac: Optional[float] = None,
+        trace_id: str = "",
+    ) -> StepBudget:
+        """Settle this step's budget and run both detectors; on trip, emit
+        one ``perf_regression`` incident.  Returns the settled budget (the
+        hub exports its components as ``step_budget_<component>_ms``
+        gauges)."""
+        self._steps_seen += 1
+        budget = self.budget.settle(step, wall_ms, host_ms=host_ms,
+                                    wire_bytes=wire_bytes)
+        self._budgets.append(budget)
+        tripped_wall = self._wall.update(wall_ms)
+        tripped_goodput = (goodput_frac is not None
+                           and self._goodput.update(goodput_frac))
+        if ((tripped_wall or tripped_goodput)
+                and self._steps_seen > self._cooldown_until):
+            stream = "step_wall" if tripped_wall else "goodput"
+            self._trip(step, stream, trace_id)
+            self._cooldown_until = self._steps_seen + self.cooldown
+        return budget
+
+    # -- the incident ---------------------------------------------------------
+
+    def _verdict(self) -> Dict:
+        """Aggregate the recent window into one partition + dominant name."""
+        components = dict.fromkeys(BUDGET_COMPONENTS, 0.0)
+        residual = measured = expected = 0.0
+        straggler_rank = -1
+        for b in self._budgets:
+            for c in BUDGET_COMPONENTS:
+                components[c] += b.components.get(c, 0.0)
+            residual += b.residual_ms
+            measured += b.measured_ms
+            expected += b.expected_ms
+            if b.straggler_rank >= 0:
+                straggler_rank = b.straggler_rank
+        dominant = max(components, key=lambda c: components[c])
+        if components[dominant] <= 0:
+            dominant = "unattributed"
+        return {
+            "components": {k: round(v, 4) for k, v in components.items()},
+            "dominant": dominant,
+            "residual_ms": round(residual, 4),
+            "measured_ms": round(measured, 4),
+            "expected_ms": round(expected, 4),
+            "straggler_rank": straggler_rank,
+        }
+
+    def _trip(self, step: int, stream: str, trace_id: str) -> None:
+        verdict = self._verdict()
+        # ts stamped here (not left to the sink) so drained incidents carry
+        # it onto the fleet timeline even when no JSONL sink is attached
+        event = {
+            "event": "perf_regression",
+            "ts": time.time(),
+            "step": int(step),
+            "stream": stream,
+            "dominant": verdict["dominant"],
+            "components": verdict["components"],
+            "residual_ms": verdict["residual_ms"],
+            "expected_ms": verdict["expected_ms"],
+            "measured_ms": verdict["measured_ms"],
+            "plan_version": int(self.plan_version),
+            "trace_id": str(trace_id or ""),
+        }
+        if verdict["straggler_rank"] >= 0:
+            event["straggler_rank"] = verdict["straggler_rank"]
+        logger.warning(
+            "perf regression at step %d (%s stream): dominant=%s "
+            "residual=%.2fms over the last %d steps",
+            step, stream, event["dominant"], event["residual_ms"],
+            len(self._budgets),
+        )
+        if self.registry is not None:
+            self.registry.counter(
+                "perf_regressions_total",
+                help="regression-sentinel trips (perf_regression incidents)",
+            ).inc()
+        if self.sink is not None:
+            try:
+                self.sink.emit(dict(event))
+            except ValueError:
+                pass  # sink closed under us; the incident still queues
+        self.incidents.append(event)
+        if len(self.incidents) > self.max_incidents:
+            del self.incidents[: len(self.incidents) - self.max_incidents]
+        self._pending.append(event)
+        if len(self._pending) > self.max_incidents:
+            del self._pending[: len(self._pending) - self.max_incidents]
+
+    def drain_incidents(self) -> List[Dict]:
+        """Incidents emitted since the last drain — what the gang
+        aggregator pushes (best-effort) to the fleet incident tier."""
+        out, self._pending = self._pending, []
+        return out
+
+    def report(self) -> Dict:
+        return {
+            "steps_seen": self._steps_seen,
+            "incidents": len(self.incidents),
+            "wall_trips": self._wall.trips,
+            "goodput_trips": self._goodput.trips,
+            "last_incident": self.incidents[-1] if self.incidents else None,
+            "budget": self.budget.report(),
+        }

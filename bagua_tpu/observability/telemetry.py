@@ -148,6 +148,18 @@ class Telemetry:
             pass ``None`` to force off or an instance to adopt.  The hub
             installs its tracer as the process-wide ambient tracer and a
             retry observer so ``retry_call`` / the RPC transports see it.
+        regression: the performance-regression sentinel
+            (:class:`~bagua_tpu.observability.regression.RegressionSentinel`)
+            the hub feeds: per-step budget attribution
+            (``step_budget_<component>_ms`` gauges) plus CUSUM changepoint
+            detection over the step-wall and goodput streams, emitting
+            schema-validated ``perf_regression`` incidents on trip.  The
+            default ``"auto"`` builds one only under
+            ``BAGUA_REGRESSION_SENTINEL=1`` (knobs
+            ``BAGUA_REGRESSION_WARMUP`` / ``_THRESHOLD`` / ``_COOLDOWN``),
+            priced from the goodput meter's α–β wire model when one is
+            attached; pass ``None`` to force off or an instance to adopt.
+            Bitwise-inert either way (host-side arithmetic only).
     """
 
     def __init__(
@@ -160,6 +172,7 @@ class Telemetry:
         goodput=None,
         flight="auto",
         tracing="auto",
+        regression="auto",
     ):
         self.registry = registry or MetricsRegistry()
         self.goodput = goodput
@@ -210,6 +223,33 @@ class Telemetry:
             from bagua_tpu.observability.tracing import set_global_tracer
 
             set_global_tracer(self.tracer)
+        if regression == "auto":
+            from bagua_tpu.env import (
+                get_regression_cooldown,
+                get_regression_sentinel_enabled,
+                get_regression_threshold,
+                get_regression_warmup,
+            )
+
+            regression = None
+            if get_regression_sentinel_enabled():
+                from bagua_tpu.observability.attribution import BudgetModel
+                from bagua_tpu.observability.regression import RegressionSentinel
+
+                budget = (BudgetModel.from_meter(goodput)
+                          if goodput is not None else BudgetModel())
+                regression = RegressionSentinel(
+                    budget=budget,
+                    warmup=get_regression_warmup(),
+                    threshold=get_regression_threshold(),
+                    cooldown=get_regression_cooldown(),
+                )
+        self.regression = regression
+        if self.regression is not None:
+            if self.regression.sink is None:
+                self.regression.sink = self.jsonl
+            if self.regression.registry is None:
+                self.regression.registry = self.registry
         from bagua_tpu.resilience.retry import set_retry_observer
 
         set_retry_observer(self.on_rpc_retry)
@@ -264,6 +304,8 @@ class Telemetry:
             # trace/span ids let forensics join a wedged collective back to
             # the exact in-flight trace on the fleet timeline.
             out["trace"] = self.tracer.trace_context()
+        if self.regression is not None:
+            out["regression"] = self.regression.report()
         return out
 
     # -- engine feed ---------------------------------------------------------
@@ -304,6 +346,8 @@ class Telemetry:
         ).observe(float(wall_ms))
         if self.goodput is not None:
             self.goodput.on_compile(float(wall_ms) / 1e3)
+        if self.regression is not None:
+            self.regression.note_compile(float(wall_ms))
 
     def on_step(
         self,
@@ -366,6 +410,31 @@ class Telemetry:
             self.tracer.note_step(
                 wall_ms=round(wall_s * 1e3, 3), wire_bytes=int(wire_bytes)
             )
+        if self.regression is not None:
+            host_ms = (sum(host_overhead.values()) * 1e3
+                       if host_overhead else None)
+            goodput_frac = (self.goodput.ledger.goodput_frac()
+                            if self.goodput is not None else None)
+            budget = self.regression.observe_step(
+                int(step), wall_s * 1e3, host_ms=host_ms,
+                wire_bytes=int(wire_bytes), goodput_frac=goodput_frac,
+                trace_id=self._trace_fields().get("trace_id", ""),
+            )
+            # flat-name analog of a bagua_step_budget_ms{component=...}
+            # labeled family, same convention as wire_bytes_precision_<p>
+            for comp, ms in budget.components.items():
+                r.gauge(
+                    f"step_budget_{comp}_ms",
+                    help=f"step-budget residual attributed to {comp}",
+                ).set(round(ms, 4))
+            r.gauge(
+                "step_budget_expected_ms",
+                help="budget-model expected step wall",
+            ).set(round(budget.expected_ms, 4))
+            r.gauge(
+                "step_budget_residual_ms",
+                help="measured minus expected step wall",
+            ).set(round(budget.residual_ms, 4))
         if self.jsonl:
             event = {
                 "event": "step", "step": int(step),
@@ -406,6 +475,8 @@ class Telemetry:
         r = self.registry
         r.counter("rebucket_total", help="bucket-plan swaps adopted by the engine").inc()
         r.gauge("plan_version", help="monotonic bucket-plan version").set(plan_version)
+        if self.regression is not None:
+            self.regression.plan_version = int(plan_version)
         if predicted_exposed_ms is not None:
             r.gauge(
                 "predicted_exposed_comm_ms",
@@ -452,6 +523,8 @@ class Telemetry:
             "precision_switch_total",
             help="per-bucket wire-precision plan swaps adopted by the engine",
         ).inc()
+        if self.regression is not None:
+            self.regression.plan_version = int(plan_version)
         new_precisions = [str(p) for p in new_precisions]
         for prec in sorted(set(new_precisions)):
             r.gauge(
@@ -489,6 +562,10 @@ class Telemetry:
         r.gauge("snapshot_last_step", help="step of the newest snapshot").set(step)
         if self.goodput is not None:
             self.goodput.on_snapshot(kind, float(wall_ms))
+        if self.regression is not None and kind != "async":
+            # only blocking writes stall the step loop; cadenced async
+            # snapshots ride the background writer and cost the step nothing
+            self.regression.note_snapshot(float(wall_ms))
         if self.tracer is not None:
             self.tracer.record_event(
                 "snapshot",
@@ -669,6 +746,8 @@ class Telemetry:
                 "rpc_backpressure_total",
                 help="retries paced by a server Retry-After hint (429s)",
             ).inc()
+        if self.regression is not None:
+            self.regression.note_backpressure(float(delay_s))
         if self.jsonl:
             event = {
                 "event": "rpc_retry", "step": int(self.current_step),

@@ -24,6 +24,15 @@ The output is a schema-validated ``hang_report``
 Invalid input dumps are skipped with a warning; an invalid *report* (or no
 usable dumps at all) exits non-zero so CI lanes can gate on it.
 
+When the regression sentinel was on, its ``perf_regression`` incidents
+usually land in a metrics JSONL next to the dumps; the analyzer folds any
+it finds into the report (``incidents`` extra field), and when both the
+flight forensics and the sentinel point at the same rank — a
+``straggler`` verdict here, a ``straggler``-dominant incident there with
+a matching ``straggler_rank`` — the agreement is recorded as
+``straggler_confirmed_by_sentinel``: two independent witnesses, one from
+collective sequence deltas, one from step-time budget attribution.
+
 Usage::
 
     python ci/diagnose_hang.py --dir /path/to/dumps          # flight_*.json
@@ -65,6 +74,62 @@ def load_dumps(paths):
             continue
         dumps.append(payload)
     return dumps, skipped
+
+
+def sentinel_incidents(pattern: str):
+    """``perf_regression`` events from any metrics JSONL matching
+    ``pattern`` (typically ``<dump_dir>/*.jsonl``): the regression
+    sentinel's online verdicts, folded in as the second witness next to
+    the flight-recorder forensics.  Unreadable files and torn lines are
+    skipped — the incidents are corroboration, never a prerequisite."""
+    incidents = []
+    for path in sorted(globlib.glob(pattern)):
+        try:
+            f = open(path)
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if ev.get("event") == "perf_regression":
+                    incidents.append(ev)
+    incidents.sort(key=lambda e: (e.get("ts") or 0.0))
+    return incidents
+
+
+def fold_incidents(report: dict, incidents) -> None:
+    """Attach sentinel incidents to the report (extra fields only — the
+    hang_report schema checks required fields, so these ride along), and
+    record the rank-level agreement when the flight verdict and the
+    budget attribution both indict the same straggler."""
+    if not incidents:
+        return
+    report["incidents"] = [
+        {
+            "step": inc.get("step"),
+            "stream": inc.get("stream"),
+            "dominant": inc.get("dominant"),
+            "residual_ms": inc.get("residual_ms"),
+            **({"straggler_rank": inc["straggler_rank"]}
+               if "straggler_rank" in inc else {}),
+        }
+        for inc in incidents[-8:]
+    ]
+    if report.get("verdict") != "straggler":
+        return
+    lagging = {int(r) for r in report.get("lagging_ranks") or []}
+    for inc in reversed(incidents):
+        rank = inc.get("straggler_rank", -1)
+        if inc.get("dominant") == "straggler" and isinstance(rank, int) \
+                and rank in lagging:
+            report["straggler_confirmed_by_sentinel"] = rank
+            return
 
 
 def trace_contexts(dumps) -> dict:
@@ -111,6 +176,20 @@ def summarize(report) -> str:
             f"(span {ctx.get('span_id')}) — query "
             f"/fleet/timeline for the RPC chain"
         )
+    incidents = report.get("incidents") or []
+    if incidents:
+        newest = incidents[-1]
+        lines.append(
+            f"sentinel: {len(incidents)} perf_regression incident(s) "
+            f"nearby; newest at step {newest.get('step')} "
+            f"(dominant {newest.get('dominant')})"
+        )
+    if "straggler_confirmed_by_sentinel" in report:
+        lines.append(
+            "straggler verdict CONFIRMED by the regression sentinel: "
+            f"rank {report['straggler_confirmed_by_sentinel']} indicted by "
+            "both the flight rings and the budget attribution"
+        )
     if report.get("detail"):
         lines.append(f"detail: {report['detail']}")
     return "\n".join(lines)
@@ -122,6 +201,9 @@ def main(argv=None) -> int:
                     help="directory holding flight_<rank>.json dumps")
     ap.add_argument("--glob", default=None,
                     help="explicit glob for dump files (overrides --dir)")
+    ap.add_argument("--metrics-glob", default=None,
+                    help="glob for metrics JSONL holding perf_regression "
+                    "incidents (default: *.jsonl next to the dumps)")
     ap.add_argument("--out", default=None,
                     help="write the hang_report JSON here (default: stdout)")
     ap.add_argument("--strict", action="store_true",
@@ -148,6 +230,10 @@ def main(argv=None) -> int:
         # extra field (the report schema checks required fields only):
         # which trace each rank was inside when it wedged
         report["trace_by_rank"] = traces
+    metrics_pattern = args.metrics_glob or os.path.join(
+        os.path.dirname(pattern) or ".", "*.jsonl"
+    )
+    fold_incidents(report, sentinel_incidents(metrics_pattern))
     problems = validate_hang_report(report)
     if problems:
         print("diagnose_hang: internal error — report failed its own "

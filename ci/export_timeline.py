@@ -22,7 +22,12 @@ mapping:
   fleet) renders as an arrow across the process tracks;
 * span annotations (retries, backpressure hints, breaker transitions)
   and ingested timeline events → ``i`` (instant) events on the owning
-  track.
+  track;
+* ``perf_regression`` incidents — from a metrics JSONL (``--metrics``) or
+  riding a ``/fleet/timeline`` response as ``"item": "incident"`` rows —
+  → ``i`` instants named ``perf_regression:<dominant>`` carrying the full
+  budget-component partition in ``args``, so the regression verdict lands
+  on the same Perfetto canvas as the spans it indicts.
 
 :func:`validate_chrome_trace` schema-checks the output — the CI tracing
 lane gates on it.  Stdlib only.
@@ -31,6 +36,8 @@ Usage::
 
     python ci/export_timeline.py --spans spans.jsonl --out trace.json
     python ci/export_timeline.py --timeline timeline.json --out trace.json
+    python ci/export_timeline.py --spans spans.jsonl \
+        --metrics metrics.jsonl --out trace.json
     python ci/export_timeline.py --endpoint 127.0.0.1:29500 --gang g0 \
         --out trace.json
 """
@@ -49,6 +56,7 @@ from bagua_tpu.observability.tracing import validate_span  # noqa: E402
 
 __all__ = [
     "load_span_jsonl",
+    "load_metrics_incidents",
     "spans_to_trace_events",
     "build_chrome_trace",
     "validate_chrome_trace",
@@ -82,9 +90,39 @@ def load_timeline(payload: dict) -> "tuple[List[dict], List[dict]]":
             span = {k: v for k, v in item.items() if k != "item"}
             if not validate_span(span):
                 spans.append(span)
-        elif kind == "event":
+        elif kind in ("event", "incident"):
+            # incident rows are perf_regression events the gang pushed to
+            # the fleet's volatile incident ring — same instant rendering
             events.append({k: v for k, v in item.items() if k != "item"})
     return spans, events
+
+
+def load_metrics_incidents(path: str) -> List[dict]:
+    """The ``perf_regression`` events from a metrics JSONL (rotated set
+    included) — annotation instants for the timeline."""
+    from bagua_tpu.observability.metrics import (
+        rotated_metrics_files, validate_metrics_event,
+    )
+
+    incidents = []
+    for part in rotated_metrics_files(path):
+        try:
+            f = open(part)
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if ev.get("event") == "perf_regression" and \
+                        not validate_metrics_event(ev):
+                    incidents.append(ev)
+    return incidents
 
 
 def _track(span: dict) -> "tuple[str, str]":
@@ -188,10 +226,19 @@ def spans_to_trace_events(
         ts = ev.get("ts")
         if not isinstance(ts, (int, float)):
             continue
-        pid, tid = tracks.resolve("events", str(ev.get("event") or "event"))
+        name = str(ev.get("event") or "event")
+        cat = "event"
+        if name == "perf_regression":
+            # the sentinel's verdict IS the headline: put the dominant
+            # budget component in the instant's name so the Perfetto track
+            # reads perf_regression:compile / :wire_slowdown / ... at a
+            # glance, with the full partition in args
+            name = f"perf_regression:{ev.get('dominant') or 'unattributed'}"
+            cat = "incident"
+        pid, tid = tracks.resolve("events", name)
         out.append({
-            "ph": "i", "name": str(ev.get("event") or "event"),
-            "cat": "event", "s": "t", "ts": round(float(ts) * 1e6, 3),
+            "ph": "i", "name": name,
+            "cat": cat, "s": "t", "ts": round(float(ts) * 1e6, 3),
             "pid": pid, "tid": tid,
             "args": {k: v for k, v in ev.items() if k not in ("event", "ts")},
         })
@@ -257,6 +304,9 @@ def main(argv=None) -> int:
                     help="span JSONL file (repeatable; BAGUA_TRACE_PATH output)")
     ap.add_argument("--timeline", action="append", default=[],
                     help="saved /fleet/timeline JSON response (repeatable)")
+    ap.add_argument("--metrics", action="append", default=[],
+                    help="metrics JSONL whose perf_regression incidents "
+                    "become annotation instants (repeatable)")
     ap.add_argument("--endpoint", default=None,
                     help="live fleet endpoint (host:port) to fetch --gang from")
     ap.add_argument("--gang", default=None,
@@ -274,6 +324,8 @@ def main(argv=None) -> int:
             tl_spans, tl_events = load_timeline(json.load(f))
         spans.extend(tl_spans)
         events.extend(tl_events)
+    for path in args.metrics:
+        events.extend(load_metrics_incidents(path))
     if args.endpoint:
         if not args.gang:
             print("export_timeline: --endpoint requires --gang", file=sys.stderr)

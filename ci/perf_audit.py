@@ -1030,6 +1030,219 @@ def fleet_sim_lane():
     }
 
 
+def regression_attribution_lane(out_prefix: str, steps: int = 200):
+    """Executed regression-sentinel gate: budget attribution held to its
+    three contracts.
+
+    **Clean run trips nothing.** A 200-step gradient_allreduce[overlap]
+    MLP run with the sentinel on (``BAGUA_REGRESSION_SENTINEL=1``) must
+    emit zero ``perf_regression`` events, while exporting the per-component
+    ``bagua_step_budget_<component>_ms`` gauges — the false-positive gate
+    for the self-calibrating CUSUM baseline.
+
+    **Bitwise-inert.** Sentinel on vs off trains bitwise-identical state
+    for gradient_allreduce[overlap] (the 200-step runs) AND zero[overlap]
+    (short runs) — every hook is host-side arithmetic, the health-monitor
+    /flight-recorder/tracing discipline.
+
+    **Injected causes attribute correctly.** Four deterministic synthetic
+    regressions drive fresh priced sentinels: a forced recompile, a
+    blocking snapshot, a fleetsim-injected straggler (the real
+    ``run_fleet`` detection feeds ``note_straggler``), and a 3x wire-byte
+    inflation priced through the α–β wire model.  Each must trip with the
+    matching dominant component, with the partition summing to the
+    residual within 1%; ingesting the incidents into an in-process
+    :class:`FleetControlPlane` must flip the gang's scheduler verdict to
+    ``regressed``.  tests/test_ci_lane.py greps the sentinel line and
+    re-checks the audit fields.
+    """
+    import hashlib
+
+    import bagua_tpu
+    from bagua_tpu.algorithms import build_algorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.fleet.control_plane import FleetControlPlane
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+    from bagua_tpu.observability import (
+        BudgetModel, RegressionSentinel, Telemetry, validate_metrics_file,
+    )
+    from bagua_tpu.perflab.fleetsim import FleetConfig, Straggler, run_fleet
+
+    group = bagua_tpu.init_process_group(intra_size=4)
+    params = init_mlp(jax.random.PRNGKey(0), [64, 128, 128, 64])
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(8 * group.size, 64).astype(np.float32))
+    y = jnp.asarray(rng.rand(8 * group.size, 64).astype(np.float32))
+
+    def run(algo_name, n_steps, sentinel_on, metrics_path=None):
+        if sentinel_on:
+            os.environ["BAGUA_REGRESSION_SENTINEL"] = "1"
+        try:
+            if metrics_path and os.path.exists(metrics_path):
+                os.remove(metrics_path)  # append-mode sink: fresh stream
+            tel = Telemetry(metrics_jsonl=metrics_path, flight=None)
+            ddp = DistributedDataParallel(
+                loss_fn=mse_loss, optimizer=optax.sgd(0.01, momentum=0.9),
+                algorithm=build_algorithm(algo_name), process_group=group,
+                bucket_size_bytes=1 << 16, overlap=True, telemetry=tel,
+            )
+            state = ddp.init(params)
+            losses = None
+            for _ in range(n_steps):
+                state, losses = ddp.train_step(state, (x, y))
+            jax.block_until_ready(losses)
+            digest = hashlib.sha256()
+            for leaf in jax.tree.leaves((state.params, state.opt_state)):
+                digest.update(np.asarray(leaf).tobytes())
+            assert (tel.regression is not None) == sentinel_on, (
+                "BAGUA_REGRESSION_SENTINEL gate broken"
+            )
+            report = tel.regression.report() if sentinel_on else None
+            if metrics_path:
+                tel.export_prometheus(metrics_path + ".prom")
+            tel.close()
+            ddp.shutdown()
+            return digest.hexdigest(), report
+        finally:
+            os.environ.pop("BAGUA_REGRESSION_SENTINEL", None)
+
+    # -- clean run trips nothing (and the gar bitwise witness rides it) -------
+    metrics_path = out_prefix + "_regression_metrics.jsonl"
+    sha_on, clean_report = run("gradient_allreduce", steps, True, metrics_path)
+    sha_off, _ = run("gradient_allreduce", steps, False)
+    assert sha_on == sha_off, (
+        f"sentinel perturbed gradient_allreduce training: {sha_on} != {sha_off}"
+    )
+    assert clean_report["incidents"] == 0 and clean_report["steps_seen"] == steps, (
+        f"clean {steps}-step run must emit zero incidents: {clean_report}"
+    )
+    problems = validate_metrics_file(metrics_path)
+    assert not problems, f"regression lane metrics failed schema: {problems}"
+    with open(metrics_path) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    assert not [e for e in events if e["event"] == "perf_regression"], events
+    with open(metrics_path + ".prom") as f:
+        prom = f.read()
+    from bagua_tpu.observability.attribution import BUDGET_COMPONENTS
+    for comp in BUDGET_COMPONENTS:
+        assert f"bagua_step_budget_{comp}_ms" in prom, (
+            f"step_budget_{comp}_ms gauge missing from the export"
+        )
+
+    # -- zero[overlap] bitwise witness (short: the hooks are the same) --------
+    zsha_on, _ = run("zero", 30, True)
+    zsha_off, _ = run("zero", 30, False)
+    assert zsha_on == zsha_off, (
+        f"sentinel perturbed zero training: {zsha_on} != {zsha_off}"
+    )
+
+    # -- fleetsim straggler: the real detection feeds the sentinel ------------
+    sim = run_fleet(FleetConfig(
+        n_gangs=2, ranks_per_gang=4, windows=2, seed=0,
+        faults=(Straggler(gang=1, rank=2, factor=3.0, phase="wire"),),
+    ))
+    detection = sim["gangs"][1]["straggler_detections"][0]
+    straggler_excess = detection["p50_ms"] - detection["gang_median_ms"]
+    assert straggler_excess > 0, detection
+
+    # -- four injected causes, each attributed to its component ---------------
+    def drive(cause):
+        # priced model: expected = 6 compute + 4 wire = 10 ms
+        sentinel = RegressionSentinel(
+            budget=BudgetModel(compute_ms=6.0, wire_ms=4.0),
+            warmup=20, threshold=8.0, cooldown=0, window=20,
+        )
+        jitter = np.random.RandomState(1)
+        base_bytes = 1 << 20
+        step = 0
+        for _ in range(40):  # clean baseline: jitter under the sigma floor
+            wall = 10.0 + float(jitter.uniform(-0.05, 0.05))
+            sentinel.observe_step(step, wall, host_ms=0.5,
+                                  wire_bytes=base_bytes)
+            step += 1
+        assert not sentinel.incidents, f"{cause}: clean baseline tripped"
+        for _ in range(60):  # sustained injected regression until trip
+            wall, wire_bytes = 10.0, base_bytes
+            if cause == "compile":
+                sentinel.note_compile(8.0)
+                wall += 8.0
+            elif cause == "snapshot":
+                sentinel.note_snapshot(6.0)
+                wall += 6.0
+            elif cause == "straggler":
+                sentinel.note_straggler(straggler_excess,
+                                        rank=detection["rank"])
+                wall += straggler_excess
+            elif cause == "wire_slowdown":
+                # 3x byte inflation priced through the wire model: the
+                # 2x excess over baseline costs 2 x wire_ms = 8 ms
+                wire_bytes = base_bytes * 3
+                wall += 8.0
+            wall += float(jitter.uniform(-0.05, 0.05))
+            sentinel.observe_step(step, wall, host_ms=0.5,
+                                  wire_bytes=wire_bytes)
+            step += 1
+            if sentinel.incidents:
+                break
+        assert sentinel.incidents, f"{cause}: injected regression never tripped"
+        inc = sentinel.incidents[0]
+        assert inc["dominant"] == cause, (
+            f"{cause} misattributed: dominant={inc['dominant']} "
+            f"components={inc['components']}"
+        )
+        err = abs(sum(inc["components"].values()) - inc["residual_ms"])
+        assert err <= 0.01 * max(1.0, abs(inc["residual_ms"])), (
+            f"{cause}: partition off by {err} ms vs residual "
+            f"{inc['residual_ms']} ms"
+        )
+        if cause == "straggler":
+            assert inc["straggler_rank"] == detection["rank"], inc
+        return inc
+
+    causes = ("compile", "snapshot", "straggler", "wire_slowdown")
+    incidents = {cause: drive(cause) for cause in causes}
+
+    # -- the fleet folds incidents into the scheduler verdict -----------------
+    fleet = FleetControlPlane()
+    gang = "regression-lane"
+    fleet.gang(gang)  # namespace so the scheduler view judges it
+    ingest = fleet.ingest_incidents(gang, list(incidents.values()))
+    assert ingest["accepted"] == len(causes) and ingest["rejected"] == 0
+    row = fleet.scheduler_view()["gangs"][gang]
+    assert row["verdict"] == "regressed" and row["regressed"], row
+    assert row["incidents"] == len(causes), row
+    assert "perf_regression" not in json.dumps(fleet.dump()), (
+        "volatile incidents leaked into the durable dump"
+    )
+
+    print(
+        f"[audit] regression attribution lane passed ({steps} clean steps, "
+        f"0 incidents, gar+zero bitwise-inert, injected causes attributed "
+        f"{'/'.join(incidents[c]['dominant'] for c in causes)}, scheduler "
+        "verdict regressed)",
+        file=sys.stderr,
+    )
+    return {
+        "ok": True,
+        "clean_steps": steps,
+        "clean_incidents": 0,
+        "bitwise_identical": True,
+        "injected": {
+            cause: {
+                "dominant": inc["dominant"],
+                "stream": inc["stream"],
+                "residual_ms": inc["residual_ms"],
+                "partition_error_ms": round(
+                    abs(sum(inc["components"].values()) - inc["residual_ms"]), 6
+                ),
+            }
+            for cause, inc in incidents.items()
+        },
+        "straggler_rank": incidents["straggler"]["straggler_rank"],
+        "scheduler_verdict": row["verdict"],
+    }
+
+
 def autotune_planner_lane(fixture_path=None):
     """Recorded-span planner gate (pure cost model, no compile — CPU-safe).
 
@@ -2095,6 +2308,14 @@ def main():
     if args.algo is None and args.wire is None:
         bench_modeled_result = bench_modeled_lane()
         fleet_sim_result = fleet_sim_lane()
+    # Regression-sentinel gate: clean 200-step run trips nothing, sentinel
+    # on/off bitwise-inert (gradient_allreduce + zero, overlap on), four
+    # injected causes attributed to the right budget component, and the
+    # fleet scheduler verdict flips to regressed.  The focused --algo/--wire
+    # lanes skip it.
+    regression_result = None
+    if args.algo is None and args.wire is None:
+        regression_result = regression_attribution_lane(args.out)
     # Recorded-span planner gate: DP partition must beat the greedy seed
     # plan's predicted exposed comm on the committed VGG16 fixture.
     planner_result = autotune_planner_lane()
@@ -2137,6 +2358,7 @@ def main():
              "retrace_lint": retrace_lint_result,
              "bench_modeled": bench_modeled_result,
              "fleet_sim": fleet_sim_result,
+             "regression_attribution": regression_result,
              "resilience": resilience_result,
              "fleet_load": fleet_load_result},
             f, indent=1,

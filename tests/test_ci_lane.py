@@ -47,6 +47,7 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert "bench modeled lane passed" in proc.stderr
     assert "fleet sim lane passed" in proc.stderr
     assert "fleet load lane passed" in proc.stderr
+    assert "regression attribution lane passed" in proc.stderr
 
     # The telemetry smoke emits a JSONL metrics stream next to --out; hold it
     # to the event schema here too (belt and braces: the subprocess already
@@ -230,6 +231,37 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert fl["plan_adoption"]["plan_source"] == "fleet"
     assert fl["plan_adoption"]["published_before_kill"] is True
     assert audit["fleet_load"] == fl
+
+    # The regression-attribution lane's artifact: a clean 200-step sentinel-on
+    # run emitted zero perf_regression incidents while exporting every
+    # per-component budget gauge; sentinel on vs off was bitwise-identical for
+    # gradient_allreduce AND zero; each of the four injected causes tripped
+    # with the matching dominant component (partition summing to the residual
+    # within 1%); and ingesting the incidents flipped the fleet scheduler
+    # verdict to regressed.
+    reg = audit["regression_attribution"]
+    assert reg["ok"] is True
+    assert reg["clean_steps"] >= 200 and reg["clean_incidents"] == 0
+    assert reg["bitwise_identical"] is True
+    causes = {"compile", "snapshot", "straggler", "wire_slowdown"}
+    assert set(reg["injected"]) == causes
+    for cause, inc in reg["injected"].items():
+        assert inc["dominant"] == cause, reg["injected"]
+        assert inc["stream"] in ("step_wall", "goodput")
+        assert inc["partition_error_ms"] <= 0.01 * max(
+            1.0, abs(inc["residual_ms"]))
+    assert reg["straggler_rank"] == 2  # fleetsim's injected wire straggler
+    assert reg["scheduler_verdict"] == "regressed"
+    reg_metrics = str(out) + "_regression_metrics.jsonl"
+    assert os.path.exists(reg_metrics), "regression lane did not emit metrics"
+    assert validate_metrics_file(reg_metrics) == []
+    with open(reg_metrics) as f:
+        rev = [json.loads(line) for line in f if line.strip()]
+    assert not [e for e in rev if e["event"] == "perf_regression"]
+    reg_prom = open(reg_metrics + ".prom").read()
+    assert "bagua_step_budget_compile_ms" in reg_prom
+    assert "bagua_step_budget_wire_slowdown_ms" in reg_prom
+    assert "bagua_step_budget_unattributed_ms" in reg_prom
 
 
 def test_perf_audit_quick_bytegrad_compressed_census(tmp_path):

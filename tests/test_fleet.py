@@ -755,9 +755,11 @@ def test_sigkill_restart_replays_wal_with_live_clients(tmp_path):
 
 
 def test_scheduler_view_verdict_precedence_conflicting_signals():
-    """The verdict ladder is wedged > straggler > healthy > idle: a gang
-    carrying BOTH a flight digest and a straggler-grade p50 spread must
-    come back wedged, with the losing straggler signal still reported."""
+    """The verdict ladder is wedged > straggler > regressed > healthy >
+    idle: a gang carrying BOTH a flight digest and a straggler-grade p50
+    spread must come back wedged, with the losing straggler signal still
+    reported; a sentinel incident outranks healthy summaries but loses to
+    a straggler spread (and the losing ``regressed`` fact survives)."""
     plane = FleetControlPlane(lease_ttl_s=50.0, clock=lambda: 10.0,
                               rdzv_kwargs=RDZV_FAST)
 
@@ -768,13 +770,21 @@ def test_scheduler_view_verdict_precedence_conflicting_signals():
                         phase_ms=phase_ms or {}).payload(),
         )
 
+    incident = {"step": 3, "dominant": "wire_slowdown", "stream": "step_wall"}
+
     # conflicting signals on one gang: a 4x p50 spread AND a flight digest
     push("conflict", 0, 10.0)
     push("conflict", 1, 40.0, phase_ms={"h2d": 30.0, "compute": 5.0})
     plane.gang("conflict").rendezvous.kv_set(flight_kv_key("0", 1), {"hang": True})
-    # the same summaries without the digest sit one rung down
+    # the same summaries without the digest sit one rung down — and an
+    # incident on top must NOT outrank the straggler finding
     push("strag", 0, 10.0)
     push("strag", 1, 40.0, phase_ms={"h2d": 30.0, "compute": 5.0})
+    plane.ingest_incidents("strag", [incident])
+    # healthy summaries + an incident: the sentinel verdict wins
+    push("regressed", 0, 10.0)
+    push("regressed", 1, 11.0)
+    plane.ingest_incidents("regressed", [incident])
     push("ok", 0, 10.0)
     push("ok", 1, 11.0)
     plane.gang("empty")
@@ -786,11 +796,19 @@ def test_scheduler_view_verdict_precedence_conflicting_signals():
     assert gangs["conflict"]["straggler"] is not None
     assert gangs["conflict"]["straggler"]["rank"] == 1
     assert gangs["strag"]["verdict"] == "straggler"
+    # the straggler outranks — but does not erase — the regressed fact
+    assert gangs["strag"]["regressed"] is True
+    assert gangs["strag"]["incidents"] == 1
+    assert gangs["regressed"]["verdict"] == "regressed"
+    assert gangs["regressed"]["last_incident"] == {
+        "step": 3, "dominant": "wire_slowdown", "stream": "step_wall",
+    }
     assert gangs["ok"]["verdict"] == "healthy"
+    assert gangs["ok"]["regressed"] is False
     assert gangs["empty"]["verdict"] == "idle"
-    order = ("empty", "ok", "strag", "conflict")
+    order = ("empty", "ok", "regressed", "strag", "conflict")
     assert [gangs[g]["verdict"] for g in order] == [
-        "idle", "healthy", "straggler", "wedged",
+        "idle", "healthy", "regressed", "straggler", "wedged",
     ]
 
 
@@ -866,3 +884,74 @@ def test_fleet_tracing_timeline_join_and_metrics():
         set_global_tracer(None)
         tracer.close()
         server.shutdown()
+
+
+# ---------------- incident tier (regression sentinel) -------------------------
+
+
+def test_fleet_incident_tier_routes_metrics_and_volatility(tmp_path):
+    """End to end over HTTP: pushed perf_regression incidents land in the
+    gang's volatile ring (malformed ones counted and dropped), surface on
+    /fleet/incidents, /fleet/scheduler (the ``regressed`` verdict +
+    ``last_incident`` fact), /fleet/timeline (``incident`` items) and the
+    /fleet/metrics incident counters — and never touch the WAL: a restart
+    on the same WAL dir comes back with an empty incident tier."""
+    plane = FleetControlPlane(wal_dir=str(tmp_path / "wal"),
+                              rdzv_kwargs=RDZV_FAST)
+    server, base = _serve(plane)
+    try:
+        fc = FleetClient(base)
+        incidents = [
+            {"event": "perf_regression", "ts": time.time(), "step": 12,
+             "stream": "step_wall", "dominant": "compile",
+             "components": {"compile": 8.0, "unattributed": 0.1},
+             "residual_ms": 8.1, "expected_ms": 10.0, "measured_ms": 18.1,
+             "plan_version": 0, "trace_id": ""},
+            {"event": "perf_regression", "ts": time.time(), "step": 40,
+             "stream": "goodput", "dominant": "straggler",
+             "straggler_rank": 2, "components": {"straggler": 120.0},
+             "residual_ms": 120.0, "expected_ms": 10.0,
+             "measured_ms": 130.0, "plan_version": 1, "trace_id": ""},
+        ]
+        out = fc.push_incidents("inc", incidents + ["junk", {"dominant": 3}])
+        assert out["accepted"] == 2 and out["rejected"] == 2
+
+        per_gang = fc.incidents("inc")
+        assert per_gang["gang"] == "inc" and per_gang["n_incidents"] == 2
+        assert [i["dominant"] for i in per_gang["incidents"]] == [
+            "compile", "straggler",
+        ]
+        all_gangs = fc.incidents()
+        assert all_gangs["n_incidents"] == 2
+        assert set(all_gangs["gangs"]) == {"inc"}
+
+        row = fc.scheduler_view()["gangs"]["inc"]
+        assert row["verdict"] == "regressed" and row["regressed"] is True
+        assert row["incidents"] == 2
+        assert row["last_incident"] == {
+            "step": 40, "dominant": "straggler", "stream": "goodput",
+        }
+
+        tl = fc.timeline("inc")
+        tl_incidents = [i for i in tl["items"] if i["item"] == "incident"]
+        assert len(tl_incidents) == 2 and tl["n_incidents"] == 2
+        assert {i["dominant"] for i in tl_incidents} == {
+            "compile", "straggler",
+        }
+
+        text = fc.metrics_text()
+        assert "bagua_fleet_incidents_total 2" in text
+        assert "bagua_fleet_incidents_total_inc 2" in text
+
+        # volatile tier: not a byte of it in the durable dump ...
+        dump = _get_json(base + "/fleet/dump")
+        assert "perf_regression" not in json.dumps(dump)
+        assert "incident" not in json.dumps(dump)
+    finally:
+        server.shutdown()
+
+    # ... so a restart on the same WAL replays to an EMPTY incident tier
+    plane2 = FleetControlPlane(wal_dir=str(tmp_path / "wal"),
+                               rdzv_kwargs=RDZV_FAST)
+    assert plane2.incidents()["n_incidents"] == 0
+    assert plane2.scheduler_view()["gangs"]["inc"]["regressed"] is False

@@ -885,3 +885,203 @@ def test_breaker_transitions_land_on_telemetry(tmp_path):
     prom = tel.registry.to_prometheus()
     assert "bagua_breaker_state 0" in prom
     assert "bagua_breaker_transitions_total 3" in prom
+
+
+# -- budget attribution / regression sentinel ---------------------------------
+
+
+from bagua_tpu.observability import (  # noqa: E402
+    BUDGET_COMPONENTS,
+    BudgetModel,
+    Cusum,
+    RegressionSentinel,
+)
+
+
+def test_perf_regression_event_schema(tmp_path):
+    sink = JsonlSink(str(tmp_path / "m.jsonl"))
+    good = {
+        "event": "perf_regression", "step": 7, "stream": "step_wall",
+        "dominant": "compile",
+        "components": {c: 0.0 for c in BUDGET_COMPONENTS},
+        "residual_ms": 8.0, "expected_ms": 10.0, "measured_ms": 18.0,
+        "plan_version": 2, "trace_id": "",
+    }
+    sink.emit(dict(good))
+    # extra fields ride along (straggler_rank when the gang attributed one)
+    sink.emit(dict(good, straggler_rank=3))
+    # missing payload field and wrong types are rejected at the emit site
+    bad = dict(good)
+    del bad["dominant"]
+    with pytest.raises(ValueError):
+        sink.emit(bad)
+    with pytest.raises(ValueError):
+        sink.emit(dict(good, components="compile"))
+    with pytest.raises(ValueError):
+        sink.emit(dict(good, residual_ms="8"))
+    sink.close()
+    assert not validate_metrics_file(str(tmp_path / "m.jsonl"))
+
+
+def test_budget_partition_sums_to_residual_with_all_components():
+    model = BudgetModel(compute_ms=6.0, wire_ms=4.0)
+    base_bytes = 1 << 20
+    # feed the byte/host baselines with a few clean steps
+    for step in range(5):
+        model.settle(step, 10.0, host_ms=1.0, wire_bytes=base_bytes)
+    model.note_compile(8.0)
+    model.note_snapshot(6.0)
+    model.note_backpressure(0.002)
+    model.note_straggler(3.0, rank=2)
+    budget = model.settle(5, 40.0, host_ms=2.5, wire_bytes=base_bytes * 2)
+    assert set(budget.components) == set(BUDGET_COMPONENTS)
+    assert budget.expected_ms == pytest.approx(10.0)
+    assert budget.residual_ms == pytest.approx(30.0)
+    assert budget.components["compile"] == pytest.approx(8.0)
+    assert budget.components["snapshot"] == pytest.approx(6.0)
+    assert budget.components["backpressure"] == pytest.approx(2.0)
+    assert budget.components["straggler"] == pytest.approx(3.0)
+    # 2x bytes = 1x excess over baseline, priced at wire_ms
+    assert budget.components["wire_slowdown"] == pytest.approx(4.0)
+    assert budget.components["host_data"] == pytest.approx(1.5)
+    # the partition is exact by construction: unattributed is the remainder
+    assert budget.partition_error_ms() == pytest.approx(0.0, abs=1e-9)
+    assert sum(budget.components.values()) == pytest.approx(30.0)
+    assert budget.dominant == "compile"
+    assert budget.straggler_rank == 2
+    # evidence hooks cleared: the next step settles clean
+    nxt = model.settle(6, 10.0, host_ms=1.0, wire_bytes=base_bytes)
+    assert nxt.components["compile"] == 0.0
+    assert nxt.residual_ms == pytest.approx(0.0)
+
+
+def test_budget_self_calibration_holds_fire_then_prices_the_median():
+    model = BudgetModel(calibrate_steps=5)
+    # while calibrating: expected = measured, residual 0, not calibrated
+    early = model.settle(0, 50.0)
+    assert early.residual_ms == 0.0 and not early.calibrated
+    for step in range(1, 6):
+        model.settle(step, 10.0 + step * 0.01)
+    assert model.calibrated
+    budget = model.settle(9, 20.0)
+    assert budget.calibrated
+    assert budget.expected_ms == pytest.approx(10.03, abs=0.5)
+    assert budget.residual_ms == pytest.approx(10.0, abs=0.6)
+    # a regressed step must NOT feed the baseline (no chasing)
+    assert model.expected() == pytest.approx(10.03, abs=0.5)
+
+
+def test_cusum_trips_on_sustained_shift_not_jitter():
+    quiet = Cusum(k=1.0, h=8.0, warmup=10, alpha=0.05)
+    rng = np.random.RandomState(0)
+    assert not any(quiet.update(10.0 + rng.uniform(-0.1, 0.1))
+                   for _ in range(300))
+    shifted = Cusum(k=1.0, h=8.0, warmup=10, alpha=0.05)
+    for _ in range(50):
+        shifted.update(10.0 + rng.uniform(-0.1, 0.1))
+    tripped = any(shifted.update(12.0 + rng.uniform(-0.1, 0.1))
+                  for _ in range(50))
+    assert tripped and shifted.trips == 1
+    # goodput direction: a DOWNWARD shift trips the direction=-1 detector
+    down = Cusum(k=1.0, h=8.0, warmup=10, alpha=0.05, direction=-1)
+    for _ in range(50):
+        down.update(0.9 + rng.uniform(-0.005, 0.005))
+    assert any(down.update(0.7) for _ in range(50))
+
+
+def test_sentinel_trips_attributes_and_drains(tmp_path):
+    sink = JsonlSink(str(tmp_path / "m.jsonl"))
+    registry = MetricsRegistry()
+    sentinel = RegressionSentinel(
+        budget=BudgetModel(compute_ms=6.0, wire_ms=4.0), sink=sink,
+        registry=registry, warmup=10, threshold=8.0, cooldown=5, window=10,
+    )
+    sentinel.plan_version = 3
+    rng = np.random.RandomState(0)
+    step = 0
+    for _ in range(20):
+        sentinel.observe_step(step, 10.0 + float(rng.uniform(-0.05, 0.05)))
+        step += 1
+    assert not sentinel.incidents
+    while not sentinel.incidents:
+        sentinel.note_compile(8.0)
+        sentinel.observe_step(step, 18.0 + float(rng.uniform(-0.05, 0.05)),
+                              trace_id="00000000000000000000000000000abc")
+        step += 1
+        assert step < 100, "sentinel never tripped"
+    inc = sentinel.incidents[0]
+    assert inc["event"] == "perf_regression"
+    assert inc["stream"] == "step_wall"
+    assert inc["dominant"] == "compile"
+    assert inc["plan_version"] == 3
+    assert inc["trace_id"] == "00000000000000000000000000000abc"
+    assert abs(sum(inc["components"].values()) - inc["residual_ms"]) <= (
+        0.01 * max(1.0, abs(inc["residual_ms"]))
+    )
+    # the JSONL twin validated on emit; the counter ticked
+    sink.close()
+    assert not validate_metrics_file(str(tmp_path / "m.jsonl"))
+    with open(str(tmp_path / "m.jsonl")) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    assert [e["event"] for e in events] == ["perf_regression"]
+    assert registry.counter("perf_regressions_total").value == 1
+    # drain hands over each incident exactly once
+    assert sentinel.drain_incidents() == [inc]
+    assert sentinel.drain_incidents() == []
+    # cooldown re-arms: the sustained regression trips again eventually
+    for _ in range(40):
+        sentinel.note_compile(8.0)
+        sentinel.observe_step(step, 18.0 + float(rng.uniform(-0.05, 0.05)))
+        step += 1
+    assert len(sentinel.incidents) >= 2
+    assert sentinel.report()["wall_trips"] >= 2
+
+
+def test_telemetry_regression_env_gate_and_budget_gauges(tmp_path, monkeypatch):
+    # default off: the hub carries no sentinel
+    assert Telemetry(flight=None).regression is None
+    monkeypatch.setenv("BAGUA_REGRESSION_SENTINEL", "1")
+    monkeypatch.setenv("BAGUA_REGRESSION_WARMUP", "5")
+    path = str(tmp_path / "m.jsonl")
+    tel = Telemetry(metrics_jsonl=path, flight=None)
+    assert tel.regression is not None
+    # the hub adopted its own sink + registry for the sentinel
+    assert tel.regression.sink is tel.jsonl
+    assert tel.regression.registry is tel.registry
+    for step in range(8):
+        tel.on_step(step, wall_s=0.010, n_samples=32, wire_bytes=1 << 16,
+                    host_overhead={"pre": 0.001, "post": 0.001})
+    snap = tel.snapshot()
+    assert snap["regression"]["steps_seen"] == 8
+    assert snap["regression"]["incidents"] == 0
+    prom = tel.registry.to_prometheus()
+    for comp in BUDGET_COMPONENTS:
+        assert f"bagua_step_budget_{comp}_ms" in prom
+    assert "bagua_step_budget_expected_ms" in prom
+    assert "bagua_step_budget_residual_ms" in prom
+    tel.close()
+    assert not validate_metrics_file(path)
+    # explicit instance wins over the env gate
+    monkeypatch.delenv("BAGUA_REGRESSION_SENTINEL")
+    sentinel = RegressionSentinel()
+    tel2 = Telemetry(flight=None, regression=sentinel)
+    assert tel2.regression is sentinel
+    tel2.close()
+
+
+def test_telemetry_feeds_sentinel_evidence_hooks(tmp_path):
+    sentinel = RegressionSentinel(budget=BudgetModel(compute_ms=6.0))
+    tel = Telemetry(flight=None, regression=sentinel)
+    tel.on_compile_done("full", step=0, wall_ms=123.0)
+    tel.on_snapshot(step=0, wall_ms=50.0, n_bytes=100, kind="final")
+    tel.on_snapshot(step=0, wall_ms=999.0, n_bytes=100, kind="async")
+    tel.on_rpc_retry("/rdzv/kv/x", attempt=1, delay_s=0.004,
+                     reason="backpressure")
+    budget = sentinel.budget
+    assert budget._compile_ms == pytest.approx(123.0)
+    # only BLOCKING snapshots stall the step; async writes cost nothing
+    assert budget._snapshot_ms == pytest.approx(50.0)
+    assert budget._backpressure_s == pytest.approx(0.004)
+    tel.on_rebucket(plan_version=7, n_buckets=3)
+    assert sentinel.plan_version == 7
+    tel.close()
