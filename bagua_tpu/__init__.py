@@ -11,6 +11,7 @@ an elastic launcher.
 import bagua_tpu.compat  # noqa: F401  (must run first: grafts jax.shard_map/axis_size on old JAX)
 from bagua_tpu.version import __version__  # noqa: F401
 from bagua_tpu.defs import ReduceOp  # noqa: F401
+from bagua_tpu.mesh import MeshSpec  # noqa: F401
 from bagua_tpu.communication import (  # noqa: F401
     BaguaProcessGroup,
     init_process_group,

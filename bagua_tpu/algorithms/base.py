@@ -119,8 +119,14 @@ class AlgorithmImpl:
         Doubles as the flight recorder's trace-time capture point: every
         exchange path wraps its bucket collective in ``annotate``, so one
         notification here records the whole collective program of a step
-        variant (a no-op unless the engine has a capture active)."""
-        notify_collective(self.algo_name or type(self).__name__, bucket_idx, phase)
+        variant (a no-op unless the engine has a capture active).  The
+        record carries the mesh axes the exchange rides (the group's data
+        axes) so flight-recorder consumers can tell a dp-ring collective
+        from a model-axis one on named meshes."""
+        axes = list(self.process_group.data_axes)
+        notify_collective(
+            self.algo_name or type(self).__name__, bucket_idx, phase, axes=axes
+        )
         return bucket_scope(self.algo_name or type(self).__name__, bucket_idx, phase)
 
     # -- structure ----------------------------------------------------------
@@ -134,7 +140,7 @@ class AlgorithmImpl:
         if bucket_size_bytes is None:
             bucket_size_bytes = get_default_bucket_size()
         return BucketPlan.from_tree(
-            tree, bucket_size_bytes, align_elems=self.process_group.size,
+            tree, bucket_size_bytes, align_elems=self.process_group.exchange_size,
             filter_fn=filter_fn,
         )
 

@@ -117,9 +117,19 @@ class WireModelConfig:
 
     algo: str
     plan: Any                       #: BucketPlan (specs with numel/nbytes/slots)
-    n: int                          #: full gang size
+    n: int                          #: exchange-ring size (== gang size on 1-D meshes)
     n_intra: int = 1                #: intra-axis size (hierarchical legs)
     n_inter: int = 1
+    #: mesh axes the engine's exchange is allowed to ride (named meshes);
+    #: empty = unconstrained (legacy 1-D config).  The axis-conformance arm
+    #: of check_plan_conformance errors on any exchange-scope collective
+    #: touching an axis outside this set — "dp collectives on the dp axis
+    #: only".
+    exchange_axes: Tuple[str, ...] = ()
+    #: every axis of a NAMED mesh (empty on legacy (inter, intra) groups) —
+    #: the perflab cost bridge routes single-axis collectives to per-axis
+    #: ``axis:<name>`` cost legs when this is set.
+    mesh_axes: Tuple[str, ...] = ()
     precisions: Sequence[str] = ()  #: resolved per-bucket wire precision
     fuse: str = "tuple"
     hierarchical: bool = False
@@ -144,12 +154,22 @@ class WireModelConfig:
             precisions = ["f32"] * len(plan.specs)
         wd = getattr(impl, "wire_dtype", None)
         mesh = dict(group.mesh.shape)
+        # The ring the exchange rides: every axis on legacy meshes, the data
+        # axes only on named meshes (tp/sp peers each keep a full ring).
+        exchange_size = getattr(group, "exchange_size", group.size)
+        exchange_axes = tuple(getattr(group, "data_axes", ()) or ())
+        mesh_axes = (
+            tuple(group.all_axes)
+            if getattr(group, "mesh_spec", None) is not None else ()
+        )
         return cls(
             algo=getattr(impl, "algo_name", type(impl).__name__),
             plan=plan,
-            n=group.size,
+            n=exchange_size,
             n_intra=int(mesh.get("intra", 1)),
             n_inter=int(mesh.get("inter", 1)),
+            exchange_axes=exchange_axes,
+            mesh_axes=mesh_axes,
             precisions=precisions,
             fuse=getattr(impl, "fuse", "tuple"),
             hierarchical=bool(getattr(impl, "hierarchical", False)),
@@ -431,6 +451,34 @@ def check_plan_conformance(
                     bucket=bucket,
                 )
             )
+
+    # axis conformance (named meshes): every collective inside one of this
+    # algorithm's exchange scopes must ride the exchange axes only — a dp
+    # collective leaking onto a model axis (tp/sp) would silently average
+    # across tensor-parallel shards.
+    if cfg.exchange_axes:
+        allowed = set(cfg.exchange_axes)
+        for (algo, bucket, phase), descs in groups.items():
+            if algo != cfg.algo:
+                continue
+            for d in descs:
+                stray = [a for a in d.axes if a not in allowed]
+                if stray:
+                    findings.append(
+                        Finding(
+                            check="plan_conformance",
+                            severity="error",
+                            message=(
+                                f"bucket {bucket} phase {phase!r}: "
+                                f"{d.primitive} rides mesh axes "
+                                f"{tuple(d.axes)} but the exchange is "
+                                f"confined to {cfg.exchange_axes} — stray "
+                                f"axes {tuple(stray)}"
+                            ),
+                            label=d.label,
+                            bucket=bucket,
+                        )
+                    )
 
     if cfg.algo not in MODELED_ALGOS:
         return findings

@@ -133,13 +133,19 @@ def predict_flight_program(
         )
         if spec is not None and spec.dtype not in ("f32", "f16", "bf16"):
             prec = "f32"
-        records.append({
+        rec = {
             "algo": algo, "bucket": b, "phase": phase,
             "nbytes": int(spec.nbytes) if spec is not None else 0,
             "precision": prec,
             "plan_version": pv, "variant": str(variant),
             "label": format_exchange_label(algo, b, phase),
-        })
+        }
+        if cfg.exchange_axes:
+            # annotate() stamps the mesh axes the exchange rides; the
+            # prediction must carry the same field for the record-for-record
+            # static/dynamic comparison.
+            rec["axes"] = list(cfg.exchange_axes)
+        records.append(rec)
         hop_descs = [d for d in descs if d.qr and d.qr["stage"] == "hop"]
         ag_descs = [d for d in descs if d.qr and d.qr["stage"] == "ag"]
         for ring_kind, leg in (("rs", hop_descs), ("ag", ag_descs)):

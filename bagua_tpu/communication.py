@@ -32,6 +32,8 @@ Two collective surfaces are provided:
    slice is what that rank would hold after the collective.
 """
 
+import contextlib
+import contextvars
 import functools
 import pickle
 from typing import Callable, List, Optional, Sequence, Tuple
@@ -42,6 +44,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from bagua_tpu.defs import ReduceOp
+from bagua_tpu.mesh import MeshSpec
 
 INTER_AXIS = "inter"
 INTRA_AXIS = "intra"
@@ -51,23 +54,51 @@ _default_group: Optional["BaguaProcessGroup"] = None
 
 
 class BaguaProcessGroup:
-    """A group of ranks arranged on a 2-D ``(inter, intra)`` device mesh.
+    """A group of ranks arranged on a named device mesh.
 
+    Without a ``mesh_spec`` this is the classic 2-D ``(inter, intra)`` mesh:
     ``intra_size`` ranks form the fast inner axis (ICI / one host);
-    ``inter_size = size // intra_size`` forms the slower outer axis (DCN).
+    ``inter_size = size // intra_size`` forms the slower outer axis (DCN),
+    and every axis carries the data-parallel exchange.
+
+    With a :class:`bagua_tpu.mesh.MeshSpec` the mesh axes are the spec's
+    named axes (e.g. ``dp × tp``): the engine's bucketed exchange rides the
+    spec's *data* axes only, while *model* axes (tp/sp/ep/pp) are left to the
+    model's own collectives.
     """
 
-    def __init__(self, devices: Sequence, intra_size: Optional[int] = None, name: str = "bagua"):
+    def __init__(
+        self,
+        devices: Sequence,
+        intra_size: Optional[int] = None,
+        name: str = "bagua",
+        mesh_spec: Optional[MeshSpec] = None,
+    ):
         devices = list(devices)
         n = len(devices)
+        self.name = name
+        self.devices = devices
+        self.mesh_spec = mesh_spec
+        if mesh_spec is not None:
+            if intra_size is not None:
+                raise ValueError(
+                    "pass either intra_size (legacy inter/intra mesh) or "
+                    "mesh_spec (named mesh), not both"
+                )
+            self.mesh = Mesh(mesh_spec.device_array(devices), mesh_spec.names)
+            # Legacy hierarchical split is undefined on a named mesh: the
+            # whole group counts as one "intra" domain for consumers that
+            # only read the attributes (hierarchical exchange itself is
+            # fenced at DDP construction).
+            self.intra_size = n
+            self.inter_size = 1
+            return
         if intra_size is None:
             # Default: devices-per-process (one host = one ICI domain).
             per_proc = max(1, n // max(jax.process_count(), 1))
             intra_size = per_proc if n % per_proc == 0 else n
         if n % intra_size != 0:
             raise ValueError(f"group size {n} not divisible by intra_size {intra_size}")
-        self.name = name
-        self.devices = devices
         self.intra_size = intra_size
         self.inter_size = n // intra_size
         self.mesh = Mesh(
@@ -80,6 +111,35 @@ class BaguaProcessGroup:
         return len(self.devices)
 
     @property
+    def all_axes(self) -> Tuple[str, ...]:
+        """Every mesh axis name (state stacks/shards over all of them)."""
+        if self.mesh_spec is not None:
+            return self.mesh_spec.names
+        return ALL_AXES
+
+    @property
+    def data_axes(self) -> Tuple[str, ...]:
+        """Axes the batch shards over and the gradient exchange rides."""
+        if self.mesh_spec is not None:
+            return self.mesh_spec.data_axes
+        return ALL_AXES
+
+    @property
+    def model_axes(self) -> Tuple[str, ...]:
+        if self.mesh_spec is not None:
+            return self.mesh_spec.model_axes
+        return ()
+
+    @property
+    def exchange_size(self) -> int:
+        """Ranks in the gradient-exchange ring (== ``size`` unless model
+        axes are present — then the exchange communicates only among ranks
+        sharing a model-axis coordinate)."""
+        if self.mesh_spec is not None:
+            return self.mesh_spec.exchange_size
+        return self.size
+
+    @property
     def spans_processes(self) -> bool:
         """True when the group's devices live in more than one OS process
         (multi-host / multi-controller deployment)."""
@@ -90,6 +150,8 @@ class BaguaProcessGroup:
         return list(range(self.size))
 
     def __repr__(self) -> str:
+        if self.mesh_spec is not None:
+            return f"BaguaProcessGroup(size={self.size}, mesh={self.mesh_spec!r})"
         return f"BaguaProcessGroup(size={self.size}, inter={self.inter_size}, intra={self.intra_size})"
 
     # ---- shard_map helpers -------------------------------------------------
@@ -107,6 +169,7 @@ def init_process_group(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    mesh_spec: Optional[MeshSpec] = None,
 ) -> BaguaProcessGroup:
     """Initialize the default process group (reference ``communication.py:446``).
 
@@ -129,7 +192,11 @@ def init_process_group(
         )
     if devices is None:
         devices = jax.devices()
-    _default_group = BaguaProcessGroup(devices, intra_size=intra_size)
+        if mesh_spec is not None:
+            devices = devices[: mesh_spec.size]
+    _default_group = BaguaProcessGroup(
+        devices, intra_size=intra_size, mesh_spec=mesh_spec
+    )
     return _default_group
 
 
@@ -144,7 +211,9 @@ def get_default_group() -> BaguaProcessGroup:
 
 
 def new_group(
-    ranks: Optional[Sequence[int]] = None, intra_size: Optional[int] = None
+    ranks: Optional[Sequence[int]] = None,
+    intra_size: Optional[int] = None,
+    mesh_spec: Optional[MeshSpec] = None,
 ) -> BaguaProcessGroup:
     """Create a new group from ranks of the default group
     (reference ``communication.py:217``)."""
@@ -153,7 +222,7 @@ def new_group(
         devices = base.devices
     else:
         devices = [base.devices[r] for r in ranks]
-    return BaguaProcessGroup(devices, intra_size=intra_size)
+    return BaguaProcessGroup(devices, intra_size=intra_size, mesh_spec=mesh_spec)
 
 
 # ---------------------------------------------------------------------------
@@ -161,9 +230,31 @@ def new_group(
 # ---------------------------------------------------------------------------
 
 
+# The ambient axes an ``axis=None`` collective resolves to.  The engine
+# enters :func:`default_axes` inside its shard_map body (the body executes
+# during tracing, so the context is live for exactly that trace): on a
+# named mesh the algorithm's collectives then ride the group's data axes
+# while explicit-axis collectives (the model's tp/sp/ep exchanges) are
+# untouched.  Outside any context the legacy ALL_AXES default applies.
+_DEFAULT_AXES: contextvars.ContextVar = contextvars.ContextVar(
+    "bagua_default_axes", default=None
+)
+
+
+@contextlib.contextmanager
+def default_axes(axes: Sequence[str]):
+    """Make ``axes`` the resolution of ``axis=None`` collectives within."""
+    token = _DEFAULT_AXES.set(tuple(axes))
+    try:
+        yield
+    finally:
+        _DEFAULT_AXES.reset(token)
+
+
 def _axes(axis) -> Tuple[str, ...]:
     if axis is None:
-        return ALL_AXES
+        ambient = _DEFAULT_AXES.get()
+        return ambient if ambient is not None else ALL_AXES
     if isinstance(axis, str):
         return (axis,)
     return tuple(axis)
@@ -329,12 +420,17 @@ def _eager_compiled(group: BaguaProcessGroup, key: tuple, make_fn: Callable):
     cached = _EAGER_CACHE.get(cache_key)
     if cached is None:
         fn = make_fn()
+        axes = group.all_axes
 
         def per_rank(x):
-            return fn(x[0])[None]
+            # eager collectives span the WHOLE group, whatever its axes are
+            # named (the body runs at trace time, so the context is live for
+            # the axis=None resolution inside fn)
+            with default_axes(axes):
+                return fn(x[0])[None]
 
         cached = jax.jit(
-            group.shard_map(per_rank, in_specs=P(ALL_AXES), out_specs=P(ALL_AXES))
+            group.shard_map(per_rank, in_specs=P(axes), out_specs=P(axes))
         )
         _EAGER_CACHE[cache_key] = cached
     return cached
@@ -382,7 +478,7 @@ def _eager(group: Optional[BaguaProcessGroup], key: tuple, make_fn: Callable):
 
     from jax.sharding import NamedSharding
 
-    sharding = NamedSharding(group.mesh, P(ALL_AXES))
+    sharding = NamedSharding(group.mesh, P(group.all_axes))
     n_local = len(local_ranks(group))
 
     def call_local_view(local):
@@ -515,7 +611,7 @@ def barrier(comm: Optional[BaguaProcessGroup] = None):
         # would deadlock against them).
         from jax.sharding import NamedSharding
 
-        sharding = NamedSharding(group.mesh, P(ALL_AXES))
+        sharding = NamedSharding(group.mesh, P(group.all_axes))
         n_local = sum(
             1 for d in group.devices if d.process_index == jax.process_index()
         )

@@ -34,7 +34,12 @@ from jax.sharding import PartitionSpec as P
 
 from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, StepContext
 from bagua_tpu.bucket import BucketPlan, wrap_params_for_overlap
-from bagua_tpu.communication import ALL_AXES, BaguaProcessGroup, get_default_group
+from bagua_tpu.communication import (
+    ALL_AXES,
+    BaguaProcessGroup,
+    default_axes,
+    get_default_group,
+)
 from bagua_tpu.env import get_default_bucket_size, get_static_verify_mode
 from bagua_tpu.observability.annotations import step_scope
 from bagua_tpu.observability.core import StepTimer
@@ -115,12 +120,21 @@ class DistributedDataParallel:
         overlap="auto",
         telemetry=None,
         health_monitor=None,
+        dp_axis=None,
+        fsdp_axis=None,
+        tp_axis=None,
     ):
         self.loss_fn = loss_fn
         self.group = process_group or get_default_group()
+        self._validate_mesh_axes(dp_axis=dp_axis, fsdp_axis=fsdp_axis, tp_axis=tp_axis)
         self.impl: AlgorithmImpl = (
             algorithm.reify(self.group) if isinstance(algorithm, Algorithm) else algorithm
         )
+        if self.group.mesh_spec is not None and getattr(self.impl, "hierarchical", False):
+            raise ValueError(
+                "hierarchical algorithms assume the legacy (inter, intra) mesh; "
+                "construct the group without a MeshSpec (intra_size=...) to use them"
+            )
         if optimizer is None:
             # Algorithms that bundle their own optimizer (QAdam) supply the
             # engine-side update rule themselves.
@@ -199,6 +213,35 @@ class DistributedDataParallel:
         #: host_overhead_snapshot surfaces its p50/p95/p99 tail
         self.step_timer = StepTimer()
 
+    def _validate_mesh_axes(self, **axis_kwargs):
+        """Check the ``dp_axis``/``fsdp_axis``/``tp_axis`` keywords against the
+        group's declared mesh axes at construction (mirrors ``_bound_axes`` in
+        parallel/moe/layer.py): a typo'd name raises here, not deep in trace.
+        The keywords assert roles, they don't reassign them — declare roles on
+        the :class:`~bagua_tpu.mesh.MeshSpec` itself."""
+        from bagua_tpu.mesh import _none_of_declared
+
+        spec = self.group.mesh_spec
+        declared = self.group.all_axes
+        roles = {"dp_axis": "data", "fsdp_axis": "data", "tp_axis": "model"}
+        for kw, value in axis_kwargs.items():
+            if value is None:
+                continue
+            tup = (value,) if isinstance(value, str) else tuple(value)
+            for a in tup:
+                if a not in declared:
+                    raise _none_of_declared(kw, a, declared)
+                if spec is not None:
+                    want = spec.data_axes if roles[kw] == "data" else spec.model_axes
+                    if a not in want:
+                        raise ValueError(
+                            f"mesh axis {a!r} is declared but carries the "
+                            f"{'model' if roles[kw] == 'data' else 'data'} role on "
+                            f"{spec!r} — {kw} must name one of its "
+                            f"{roles[kw]} axes; assign roles on the MeshSpec "
+                            f"(dp_axis/fsdp_axis/tp_axis at spec construction)"
+                        )
+
     # -- initialization -----------------------------------------------------
 
     def init(self, params=None, stacked_params=None) -> TrainState:
@@ -246,7 +289,7 @@ class DistributedDataParallel:
         # state would make the first step's jit signature differ from every
         # later step's, compiling the full step graph twice back-to-back
         # (~2x VGG16's compile latency at startup, measured on v5e).
-        sharding = jax.sharding.NamedSharding(self.group.mesh, P(ALL_AXES))
+        sharding = jax.sharding.NamedSharding(self.group.mesh, P(self.group.all_axes))
         if stacked_params is not None:
             build_stacked = lambda sp: TrainState(
                 params=sp,
@@ -457,7 +500,7 @@ class DistributedDataParallel:
         ]:
             return True
         plan = BucketPlan.from_declarations(
-            buckets, self._tree_template, align_elems=self.group.size
+            buckets, self._tree_template, align_elems=self.group.exchange_size
         )
         self.rebucket(plan)
         if payload.get("bucket_size_bytes"):
@@ -478,8 +521,9 @@ class DistributedDataParallel:
         overlap = self.overlap_enabled
         updater = self._sharded_updater  # rebucket rebuilds it + clears _step_fns
         health_on = self.health_monitor is not None
+        all_axes, data_axes = group.all_axes, group.data_axes
 
-        def local_step(state: TrainState, batch):
+        def _local_body(state: TrainState, batch):
             params, opt_state, algo_state, step = (
                 _local(state.params),
                 _local(state.opt_state),
@@ -614,11 +658,24 @@ class DistributedDataParallel:
                 return new_state, loss[None], health[None]
             return new_state, loss[None]
 
+        def local_step(state: TrainState, batch):
+            # The body executes during tracing, so this context scopes the
+            # trace: every ``axis=None`` collective the algorithm issues (the
+            # bucketed exchange) resolves to the group's *data* axes, while
+            # the model's explicit-axis collectives (tp/sp/ep) are untouched.
+            # On the legacy (inter, intra) mesh data_axes == all axes, so the
+            # emitted program is unchanged.
+            with default_axes(data_axes):
+                return _local_body(state, batch)
+
         n_out = 3 if health_on else 2
+        # State stacks/shards over every mesh axis; the batch shards over the
+        # data axes only (replicated across model axes — each tp peer sees
+        # the same examples, Megatron-style).
         return self.group.shard_map(
             local_step,
-            in_specs=(P(ALL_AXES), P(ALL_AXES)),
-            out_specs=(P(ALL_AXES),) * n_out,
+            in_specs=(P(all_axes), P(data_axes)),
+            out_specs=(P(all_axes),) * n_out,
         )
 
     # -- static verification (pre-dispatch gate) -----------------------------
@@ -905,7 +962,7 @@ class DistributedDataParallel:
                 # Ring-model bytes per leg: a reduce-scatter or all-gather of
                 # an N-byte bucket moves N*(n-1)/n on the wire — each leg half
                 # of the all-reduce's 2N*(n-1)/n.
-                n = self.group.size
+                n = self.group.exchange_size
                 leg = self.plan.total_bytes() * (n - 1) // n
                 wire_by_leg = {"rs": leg, "ag": leg}
             wire_by_precision = None
@@ -968,6 +1025,12 @@ class DistributedDataParallel:
         the same cost class as the re-jit the swap already triggers."""
         import numpy as np
 
+        if self.group.exchange_size != self.group.size:
+            raise ValueError(
+                "host-side shard migration is undefined when model axes are "
+                "present (shard rows are per exchange-ring rank, state rows "
+                "per mesh rank); run rebucket before init or drop the tp axis"
+            )
         old = self._pending_reshard
         self._pending_reshard = None
         new = self._sharded_updater.layout
@@ -998,7 +1061,7 @@ class DistributedDataParallel:
             opt_state=ShardedOptState(sharded=tuple(new_sharded), local=opt.local),
             algo_state=algo,
         )
-        sharding = jax.sharding.NamedSharding(self.group.mesh, P(ALL_AXES))
+        sharding = jax.sharding.NamedSharding(self.group.mesh, P(self.group.all_axes))
         return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding), host)
 
     def reshard_host_state(
@@ -1014,6 +1077,12 @@ class DistributedDataParallel:
 
         from bagua_tpu.checkpoint.checkpointing import remap_world_size
 
+        if self.group.exchange_size != self.group.size:
+            raise ValueError(
+                "snapshot resharding is undefined when model axes are present "
+                "(per-rank shard rows don't map 1:1 to exchange-ring slots); "
+                "resume onto a data-only mesh, then re-shard"
+            )
         old = ShardLayout.from_payload(plan_payload, old_world)
         new = self._sharded_updater.layout
         n_new = self.group.size
@@ -1065,18 +1134,20 @@ class DistributedDataParallel:
         if self._sharded_updater is None:
             return state
         impl, plan, group = self.impl, self.plan, self.group
+        all_axes, data_axes = group.all_axes, group.data_axes
 
         def local_fin(state):
-            params = _local(state.params)
-            algo_state = _local(state.algo_state)
-            ctx = StepContext(group=group, step=state.step[0], plan=plan)
-            params, algo_state = impl.on_step_start(params, algo_state, ctx)
-            return state._replace(
-                params=_restack(params), algo_state=_restack(algo_state)
-            )
+            with default_axes(data_axes):
+                params = _local(state.params)
+                algo_state = _local(state.algo_state)
+                ctx = StepContext(group=group, step=state.step[0], plan=plan)
+                params, algo_state = impl.on_step_start(params, algo_state, ctx)
+                return state._replace(
+                    params=_restack(params), algo_state=_restack(algo_state)
+                )
 
         fn = self.group.shard_map(
-            local_fin, in_specs=(P(ALL_AXES),), out_specs=P(ALL_AXES)
+            local_fin, in_specs=(P(all_axes),), out_specs=P(all_axes)
         )
         return jax.jit(fn)(state)
 
@@ -1193,8 +1264,8 @@ class DistributedDataParallel:
             compiled = jax.jit(
                 self.group.shard_map(
                     local_probe,
-                    in_specs=(P(ALL_AXES), P(ALL_AXES)),
-                    out_specs=P(ALL_AXES),
+                    in_specs=(P(self.group.all_axes), P(self.group.data_axes)),
+                    out_specs=P(self.group.all_axes),
                 )
             ).lower(state, batch).compile()  # the one extra compile
             jax.block_until_ready(compiled(state, batch))  # settle (warmup run)
@@ -1255,8 +1326,8 @@ class DistributedDataParallel:
             fn = jax.jit(
                 self.group.shard_map(
                     local_grads,
-                    in_specs=(P(ALL_AXES), P(ALL_AXES)),
-                    out_specs=P(ALL_AXES),
+                    in_specs=(P(self.group.all_axes), P(self.group.data_axes)),
+                    out_specs=P(self.group.all_axes),
                 )
             )
             jax.block_until_ready(fn(state, batch))  # compile + settle
@@ -1278,7 +1349,7 @@ class DistributedDataParallel:
             return local_batch
         import numpy as np
 
-        sharding = jax.sharding.NamedSharding(self.group.mesh, P(ALL_AXES))
+        sharding = jax.sharding.NamedSharding(self.group.mesh, P(self.group.data_axes))
         return jax.tree.map(
             lambda x: jax.make_array_from_process_local_data(
                 sharding, np.asarray(x)
@@ -1419,7 +1490,7 @@ class AutotuneSession:
             [td.name for td in b] for b in proposed
         ] != [[td.name for td in b] for b in current]:
             plan = BucketPlan.from_declarations(
-                proposed, self.ddp._tree_template, align_elems=self.ddp.group.size
+                proposed, self.ddp._tree_template, align_elems=self.ddp.group.exchange_size
             )
             self.ddp.rebucket(
                 plan,

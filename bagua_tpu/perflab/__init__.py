@@ -53,6 +53,7 @@ from bagua_tpu.perflab.fleetsim import (
 from bagua_tpu.perflab.topology import (
     DEFAULT_TOPOLOGY,
     TopologyAssumptions,
+    t_axis_collective,
     t_collective,
     torus_dims,
 )
@@ -77,6 +78,7 @@ __all__ = [
     "pallas_kernel_basis",
     "price_program",
     "run_fleet",
+    "t_axis_collective",
     "t_collective",
     "torus_dims",
 ]

@@ -65,6 +65,16 @@ def classify_leg(d: CollectiveDescriptor, cfg: Optional[WireModelConfig]) -> str
         and d.axes[0] in ("intra", "inter")
     ):
         return d.axes[0]
+    if (
+        leg == "flat"
+        and cfg is not None
+        and len(d.axes) == 1
+        and d.axes[0] in getattr(cfg, "mesh_axes", ())
+    ):
+        # Named-mesh engines: a single-axis collective rides that axis's
+        # link (dp ring on DCN, tp ring on ICI, ...); price it on the
+        # per-axis fitted leg (CostModel.axis_leg falls back to flat).
+        return f"axis:{d.axes[0]}"
     return leg
 
 
@@ -148,7 +158,10 @@ def price_program(
     for (algo, bucket, phase, leg), descs in _grouped(program, cfg).items():
         nbytes = _deduped(descs, lambda d: d.wire_bytes)
         count = _deduped(descs, lambda d: 1)
-        ab = legs[leg]
+        if leg.startswith("axis:"):
+            ab = cost_model.axis_leg(leg[len("axis:"):])
+        else:
+            ab = legs[leg]
         seconds = count * ab.alpha + nbytes / ab.beta
         rows.append({
             "algo": algo, "bucket": bucket, "phase": phase, "leg": leg,

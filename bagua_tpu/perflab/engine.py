@@ -75,6 +75,12 @@ class ModeledCell:
     leg_breakdown: Dict[str, Dict]
     kernel_basis: Dict
     findings: List[str]
+    #: the engine's mesh shape (``{"inter": 2, "intra": 4}`` or
+    #: ``{"dp": 4, "tp": 2}``) — the cell key that lets BENCH_MODELED.json
+    #: hold dp×tp / dp×fsdp cells alongside the 1-D rows
+    mesh: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: axes the cell's gradient exchange rode (provenance for per-axis legs)
+    exchange_axes: List[str] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> Dict:
         d = dataclasses.asdict(self)
@@ -185,6 +191,8 @@ def model_step_cell(
         leg_breakdown=priced.by_leg(),
         kernel_basis=pallas_kernel_basis(cfg.algo, wire),
         findings=[str(f) for f in report.errors],
+        mesh={k: int(v) for k, v in ddp.group.mesh.shape.items()},
+        exchange_axes=list(cfg.exchange_axes),
     )
 
 

@@ -21,6 +21,7 @@ import math
 __all__ = [
     "DEFAULT_TOPOLOGY",
     "TopologyAssumptions",
+    "t_axis_collective",
     "t_collective",
     "torus_dims",
 ]
@@ -49,6 +50,19 @@ class TopologyAssumptions:
     def dcn_bw_chip(self) -> float:
         """Per-chip share of the host's DCN bandwidth."""
         return self.dcn_bw_host / self.chips_per_host
+
+    def axis_link(self, axis: str, within_pod: bool = False) -> str:
+        """The physical leg one named mesh axis's collectives ride in the
+        projected fleet layout: model axes (tp/sp/ep/...) are packed inside
+        a pod slice and ride ICI; data axes (dp/fsdp/...) span hosts and
+        ride DCN once the gang outgrows one pod (``within_pod=False``).
+        The legacy hierarchical names keep their historical placement:
+        ``intra`` is ICI, ``inter`` follows the data-axis rule."""
+        from bagua_tpu.mesh import MODEL_AXIS_NAMES
+
+        if axis == "intra" or axis in MODEL_AXIS_NAMES or within_pod:
+            return "ici"
+        return "dcn"
 
     def describe(self) -> dict:
         return {
@@ -97,3 +111,31 @@ def t_collective(
     if kind == "permute":  # neighbor exchange: one hop, n-independent
         return bytes_per_chip / topo.ici_bw_chip + topo.ici_lat_hop
     raise ValueError(kind)
+
+
+def t_axis_collective(
+    kind: str,
+    bytes_per_chip: float,
+    n: int,
+    axis: str,
+    topo: TopologyAssumptions = DEFAULT_TOPOLOGY,
+    within_pod: bool = False,
+) -> float:
+    """Per-chip time of one collective riding a *named mesh axis* of size
+    ``n``: the axis's :meth:`TopologyAssumptions.axis_link` picks the wire.
+    ICI legs reuse :func:`t_collective`'s torus model; DCN legs pay the same
+    ring byte factor on the per-chip DCN share with no torus latency term
+    (host NICs, worst-case bound — the same model as the multi-pod rows)."""
+    if n <= 1:
+        return 0.0
+    if topo.axis_link(axis, within_pod) == "ici":
+        return t_collective(kind, bytes_per_chip, n, topo)
+    if kind == "allreduce":
+        factor = 2 * (n - 1) / n
+    elif kind in ("allgather", "alltoall", "reducescatter"):
+        factor = (n - 1) / n
+    elif kind == "permute":
+        factor = 1.0
+    else:
+        raise ValueError(kind)
+    return factor * bytes_per_chip / topo.dcn_bw_chip()

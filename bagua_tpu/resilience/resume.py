@@ -153,7 +153,6 @@ class ElasticResumeCoordinator:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from bagua_tpu.checkpoint import remap_world_size
-        from bagua_tpu.communication import ALL_AXES
 
         step = self.agreed_resume_step(nonce=nonce)
         if step is None:
@@ -211,7 +210,7 @@ class ElasticResumeCoordinator:
         # Match the engine state's leaf dtypes (remap's broadcast goes through
         # jnp and can weak-type) and commit to the step function's sharding —
         # each process materializes exactly its addressable shards.
-        sharding = NamedSharding(ddp.group.mesh, P(ALL_AXES))
+        sharding = NamedSharding(ddp.group.mesh, P(ddp.group.all_axes))
 
         def commit(host, like):
             arr = np.asarray(host, dtype=like.dtype)

@@ -77,12 +77,19 @@ class WireSample:
     ``ppermute`` plus the fused dequant-reduce-requant kernel, see
     :mod:`bagua_tpu.kernels.quantized_ring`; ``nbytes`` is the hop's
     compressed payload + sidecar).  ``hidden_frac`` is the span's measured
-    overlap fraction from the device trace, if attributed."""
+    overlap fraction from the device trace, if attributed.
+
+    ``axis`` tags the named mesh axis the collective rode (``"dp"``,
+    ``"tp"``, ...) on named-mesh engines; :meth:`CostModel.from_samples`
+    fits one α–β leg per tagged axis so a dp-ring exchange and a tp
+    activation exchange are priced on their own links.  ``None`` (legacy
+    meshes) keeps the sample on its ``leg`` fit."""
 
     nbytes: float
     seconds: float
     leg: str = "flat"
     hidden_frac: Optional[float] = None
+    axis: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -197,6 +204,7 @@ class CostModel:
         pp: AlphaBeta = DEFAULT_PP,
         qr8: AlphaBeta = DEFAULT_QR8,
         qr4: AlphaBeta = DEFAULT_QR4,
+        axis_legs: Optional[Dict[str, AlphaBeta]] = None,
     ):
         self.flat = flat
         self.intra = intra
@@ -207,14 +215,27 @@ class CostModel:
         self.pp = pp
         self.qr8 = qr8
         self.qr4 = qr4
+        #: per-named-mesh-axis α–β legs (``{"dp": ..., "tp": ...}``); a
+        #: collective riding exactly one named axis is priced on its axis
+        #: leg when one was fitted, the generic ``flat`` leg otherwise.
+        self.axis_legs: Dict[str, AlphaBeta] = dict(axis_legs or {})
+
+    def axis_leg(self, axis: str) -> AlphaBeta:
+        """The α–β model for a collective riding one named mesh axis —
+        the fitted per-axis leg, falling back to ``flat``."""
+        return self.axis_legs.get(axis, self.flat)
 
     @classmethod
     def from_samples(
         cls, samples: Sequence[WireSample], intra_size: int = 1
     ) -> "CostModel":
         by_leg: Dict[str, List[WireSample]] = {}
+        by_axis: Dict[str, List[WireSample]] = {}
         for s in samples:
-            by_leg.setdefault(s.leg, []).append(s)
+            if getattr(s, "axis", None):
+                by_axis.setdefault(s.axis, []).append(s)
+            else:
+                by_leg.setdefault(s.leg, []).append(s)
         return cls(
             flat=fit_alpha_beta(by_leg.get("flat", []), DEFAULT_FLAT),
             intra=fit_alpha_beta(by_leg.get("intra", []), DEFAULT_INTRA),
@@ -225,6 +246,10 @@ class CostModel:
             pp=fit_alpha_beta(by_leg.get("pp", []), DEFAULT_PP),
             qr8=fit_alpha_beta(by_leg.get("qr8", []), DEFAULT_QR8),
             qr4=fit_alpha_beta(by_leg.get("qr4", []), DEFAULT_QR4),
+            axis_legs={
+                ax: fit_alpha_beta(ss, DEFAULT_FLAT)
+                for ax, ss in by_axis.items()
+            },
         )
 
     def bucket_wire_time(
@@ -279,6 +304,9 @@ class CostModel:
         return (n - 1) * self.pp.predict(nbytes / n)
 
     def describe(self) -> Dict:
+        named = tuple(
+            (f"axis:{ax}", m) for ax, m in sorted(self.axis_legs.items())
+        )
         return {
             leg: {
                 "alpha_us": round(m.alpha * 1e6, 3),
@@ -294,7 +322,7 @@ class CostModel:
                 ("pp", self.pp),
                 ("qr8", self.qr8),
                 ("qr4", self.qr4),
-            )
+            ) + named
         }
 
 

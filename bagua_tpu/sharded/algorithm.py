@@ -110,7 +110,7 @@ class ZeroAlgorithmImpl(WirePrecisionMixin, AlgorithmImpl):
         ``numel/n`` each), zero until the first sharded update lands — the
         step-0 gate in :meth:`on_step_start` keeps them from ever being
         applied."""
-        n = self.process_group.size
+        n = self.process_group.exchange_size
         state = {
             "pending": tuple(
                 jnp.zeros((spec.numel // n,), from_bagua_datatype(spec.dtype))
@@ -210,7 +210,7 @@ class ZeroAlgorithmImpl(WirePrecisionMixin, AlgorithmImpl):
         into a zero-filled full-shape image so the leaves keep their
         shapes/dtypes (the sharded updater slices the shard back out)."""
         spec = ctx.plan.specs[bucket_idx]
-        n = self.process_group.size
+        n = self.process_group.exchange_size
         prec = self._precision_for_bucket(bucket_idx, spec)
         with self.annotate(bucket_idx, "rs"):
             flat = flatten_bucket_leaves(grads, spec)

@@ -61,7 +61,13 @@ class ShardedOptimizerUpdater:
         self.inner = inner
         self.plan = plan
         self.group = group
-        self.layout = ShardLayout.from_plan(plan, group.size)
+        # Shards are per exchange-ring slot: on a named mesh with model axes
+        # the reduce-scatter splits each bucket across the data axes only
+        # (each tp peer group keeps a full ring), so the layout follows
+        # exchange_size, not the full mesh size.
+        self.layout = ShardLayout.from_plan(
+            plan, getattr(group, "exchange_size", group.size)
+        )
         self._covered = {s.name for spec in plan.specs for s in spec.slots}
 
     # -- helpers -------------------------------------------------------------

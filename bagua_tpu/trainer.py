@@ -84,6 +84,9 @@ class Trainer:
         telemetry=None,
         health_monitor=None,
         gang_window: int = 0,
+        dp_axis=None,
+        fsdp_axis=None,
+        tp_axis=None,
     ):
         # Env-gated persistent compile cache (BAGUA_COMPILE_CACHE_DIR): a
         # restarted trainer deserializes the step executable instead of
@@ -100,6 +103,7 @@ class Trainer:
             loss_fn, optimizer, algorithm, process_group=process_group,
             dp_filter=dp_filter, telemetry=telemetry,
             health_monitor=health_monitor,
+            dp_axis=dp_axis, fsdp_axis=fsdp_axis, tp_axis=tp_axis,
         )
         self.gang_window = int(gang_window)
         self.gang = None  # built lazily in init_state (needs the KV client)

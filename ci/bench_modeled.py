@@ -78,6 +78,10 @@ LAYERS = [64, 128, 128, 64]
 BUCKET_BYTES = 1 << 12
 WIRES = ("f32", "int8", "int4")
 WIRE_KNOB_ALGOS = ("gradient_allreduce", "zero")
+#: named-mesh sweep: the same modeled algorithms, re-traced on 2-D meshes
+#: so BENCH_MODELED.json carries dp×tp / dp×fsdp cells keyed by mesh shape
+MESH_SPECS = ({"dp": 4, "tp": 2}, {"dp": 4, "fsdp": 2})
+MESH_WIRES = ("f32", "int8")
 CHIP = "v5e"
 MFU_ASSUMED = 0.3
 FIXTURE = os.path.join(REPO, "ci", "fixtures", "vgg16_bucket_spans.json")
@@ -124,8 +128,18 @@ def fit_cost_model(intra_size: int):
     return CostModel.from_samples(samples, intra_size=intra_size), fix
 
 
+def mesh_key(shape):
+    """Stable row key for one mesh shape: ``inter2xintra4``, ``dp4xtp2``."""
+    return "x".join(f"{k}{int(v)}" for k, v in shape.items())
+
+
 def sweep_cell(group, params, batch, cost_model, name, wire, overlap):
-    row = {"algo": name, "wire": wire, "overlap": overlap}
+    row = {
+        "algo": name,
+        "wire": wire,
+        "overlap": overlap,
+        "mesh_key": mesh_key(dict(group.mesh.shape)),
+    }
     if wire != "f32" and name not in WIRE_KNOB_ALGOS:
         row["status"] = "skipped"
         row["reason"] = "algorithm has no wire_precision knob"
@@ -227,6 +241,36 @@ def run_sweep(args):
                     file=sys.stderr,
                 )
 
+    # Named-mesh cells: the same trace → census → α–β pipeline, re-run on
+    # 2-D meshes.  Only the fully-modeled algorithms ride here (the mesh
+    # engine certifies exactly those), and every row carries its mesh shape
+    # + exchange axes so the check lane gates dp×tp and dp×fsdp cells
+    # independently of the legacy 1-D rows.
+    mesh_names = [n for n in names if n in WIRE_KNOB_ALGOS]
+    for spec_axes in MESH_SPECS:
+        mesh_group = bagua_tpu.new_group(
+            mesh_spec=bagua_tpu.MeshSpec(spec_axes)
+        )
+        mkey = mesh_key(spec_axes)
+        for name in mesh_names:
+            for wire in MESH_WIRES:
+                for overlap in (False, True):
+                    row = sweep_cell(
+                        mesh_group, params, batch, cost_model,
+                        name, wire, overlap,
+                    )
+                    rows.append(row)
+                    extra = ""
+                    if "modeled_step_ms" in row:
+                        extra = (f" {row['modeled_step_ms']:.3f} ms, "
+                                 f"{row['modeled_wire_bytes']} B wire")
+                    print(
+                        f"[bench-modeled] {name:28s} wire={wire:4s} "
+                        f"overlap={int(overlap)} mesh={mkey} "
+                        f"-> {row['status']}{extra}",
+                        file=sys.stderr,
+                    )
+
     summary = {
         s: sum(1 for r in rows if r["status"] == s)
         for s in ("pass", "fail", "skipped", "fenced")
@@ -235,6 +279,7 @@ def run_sweep(args):
         "schema": 1,
         "generated_by": "ci/bench_modeled.py",
         "mesh": dict(group.mesh.shape),
+        "meshes": [dict(group.mesh.shape)] + [dict(s) for s in MESH_SPECS],
         "model": {"layers": LAYERS, "bucket_size_bytes": BUCKET_BYTES},
         "assumptions": {
             "chip": CHIP,
@@ -268,13 +313,20 @@ def check_against(report, committed_path):
             committed = json.load(f)
     except OSError as e:
         return [f"committed artifact unreadable: {e}"]
-    old = {
-        (r["algo"], r["wire"], r["overlap"]): r
-        for r in committed.get("rows", [])
-    }
+    # mesh_key joined into the row identity: dp×tp / dp×fsdp cells gate
+    # independently of the legacy rows.  Rows of pre-mesh artifacts carry
+    # no mesh_key and default to the legacy 1-D shape, so fresh legacy rows
+    # still match them while fresh mesh rows stay additive.
+    def row_key(r):
+        return (
+            r.get("mesh_key", "inter2xintra4"),
+            r["algo"], r["wire"], r["overlap"],
+        )
+
+    old = {row_key(r): r for r in committed.get("rows", [])}
     problems = []
     for r in report["rows"]:
-        key = (r["algo"], r["wire"], r["overlap"])
+        key = row_key(r)
         ref = old.get(key)
         if ref is None:
             continue  # new cell: additive, not a regression

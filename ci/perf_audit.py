@@ -1949,6 +1949,184 @@ def audit_tp(out_prefix: str):
     }
 
 
+def audit_llama_mesh(out_prefix: str):
+    """Named-mesh lane (``--model=llama-mesh``): the 2-D engine's wire contract.
+
+    Three gates, asserted in-process (the tier-1 lane ``tests/test_ci_lane.py``
+    greps the sentinels):
+
+    * **dp×tp census** — a llama-style Megatron block (column→row split with
+      the explicit ``psum`` over ``tp``) trained through the engine on a
+      ``MeshSpec({"dp": 4, "tp": 2})`` gang emits a bucketed gradient
+      exchange confined to the ``dp`` axis — zero exchange collectives touch
+      ``tp`` — while the model's tp ring (the Megatron conjugate pair
+      audited by ``--model=tp`` / PERF_AUDIT_TP.json) stays intact.
+    * **static verify** — the strict four-checker pass over the same 2-D
+      step program: rank invariance, per-axis wire-byte exactness (modeled
+      == census bytes), static/dynamic flight-record identity (records
+      carrying the dp axis), and the axis-conformance arm.
+    * **dp×1 parity** — the named ``MeshSpec({"dp": 8})`` engine is bitwise
+      identical (params + optimizer state) to the legacy 1-D engine after 3
+      steps, for gradient_allreduce AND zero, overlap on.
+    """
+    import optax as _optax
+
+    import bagua_tpu
+    from bagua_tpu.algorithms.gradient_allreduce import (
+        GradientAllReduceAlgorithm,
+    )
+    from bagua_tpu.analysis.checks import WireModelConfig
+    from bagua_tpu.analysis.collective_ir import extract_collective_ir
+    from bagua_tpu.analysis.verify import _abstract, verify_step_program
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.sharded.algorithm import ZeroAlgorithm
+
+    rng = np.random.RandomState(0)
+    d_model, d_ff = 16, 32
+
+    def llama_block_loss(params, batch):
+        # One Megatron-split MLP block: column-parallel in, row-parallel
+        # out, the row product summed with an explicit tp collective — the
+        # wire pattern PERF_AUDIT_TP.json audits, here riding inside the
+        # engine's step so the census sees both the tp ring and the dp
+        # exchange in one program.
+        x, y = batch
+        h = jax.nn.silu(x @ params["wi"]) * (x @ params["wg"])
+        o = h @ params["wo"]
+        o = jax.lax.psum(o, "tp")
+        return jnp.mean((o - y) ** 2)
+
+    def block_params():
+        return {
+            "wi": jnp.asarray(rng.randn(d_model, d_ff).astype(np.float32) * 0.1),
+            "wg": jnp.asarray(rng.randn(d_model, d_ff).astype(np.float32) * 0.1),
+            "wo": jnp.asarray(rng.randn(d_ff, d_model).astype(np.float32) * 0.1),
+        }
+
+    def block_batch(seed=0):
+        r = np.random.RandomState(seed)
+        return (
+            jnp.asarray(r.randn(16, d_model).astype(np.float32)),
+            jnp.asarray(r.randn(16, d_model).astype(np.float32)),
+        )
+
+    # -- gate 1: dp×tp census ------------------------------------------------
+    group = bagua_tpu.new_group(mesh_spec=bagua_tpu.MeshSpec({"dp": 4, "tp": 2}))
+    ddp = DistributedDataParallel(
+        llama_block_loss, _optax.adam(1e-2), GradientAllReduceAlgorithm(),
+        process_group=group, bucket_size_bytes=1 << 10, overlap=True,
+    )
+    state = ddp.init(params=block_params())
+    batch = block_batch()
+    variant = ddp.impl.step_variant(0)
+    sharded = ddp._build_sharded(variant)
+    closed = jax.make_jaxpr(sharded)(_abstract(state), _abstract(batch))
+    program = extract_collective_ir(closed, dict(group.mesh.shape))
+    cfg = WireModelConfig.from_engine(ddp)
+
+    exchange = [d for d in program.collectives if d.scope is not None]
+    model_tp = [
+        d for d in program.collectives
+        if d.scope is None and tuple(d.axes) == ("tp",)
+    ]
+    assert exchange, "no exchange collectives traced"
+    stray = [d for d in exchange if tuple(d.axes) != ("dp",)]
+    assert not stray, [
+        (d.primitive, d.axes, d.scope) for d in stray
+    ]
+    assert model_tp, [
+        (d.primitive, d.axes) for d in program.collectives if d.scope is None
+    ]
+    print(
+        "[audit] llama-mesh dp*tp census passed (exchange on dp only: "
+        f"{len(exchange)} collectives; tp ring intact: {len(model_tp)} "
+        "model collectives on tp)",
+        file=sys.stderr,
+    )
+
+    # -- gate 2: strict static verify on the 2-D program ---------------------
+    report = verify_step_program(ddp, state, batch, variant=variant)
+    assert report.ok, [str(f) for f in report.errors]
+    assert cfg.exchange_axes == ("dp",), cfg.exchange_axes
+    # a few engine steps actually dispatch on the 2-D mesh
+    st = state
+    for s in range(2):
+        st, _ = ddp.train_step(st, block_batch(s))
+    ddp.shutdown()
+    print(
+        "[audit] llama-mesh static verify strict passed (2-D program, "
+        "per-axis wire-byte exact, axis-conformant)",
+        file=sys.stderr,
+    )
+
+    # -- gate 3: dp×1 vs legacy 1-D bitwise parity ---------------------------
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+    layers = [16, 32, 32, 8]
+    params = init_mlp(jax.random.PRNGKey(0), layers)
+    pbatch = (
+        jnp.asarray(rng.randn(32, layers[0]).astype(np.float32)),
+        jnp.asarray(rng.randn(32, layers[-1]).astype(np.float32)),
+    )
+
+    def run(g, algo):
+        e = DistributedDataParallel(
+            mse_loss, _optax.adam(1e-2), algo, process_group=g,
+            bucket_size_bytes=1 << 10, overlap=True,
+        )
+        s = e.init(params=jax.tree.map(jnp.copy, params))
+        for _ in range(3):
+            s, _ = e.train_step(s, pbatch)
+        s = e.finalize_pending_updates(s)
+        e.shutdown()
+        return jax.tree.map(np.asarray, s)
+
+    legacy_group = bagua_tpu.new_group(intra_size=1)
+    dp1_group = bagua_tpu.new_group(mesh_spec=bagua_tpu.MeshSpec({"dp": 8}))
+    parity = []
+    for algo_name, algo_cls in (
+        ("gradient_allreduce", GradientAllReduceAlgorithm),
+        ("zero", ZeroAlgorithm),
+    ):
+        a = run(legacy_group, algo_cls())
+        b = run(dp1_group, algo_cls())
+        bitwise = all(
+            np.array_equal(x, y)
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+        )
+        parity.append({"algo": algo_name, "overlap": True, "bitwise": bitwise})
+        assert bitwise, f"{algo_name}: dp*1 diverged from the 1-D engine"
+    print(
+        "[audit] llama-mesh dp*1 bitwise parity passed "
+        "(gradient_allreduce + zero, overlap on, params + opt state)",
+        file=sys.stderr,
+    )
+
+    return {
+        "model": "llama-mesh",
+        "mesh": {k: int(v) for k, v in group.mesh.shape.items()},
+        "census": {
+            "exchange_collectives": len(exchange),
+            "exchange_axes": sorted({tuple(d.axes) for d in exchange})[0],
+            "model_tp_collectives": len(model_tp),
+            "by_descriptor": [
+                {
+                    "primitive": d.primitive,
+                    "axes": list(d.axes),
+                    "scope": d.scope,
+                    "wire_bytes": d.wire_bytes,
+                }
+                for d in program.collectives
+            ],
+        },
+        "static_verify": {
+            "ok": report.ok,
+            "findings": [str(f) for f in report.errors],
+        },
+        "dp1_parity": parity,
+    }
+
+
 EXPECTED = {
     "gradient_allreduce": "one VARIADIC all-reduce per dtype bucket (tuple fusion — "
     "NCCL-allreduce analog with zero concat/slice traffic)",
@@ -2185,9 +2363,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument(
-        "--model", choices=("vgg16", "mlp", "tp"), default="vgg16",
+        "--model", choices=("vgg16", "mlp", "tp", "llama-mesh"), default="vgg16",
         help="mlp: seconds-scale audit for the tier-1 CI lane; tp: the "
-        "collective-matmul lane (fused TP/MoE census + parity + overlap)",
+        "collective-matmul lane (fused TP/MoE census + parity + overlap); "
+        "llama-mesh: the named-mesh 2-D engine lane (dp*tp census, strict "
+        "static verify, dp*1-vs-1-D bitwise parity)",
     )
     ap.add_argument(
         "--ddp-only", action="store_true",
@@ -2221,6 +2401,17 @@ def main():
         if out == os.path.join(REPO, "PERF_AUDIT"):
             out = os.path.join(REPO, "PERF_AUDIT_TP")
         result = audit_tp(out)
+        with open(out + ".json", "w") as f:
+            json.dump(result, f, indent=1)
+        print(f"wrote {out}.json", file=sys.stderr)
+        return
+
+    if args.model == "llama-mesh":
+        # Self-contained like the tp lane; separate artifact.
+        out = args.out
+        if out == os.path.join(REPO, "PERF_AUDIT"):
+            out = os.path.join(REPO, "PERF_AUDIT_LLAMA_MESH")
+        result = audit_llama_mesh(out)
         with open(out + ".json", "w") as f:
             json.dump(result, f, indent=1)
         print(f"wrote {out}.json", file=sys.stderr)

@@ -73,6 +73,7 @@ sys.path.insert(0, REPO)
 
 from bagua_tpu.perflab.topology import (  # noqa: E402
     DEFAULT_TOPOLOGY,
+    t_axis_collective,
     t_collective,
     torus_dims,  # noqa: F401  (re-exported: pre-unification public name)
 )
@@ -223,22 +224,104 @@ def project(model, spec):
     return rows
 
 
+# Named-mesh axis scenarios: the engine's dp×tp layout projected per axis.
+# tp is packed inside a pod slice (ICI by TopologyAssumptions.axis_link);
+# dp spans hosts and drops to the per-chip DCN share once the gang outgrows
+# one pod.  Megatron-style transformer wire model for the tp leg: 4
+# activation all-reduces per layer (2 fwd + 2 bwd) of batch·seq·hidden
+# bf16 bytes; the dp leg is the engine's bucketed gradient all-reduce over
+# the tp-sharded parameter bytes (params/tp · 2 B).
+LLAMA_7B_ARCH = {"hidden": 4096, "layers": 32, "seq": 2048}
+
+
+def project_mesh_axes(model="llama_7b", tp_sizes=(1, 8), n_chips=(64, 256, 512)):
+    spec = MEASURED[model]
+    arch = LLAMA_7B_ARCH
+    t_compute = spec["projected_compute_s"]
+    window = OVERLAP_FRAC * t_compute
+    rows = []
+    for n in n_chips:
+        for tp in tp_sizes:
+            if n % tp:
+                continue
+            dp = n // tp
+            within_pod = n <= POD_SIZE
+            legs = []
+            # dp leg: bf16 bucketed gradient all-reduce of the local
+            # parameter shard (params/tp), riding the dp axis
+            dp_bytes = spec["params"] * 2 / tp
+            t_dp = t_axis_collective(
+                "allreduce", dp_bytes, dp, "dp", TOPO, within_pod=within_pod
+            )
+            legs.append({
+                "axis": "dp",
+                "link": TOPO.axis_link("dp", within_pod=within_pod),
+                "collective": "allreduce",
+                "bytes_per_chip": int(dp_bytes),
+                "t_ms": round(t_dp * 1e3, 3),
+                "provenance": "TopologyAssumptions.axis_link: data axis "
+                              "spans hosts -> DCN beyond one pod",
+            })
+            # tp leg: Megatron activation all-reduces, always ICI
+            t_tp = 0.0
+            if tp > 1:
+                act_bytes = spec["batch"] * arch["seq"] * arch["hidden"] * 2
+                issues = 4 * arch["layers"]
+                t_tp = issues * t_collective("allreduce", act_bytes, tp, TOPO)
+                legs.append({
+                    "axis": "tp",
+                    "link": TOPO.axis_link("tp"),
+                    "collective": f"allreduce x{issues}",
+                    "bytes_per_chip": int(act_bytes * issues),
+                    "t_ms": round(t_tp * 1e3, 3),
+                    "provenance": "TopologyAssumptions.axis_link: model "
+                                  "axis packed in-pod -> ICI",
+                })
+            t_comm = t_dp + t_tp
+            t_n = t_compute + max(0.0, t_comm - window)
+            rows.append({
+                "model": model,
+                "mesh": {"dp": dp, "tp": tp},
+                "n_chips": n,
+                "basis": "projected_compute",
+                "legs": legs,
+                "t_compute_ms": round(t_compute * 1e3, 3),
+                "t_comm_ms": round(t_comm * 1e3, 3),
+                "t_step_ms": round(t_n * 1e3, 3),
+                "exposed_comm_ms": round(max(0.0, t_comm - window) * 1e3, 3),
+                "rate_per_chip": round(spec["batch"] / t_n, 3),
+            })
+    return rows
+
+
 def main():
     all_rows = []
     for model, spec in MEASURED.items():
         all_rows.extend(project(model, spec))
+    mesh_axis_rows = project_mesh_axes()
     out = {
         "assumptions": {
             **TOPO.describe(),
             "regime": "weak scaling, fixed per-chip batch",
+            "mesh_axis_model": (
+                "per-axis legs via TopologyAssumptions.axis_link: model "
+                "axes (tp) in-pod on ICI, data axes (dp) on the per-chip "
+                "DCN share beyond one pod; tp leg = 4 activation "
+                "all-reduces/layer (Megatron), dp leg = bf16 gradient "
+                "all-reduce of the tp-sharded params"
+            ),
         },
         "provenance": {
             "census": "PERF_AUDIT.json (compiled-HLO wire patterns)",
             "measured": ["BENCH_TPU.json", "BENCH_BERT_TPU.json"],
             "topology_model": "bagua_tpu/perflab/topology.py "
             "(shared with BENCH_MODELED.json)",
+            "mesh_axis_legs": "bagua_tpu/perflab/topology.py "
+            "t_axis_collective / TopologyAssumptions.axis_link "
+            "(shared with the named-mesh engine's BENCH_MODELED cells)",
         },
         "rows": all_rows,
+        "mesh_axis_rows": mesh_axis_rows,
     }
     with open(os.path.join(REPO, "SCALING_PROJECTION.json"), "w") as f:
         json.dump(out, f, indent=1)
@@ -275,6 +358,33 @@ def main():
             f"| {r['model']} | {r['algorithm']} | {r['n_chips']} | "
             f"{r['t_step_ms']} | {r['t_comm_ms']} | {r['exposed_comm_ms']} | "
             f"{r['efficiency_vs_8']} | {r['efficiency_no_overlap_vs_8']} | "
+            f"{r['rate_per_chip']} |"
+        )
+    lines += [
+        "",
+        "## Per-mesh-axis legs (dp on DCN × tp on ICI)",
+        "",
+        "The named-mesh engine splits the exchange by axis; the projection "
+        "prices each axis's collectives on its own link through the shared "
+        "`TopologyAssumptions.axis_link` assignment: model axes (tp) are "
+        "packed inside a pod slice and ride ICI, data axes (dp) span hosts "
+        "and drop to the per-chip DCN share once the gang outgrows one pod. "
+        "The tp leg is the Megatron activation pattern (4 all-reduces/layer "
+        "of batch·seq·hidden bf16); the dp leg is the engine's bucketed "
+        "gradient all-reduce over the tp-sharded parameter bytes.",
+        "",
+        "| model | mesh | n | dp leg (link, ms) | tp leg (link, ms) | t_comm ms | t_step ms | rate/chip |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in mesh_axis_rows:
+        by_axis = {leg["axis"]: leg for leg in r["legs"]}
+        dp_leg = by_axis.get("dp")
+        tp_leg = by_axis.get("tp")
+        fmt = lambda leg: f"{leg['link']} {leg['t_ms']}" if leg else "—"
+        mesh = "×".join(f"{k}{v}" for k, v in r["mesh"].items())
+        lines.append(
+            f"| {r['model']} | {mesh} | {r['n_chips']} | {fmt(dp_leg)} | "
+            f"{fmt(tp_leg)} | {r['t_comm_ms']} | {r['t_step_ms']} | "
             f"{r['rate_per_chip']} |"
         )
     lines += [

@@ -21,6 +21,12 @@ stage params are pp-local; everything replicated averages over (dp, sp).
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         JAX_PLATFORMS=cpu python examples/gpt_pretrain/main.py \
         --dp 1 --pp 2 --tp 2 --sp 2 --steps 5
+
+    # engine mode: the bagua DDP engine owns the step over a named MeshSpec
+    # mesh — bucketed gradient exchange (backward-overlapped, or ZeRO under
+    # --algo zero) on the dp/fsdp axes, Megatron tp inside the blocks
+    ... main.py --engine --dp 4 --tp 2 --pp 1 --sp 1 --steps 5
+    ... main.py --engine --dp 4 --fsdp 2 --tp 1 --pp 1 --sp 1 --algo zero
 """
 
 import argparse
@@ -63,12 +69,88 @@ def build(args):
     return cfg, stage, embed, head
 
 
+def run_engine(args):
+    """Engine-driven mesh mode: embed + blocks + head as one parameter tree
+    trained through ``DistributedDataParallel`` over a named ``MeshSpec``
+    mesh — the engine's bucketed exchange rides the dp/fsdp data axes only,
+    the blocks' Megatron tp collectives keep the tp axis.  The pipeline
+    (pp) and ring-attention (sp) compositions stay with the hand-scheduled
+    mode above."""
+    assert args.pp == 1 and args.sp == 1, (
+        "--engine covers dp x tp / dp x fsdp; use the default mode for pp/sp"
+    )
+    import bagua_tpu
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.sharded.algorithm import ZeroAlgorithm
+
+    axes = {"dp": args.dp}
+    if args.fsdp > 1:
+        axes["fsdp"] = args.fsdp
+    if args.tp > 1:
+        axes["tp"] = args.tp
+    group = bagua_tpu.init_process_group(mesh_spec=bagua_tpu.MeshSpec(axes))
+    cfg, stage, embed, head = build(args)
+
+    rng0 = jax.random.PRNGKey(0)
+    x0 = jnp.zeros((2, args.seq, args.hidden), jnp.float32)
+    ids0 = jnp.zeros((2, args.seq), jnp.int32)
+    params = {
+        "embed": embed.init(rng0, ids0)["params"],
+        "stage": stage.init(jax.random.PRNGKey(100), x0)["params"],
+        "head": head.init(jax.random.PRNGKey(1), x0)["params"],
+    }
+
+    def loss_fn(p, batch):
+        ids, labels = batch
+        x = embed.apply({"params": p["embed"]}, ids)
+        x = stage.apply({"params": p["stage"]}, x)
+        logits = head.apply({"params": p["head"]}, x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+
+    algo = ZeroAlgorithm() if args.algo == "zero" else GradientAllReduceAlgorithm()
+    ddp = DistributedDataParallel(
+        loss_fn, optax.adam(1e-3), algo, process_group=group,
+        bucket_size_bytes=1 << 14, overlap=True,
+        dp_axis="dp",
+        fsdp_axis="fsdp" if args.fsdp > 1 else None,
+        tp_axis="tp" if args.tp > 1 else None,
+    )
+    state = ddp.init(params=params)
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, args.vocab, size=(args.steps, args.batch, args.seq + 1))
+    losses = []
+    for i in range(args.steps):
+        batch = (
+            jnp.asarray(data[i, :, :-1], jnp.int32),
+            jnp.asarray(data[i, :, 1:], jnp.int32),
+        )
+        state, step_losses = ddp.train_step(state, ddp.shard_batch(batch))
+        losses.append(float(np.asarray(step_losses).ravel()[0]))
+        print(f"step {i}: loss {losses[-1]:.4f}", flush=True)
+    state = ddp.finalize_pending_updates(state)
+    ddp.shutdown()
+    print(f"final: engine mesh={axes} algo={args.algo}", flush=True)
+    return losses
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--pp", type=int, default=2)
     p.add_argument("--tp", type=int, default=2)
     p.add_argument("--sp", type=int, default=2)
+    p.add_argument("--fsdp", type=int, default=1, help="engine mode only: fsdp axis size")
+    p.add_argument(
+        "--engine", action="store_true",
+        help="drive the step through the bagua DDP engine over a named "
+        "MeshSpec mesh (dp x tp / dp x fsdp) instead of the raw shard_map",
+    )
+    p.add_argument(
+        "--algo", choices=("gradient_allreduce", "zero"),
+        default="gradient_allreduce", help="engine mode: exchange algorithm",
+    )
     p.add_argument("--vocab", type=int, default=128)
     p.add_argument("--hidden", type=int, default=32)
     p.add_argument("--heads", type=int, default=4)
@@ -82,6 +164,9 @@ def main(argv=None):
         help="pipeline schedule: 1F1B (bounded-memory, remat) or GPipe",
     )
     args = p.parse_args(argv)
+
+    if args.engine:
+        return run_engine(args)
 
     n = args.dp * args.pp * args.tp * args.sp
     devices = np.array(jax.devices()[:n]).reshape(args.dp, args.pp, args.tp, args.sp)

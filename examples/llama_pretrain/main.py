@@ -18,6 +18,13 @@ synthetic corpus, over a ``(dp, tp, sp)`` mesh:
 
     # real text
     ... main.py --data path/to/corpus.txt --steps 20
+
+    # engine mode: the bagua DDP engine owns the step (bucketed gradient
+    # exchange with backward overlap, confined to the dp/fsdp axes of a
+    # named MeshSpec mesh) while the model's Megatron tp collectives ride
+    # the tp axis untouched
+    ... main.py --engine --dp 4 --tp 2 --sp 1 --steps 5
+    ... main.py --engine --dp 4 --fsdp 2 --tp 1 --sp 1 --algo zero --steps 5
 """
 
 import argparse
@@ -55,12 +62,78 @@ def batches(toks, rng, batch, seq, steps):
         yield np.stack([toks[i : i + seq] for i in idx])
 
 
+def run_engine(args):
+    """Engine-driven mesh mode: a named ``MeshSpec`` threads the axes through
+    ``DistributedDataParallel`` — the bucketed gradient exchange (with
+    backward overlap, or ZeRO's rs+ag under ``--algo zero``) rides the
+    dp/fsdp data axes only while the Llama model's explicit tp collectives
+    keep their own axis.  sp stays with the hand-scheduled mode above."""
+    assert args.sp == 1, "--engine covers dp x tp / dp x fsdp; drop --sp"
+    import bagua_tpu
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.sharded.algorithm import ZeroAlgorithm
+
+    axes = {"dp": args.dp}
+    if args.fsdp > 1:
+        axes["fsdp"] = args.fsdp
+    if args.tp > 1:
+        axes["tp"] = args.tp
+    group = bagua_tpu.init_process_group(mesh_spec=bagua_tpu.MeshSpec(axes))
+
+    rng = np.random.RandomState(0)
+    toks, vocab = load_corpus(args.data, rng)
+    heads = max(2, 2 * args.tp)
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=args.hidden, num_layers=args.layers,
+        num_heads=heads, num_kv_heads=heads // 2, intermediate_size=2 * args.hidden,
+        max_position_embeddings=args.seq, tp_size=args.tp, tp_axis="tp",
+    )
+    model = LlamaModel(cfg)
+    loss_fn = llama_loss_fn(model)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, args.seq), jnp.int32))["params"]
+
+    algo = ZeroAlgorithm() if args.algo == "zero" else GradientAllReduceAlgorithm()
+    ddp = DistributedDataParallel(
+        loss_fn, optax.adamw(args.lr), algo, process_group=group,
+        bucket_size_bytes=1 << 14, overlap=True,
+        dp_axis="dp",
+        fsdp_axis="fsdp" if args.fsdp > 1 else None,
+        tp_axis="tp" if args.tp > 1 else None,
+    )
+    state = ddp.init(params=params)
+    first = last = None
+    for i, ids in enumerate(batches(toks, rng, args.batch, args.seq, args.steps)):
+        state, losses = ddp.train_step(state, ddp.shard_batch(jnp.asarray(ids)))
+        last = float(np.asarray(losses).ravel()[0])
+        first = first if first is not None else last
+        print(f"step {i}: loss {last:.4f}", flush=True)
+    state = ddp.finalize_pending_updates(state)
+    ddp.shutdown()
+    print(
+        f"final: engine mesh={axes} algo={args.algo} vocab={vocab} "
+        f"loss {first:.4f} -> {last:.4f}",
+        flush=True,
+    )
+    assert np.isfinite(last)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--data", default=None, help="UTF-8 text file (char LM); synthetic if unset")
     p.add_argument("--dp", type=int, default=2)
     p.add_argument("--tp", type=int, default=2)
     p.add_argument("--sp", type=int, default=2)
+    p.add_argument("--fsdp", type=int, default=1, help="engine mode only: fsdp axis size")
+    p.add_argument(
+        "--engine", action="store_true",
+        help="drive the step through the bagua DDP engine over a named "
+        "MeshSpec mesh (dp x tp / dp x fsdp) instead of the raw shard_map",
+    )
+    p.add_argument(
+        "--algo", choices=("gradient_allreduce", "zero"),
+        default="gradient_allreduce", help="engine mode: exchange algorithm",
+    )
     p.add_argument("--seq", type=int, default=64, help="global sequence length")
     p.add_argument("--batch", type=int, default=8, help="global batch size")
     p.add_argument("--steps", type=int, default=10)
@@ -68,6 +141,9 @@ def main():
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--lr", type=float, default=3e-3)
     args = p.parse_args()
+
+    if args.engine:
+        return run_engine(args)
 
     n_dev = args.dp * args.tp * args.sp
     devs = jax.devices()

@@ -457,3 +457,48 @@ def test_perf_audit_quick_tp_collective_matmul(tmp_path):
     for axis in ("tp", "ep"):
         assert axis in scopes, scopes
         assert 0.0 <= scopes[axis]["measured_overlap_frac"] <= 1.0
+
+
+def test_perf_audit_quick_llama_mesh(tmp_path):
+    """Tier-1 lane for the named-mesh 2-D engine gates: the dp×tp census
+    (bucketed exchange confined to the dp axis, model tp ring intact), the
+    strict static-verifier pass on the 2-D step program (per-axis wire-byte
+    exactness included), and dp×1-vs-1-D bitwise parity for both modeled
+    algorithms with overlap on."""
+    out = tmp_path / "audit_llama_mesh"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "ci", "perf_audit.py"),
+            "--quick", "--model=llama-mesh", "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (
+        f"perf_audit --quick --model=llama-mesh failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    assert "llama-mesh dp*tp census passed" in proc.stderr
+    assert "llama-mesh static verify strict passed" in proc.stderr
+    assert "llama-mesh dp*1 bitwise parity passed" in proc.stderr
+
+    with open(str(out) + ".json") as f:
+        audit = json.load(f)
+    assert audit["mesh"] == {"dp": 4, "tp": 2}
+    census = audit["census"]
+    # every exchange collective rides exactly the dp axis...
+    assert census["exchange_collectives"] > 0
+    assert census["exchange_axes"] == ["dp"]
+    for d in census["by_descriptor"]:
+        if d["scope"] is not None:
+            assert d["axes"] == ["dp"], d
+    # ...while the Megatron block's tp ring survives untouched
+    assert census["model_tp_collectives"] > 0
+    # the strict four-checker pass held on the 2-D program
+    assert audit["static_verify"]["ok"], audit["static_verify"]["findings"]
+    # dp×1 == legacy 1-D, bitwise, params + opt state, overlap on
+    algos = {row["algo"]: row for row in audit["dp1_parity"]}
+    assert set(algos) == {"gradient_allreduce", "zero"}
+    for row in algos.values():
+        assert row["overlap"] and row["bitwise"], row

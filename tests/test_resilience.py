@@ -758,3 +758,65 @@ def test_zero_resume_shrink_roundtrip_bitwise(group, tmp_path):
     ddp8.shutdown()
     ddp5.shutdown()
     ddp8b.shutdown()
+
+
+# -- named-mesh reshapes of the sharded engine ---------------------------------
+# On a data-only MeshSpec mesh the exchange ring spans every axis, so shard
+# rows map 1:1 to mesh-rank rows and the reshard path must carry values
+# exactly across a mesh *reshape* (same gang, different factorization).
+
+from bagua_tpu.mesh import MeshSpec  # noqa: E402
+
+
+def test_zero_resume_mesh_reshape_roundtrip_bitwise(tmp_path):
+    """dp8 -> dp4×fsdp2 -> dp8: reshaping a data-only named mesh preserves
+    every leaf — params, the SHARDED adam moments, the pending
+    updated-parameter shards, step — bitwise through the round trip, and
+    the intermediate 2-D engine both trains and finalizes to the same full
+    parameters the original dp8 gang would."""
+    g_a = new_group(mesh_spec=MeshSpec({"dp": 8}))
+    ddp_a = make_zero_ddp(g_a)
+    st_a = ddp_a.init(init_mlp(jax.random.PRNGKey(1), LAYERS))
+    for i in range(2):
+        st_a, _ = ddp_a.train_step(st_a, make_batch(i, n=40))
+    d_a = zero_snapshot(ddp_a, st_a, g_a.size, tmp_path, "dp8", 2)
+
+    g_b = new_group(mesh_spec=MeshSpec({"dp": 4, "fsdp": 2}))
+    assert g_b.exchange_size == g_b.size == 8  # fsdp joins the ring
+    ddp_b = make_zero_ddp(g_b)
+    init_b = ddp_b.init(init_mlp(jax.random.PRNGKey(4), LAYERS))
+    res_b = ElasticResumeCoordinator(d_a).resume(ddp_b, init_b)
+    assert res_b is not None and res_b.step == 2
+    # element-value-preserving across the reshape, sharded opt state included
+    leaves_equal(res_b.state, st_a)
+    d_b = zero_snapshot(ddp_b, res_b.state, g_b.size, tmp_path, "dp4xfsdp2", 2)
+    # the resumed 2-D engine actually trains on its mesh
+    st_b, loss = ddp_b.train_step(res_b.state, make_batch(7, n=40))
+    assert np.isfinite(np.asarray(loss)).all()
+
+    g_c = new_group(mesh_spec=MeshSpec({"dp": 8}))
+    ddp_c = make_zero_ddp(g_c)
+    init_c = ddp_c.init(init_mlp(jax.random.PRNGKey(5), LAYERS))
+    res_c = ElasticResumeCoordinator(d_b).resume(ddp_c, init_c)
+    leaves_equal(res_c.state, st_a)
+    fin_a = ddp_a.finalize_pending_updates(st_a)
+    fin_c = ddp_c.finalize_pending_updates(res_c.state)
+    for a, b in zip(jax.tree.leaves(fin_a.params), jax.tree.leaves(fin_c.params)):
+        np.testing.assert_array_equal(np.asarray(a)[0], np.asarray(b)[0])
+    ddp_a.shutdown()
+    ddp_b.shutdown()
+    ddp_c.shutdown()
+
+
+def test_zero_reshard_fenced_on_model_axes():
+    """Host-side shard migration is undefined when a model axis is present
+    (state rows are per mesh rank, shard rows per exchange-ring slot); the
+    engine must refuse loudly rather than scramble shards."""
+    g = new_group(mesh_spec=MeshSpec({"dp": 4, "tp": 2}))
+    ddp = make_zero_ddp(g)
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    host = jax.tree.map(np.asarray, state)
+    payload = ddp.export_plan_payload()
+    with pytest.raises(ValueError, match="model axes"):
+        ddp.reshard_host_state(host, payload, old_world=8)
+    ddp.shutdown()
