@@ -1,0 +1,31 @@
+"""Gang autopilot: online relaxation control over {algorithm, precision}.
+
+The controller consumes attributed ``perf_regression`` incidents, the
+health monitor's stability signal and the planner's fitted α–β cost model,
+and moves the gang to the cheapest healthy configuration through the
+engine's statically-verified single-recompile switch actions.  See
+``docs/autopilot.md`` for the policy contract.
+"""
+
+from bagua_tpu.autopilot.controller import AutopilotConfig, GangAutopilot
+from bagua_tpu.autopilot.pricing import (
+    PRECISION_RUNGS,
+    Configuration,
+    candidate_configurations,
+    degraded_cost_model,
+    modeled_step_ms,
+    price_configurations,
+    wire_ms,
+)
+
+__all__ = [
+    "AutopilotConfig",
+    "GangAutopilot",
+    "Configuration",
+    "PRECISION_RUNGS",
+    "candidate_configurations",
+    "degraded_cost_model",
+    "modeled_step_ms",
+    "price_configurations",
+    "wire_ms",
+]

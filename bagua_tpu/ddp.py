@@ -43,6 +43,10 @@ from bagua_tpu.communication import (
 from bagua_tpu.env import get_default_bucket_size, get_static_verify_mode
 from bagua_tpu.observability.annotations import step_scope
 from bagua_tpu.observability.core import StepTimer
+from bagua_tpu.observability.metrics import (
+    switch_reason_family,
+    validate_switch_reason,
+)
 from bagua_tpu.sharded.layout import ShardLayout, reshard_group_flat
 from bagua_tpu.sharded.updater import ShardedOptState, ShardedOptimizerUpdater
 from bagua_tpu.utils import SpeedMeter
@@ -174,6 +178,11 @@ class DistributedDataParallel:
         #: rebucket() — exported as the telemetry ``plan_version`` gauge so a
         #: dashboard can line up throughput shifts with plan swaps
         self.plan_version = 0
+        #: who last changed the live configuration (reason *family* of the
+        #: last rebucket / precision switch / algorithm switch) — rides the
+        #: exported plan payload so a resumed gang knows whether it is
+        #: running an operator-chosen or an autopilot-chosen configuration
+        self._plan_source = "manual"
         self._step_fns = {}
         # Per-variant collective programs for the flight recorder: captured
         # once at trace time, replayed into the ring every dispatch (see
@@ -349,7 +358,12 @@ class DistributedDataParallel:
 
     # -- re-bucketing (autotune) -------------------------------------------
 
-    def rebucket(self, plan: BucketPlan, predicted_exposed_ms: Optional[float] = None) -> None:
+    def rebucket(
+        self,
+        plan: BucketPlan,
+        predicted_exposed_ms: Optional[float] = None,
+        reason: str = "planner",
+    ) -> None:
         """Adopt a new bucket plan; next step re-jits (reference
         ``_reset_buckets``).  Under overlap mode the per-bucket ``custom_vjp``
         wrappers are re-derived from the new plan at the next ``_build_step``
@@ -359,7 +373,13 @@ class DistributedDataParallel:
         ``predicted_exposed_ms`` — the trace-driven planner's predicted
         exposed-communication time for this plan (when it proposed it) —
         rides into the telemetry ``rebucket`` record so post-run analysis can
-        compare prediction against the next trace's measurement."""
+        compare prediction against the next trace's measurement.
+
+        ``reason`` — who decided, in the shared switch-reason vocabulary
+        (``planner | health:<kind> | autopilot:<incident> | manual``, see
+        :func:`bagua_tpu.observability.metrics.validate_switch_reason`) —
+        carried on the ``rebucket`` JSONL event and the per-family counter."""
+        validate_switch_reason(reason)
         if getattr(self.impl, "holds_bucketized_state", False):
             raise ValueError(
                 f"{type(self.impl).__name__} keeps per-bucket state; "
@@ -386,12 +406,14 @@ class DistributedDataParallel:
                 self._adopt_plan(prev_plan)
             self._pending_reshard = prev_pending
             raise
+        self._plan_source = switch_reason_family(reason)
         if self.telemetry is not None:
             self.telemetry.on_rebucket(
                 plan_version=self.plan_version,
                 n_buckets=plan.num_buckets,
                 step=self._host_step if self._host_step is not None else 0,
                 predicted_exposed_ms=predicted_exposed_ms,
+                reason=reason,
             )
 
     def _adopt_plan(self, plan: BucketPlan) -> None:
@@ -418,7 +440,10 @@ class DistributedDataParallel:
         when the resolved per-bucket precisions actually changed (a no-op
         plan keeps the compiled step).  Algorithms without the
         ``wire_precision`` knob reject with AttributeError — the caller opted
-        into a dimension this algorithm does not have."""
+        into a dimension this algorithm does not have.  ``reason`` uses the
+        shared switch-reason vocabulary (``planner | health:<kind> |
+        autopilot:<incident> | manual``)."""
+        validate_switch_reason(reason)
         impl = self.impl
         if not hasattr(impl, "set_bucket_precision"):
             raise AttributeError(
@@ -443,6 +468,7 @@ class DistributedDataParallel:
             self._flight_programs = {}
             self._predicted_programs = {}
             raise
+        self._plan_source = switch_reason_family(reason)
         if self.telemetry is not None:
             self.telemetry.on_precision_switch(
                 step=self._host_step if self._host_step is not None else 0,
@@ -452,6 +478,201 @@ class DistributedDataParallel:
                 reason=reason,
             )
         return True
+
+    # -- mid-training algorithm switch (autopilot) ---------------------------
+
+    #: algorithms the engine can move a LIVE gang between: their state is an
+    #: optimizer params-mirror plus zero-initialized algorithm scratch
+    #: (quantization residuals, pending shards), so a switch is a pure
+    #: re-layout.  The decentralized family is excluded — ranks genuinely
+    #: hold different weights, so entering/leaving it needs a weight
+    #: consensus step, not a state remap.
+    SWITCHABLE_ALGORITHMS = ("gradient_allreduce", "zero", "bytegrad")
+
+    def switch_algorithm(
+        self, state: TrainState, algorithm, reason: str = "manual", **algo_kwargs
+    ) -> TrainState:
+        """Move the live gang to a different communication algorithm in one
+        recompile — the BAGUA relaxations as a *runtime* knob.
+
+        Re-buckets under the new algorithm's plan shape, remaps optimizer
+        state element-value-preservingly (a zero target shards the full
+        moments by slot name, a zero source gathers them back — the bitwise
+        contract in :mod:`bagua_tpu.sharded.updater` makes the two layouts
+        the same state), seeds a zero target's pending shards with the
+        current parameters so the next step's deferred all-gather is a
+        value-level no-op, and statically re-verifies the new program before
+        anything can dispatch it (strict gate; on rejection the engine rolls
+        back to the previous configuration and the caller keeps using
+        ``state``).  Quantization residuals restart at zero — they are
+        error-feedback carry, self-healing within a few steps.
+
+        Returns the remapped :class:`TrainState`; the engine is reconfigured
+        in place (next ``train_step`` re-jits).  ``algorithm`` is a registry
+        name from :data:`SWITCHABLE_ALGORITHMS` (``**algo_kwargs`` forwarded
+        to the builder), or an already-reified impl."""
+        import numpy as np
+
+        from bagua_tpu.algorithms import build_algorithm
+
+        validate_switch_reason(reason)
+        if self.plan is None:
+            raise ValueError("call init() before switch_algorithm()")
+        if isinstance(algorithm, str):
+            if algorithm not in self.SWITCHABLE_ALGORITHMS:
+                raise ValueError(
+                    f"cannot switch a live gang to {algorithm!r}: supported "
+                    f"targets are {self.SWITCHABLE_ALGORITHMS} (the "
+                    "decentralized family holds per-rank weights and needs a "
+                    "consensus step, not a state remap)"
+                )
+            new_impl = build_algorithm(algorithm, **algo_kwargs).reify(self.group)
+        elif isinstance(algorithm, Algorithm):
+            new_impl = algorithm.reify(self.group)
+        else:
+            new_impl = algorithm
+        cur_name = self.impl.algo_name or type(self.impl).__name__
+        new_name = new_impl.algo_name or type(new_impl).__name__
+        if cur_name not in self.SWITCHABLE_ALGORITHMS:
+            raise ValueError(
+                f"cannot switch a live gang OFF {cur_name!r}: its state is "
+                "not a pure re-layout of the switchable family's"
+            )
+        if new_name == cur_name:
+            return state  # same relaxation — nothing to remap or recompile
+        if self.group.mesh_spec is not None and getattr(new_impl, "hierarchical", False):
+            raise ValueError(
+                "hierarchical algorithms assume the legacy (inter, intra) "
+                "mesh; pass hierarchical=False to switch under a MeshSpec"
+            )
+        sharded_src = self._sharded_updater is not None
+        sharded_dst = bool(getattr(new_impl, "sharded_update", False))
+        if (sharded_src or sharded_dst) and self.group.exchange_size != self.group.size:
+            raise ValueError(
+                "switching into/out of a sharded-update algorithm is "
+                "undefined when model axes are present (shard rows are per "
+                "exchange-ring rank, state rows per mesh rank)"
+            )
+
+        # Bring the state fully onto the CURRENT configuration first: apply
+        # any queued shard migration, then flush a zero source's deferred
+        # parameter gather so host params are the post-update values.
+        pending_before = self._pending_reshard
+        if self._pending_reshard is not None:
+            state = self._apply_pending_reshard(state)
+        if sharded_src:
+            state = self.finalize_pending_updates(state)
+        host = jax.tree.map(np.asarray, state)
+        local_params = jax.tree.map(lambda x: x[0], host.params)
+        if sharded_src:
+            full_opt = self._sharded_updater.gather_full_state(
+                host.opt_state, local_params
+            )
+        else:
+            full_opt = jax.tree.map(lambda x: x[0], host.opt_state)
+
+        prev = (
+            self.impl, self.plan, self._sharded_updater, self.overlap,
+            self._plan_source,
+        )
+        n = self.group.size
+        try:
+            self.impl = new_impl
+            if self.overlap is True:
+                cap = new_impl.overlap_capability()
+                if not cap.supported:
+                    logger.warning(
+                        "switch_algorithm(%s): overlap=True unsupported (%s); "
+                        "demoting to overlap='auto'", new_name, cap.reason,
+                    )
+                    self.overlap = "auto"
+            new_impl.overlap_hint = self.overlap_enabled
+            new_plan = new_impl.tensors_to_buckets(
+                self._tree_template, self.bucket_size_bytes, filter_fn=self.dp_filter
+            )
+            self.plan = new_plan
+            new_impl.bind_plan(new_plan)
+            self._sharded_updater = (
+                ShardedOptimizerUpdater(self.optimizer, new_plan, self.group)
+                if sharded_dst else None
+            )
+            self._pending_reshard = None
+            self._step_fns = {}
+            self._flight_programs = {}
+            self._predicted_programs = {}
+            self.plan_version += 1
+
+            # Algorithm scratch: zeros in the new plan's shapes (residuals
+            # restart), except a zero target's pending shards, which are
+            # seeded with the live parameters — row r IS rank r's shard, so
+            # the next step's gather reproduces the params bit-for-bit.
+            algo_shape = jax.eval_shape(new_impl.init_state, self._tree_template)
+            algo_host = jax.tree.map(
+                lambda l: np.zeros((n,) + tuple(l.shape), l.dtype), algo_shape
+            )
+            if sharded_dst:
+                from bagua_tpu.sharded.layout import (
+                    build_shard_rows,
+                    flat_tree_values,
+                )
+
+                rows = build_shard_rows(
+                    flat_tree_values(local_params), self._sharded_updater.layout
+                )
+                algo_host = dict(algo_host)
+                algo_host["pending"] = tuple(
+                    r.astype(z.dtype, copy=False)
+                    for r, z in zip(rows, algo_host["pending"])
+                )
+                opt_host = self._sharded_updater.scatter_full_state(
+                    full_opt, local_params
+                )
+            else:
+                opt_host = jax.tree.map(
+                    lambda l: np.broadcast_to(
+                        np.asarray(l)[None], (n,) + np.shape(l)
+                    ).copy(),
+                    full_opt,
+                )
+
+            # Prove the new program before anything can dispatch it (no-op
+            # until a real batch has been seen / the gate is off).
+            self._static_reverify("switch_algorithm")
+        except Exception:
+            (self.impl, self.plan, self._sharded_updater, self.overlap,
+             self._plan_source) = prev
+            self.impl.overlap_hint = self.overlap_enabled
+            self.impl.bind_plan(self.plan)
+            # The caller keeps using the state it passed in, which is still
+            # in the PRE-migration layout if a reshard was queued — re-queue
+            # it so the rolled-back engine stays consistent with that state.
+            self._pending_reshard = pending_before
+            self._step_fns = {}
+            self._flight_programs = {}
+            self._predicted_programs = {}
+            self.plan_version += 1  # uniqueness, not density
+            raise
+
+        sharding = jax.sharding.NamedSharding(self.group.mesh, P(self.group.all_axes))
+        new_state = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), sharding),
+            TrainState(
+                params=host.params,
+                opt_state=opt_host,
+                algo_state=algo_host,
+                step=host.step,
+            ),
+        )
+        self._plan_source = switch_reason_family(reason)
+        if self.telemetry is not None:
+            self.telemetry.on_rebucket(
+                plan_version=self.plan_version,
+                n_buckets=new_plan.num_buckets,
+                step=self._host_step if self._host_step is not None else 0,
+                reason=reason,
+                algorithm=new_name,
+            )
+        return new_state
 
     # -- plan carry-over (elastic resume) -----------------------------------
 
@@ -475,6 +696,23 @@ class DistributedDataParallel:
             # different world size) can re-shard the per-rank optimizer state
             # it finds in the snapshot (resilience/resume.py).
             payload["shard"] = self._sharded_updater.layout.payload()
+        # The adopted CONFIGURATION (algorithm + execution mode + wire
+        # precision + who chose it) rides alongside the plan so an elastic
+        # resume restores the autopilot's choices, not just the bucket
+        # assignment.
+        config = {
+            "algorithm": self.impl.algo_name or type(self.impl).__name__,
+            "overlap": self.overlap if isinstance(self.overlap, str) else bool(self.overlap),
+            "source": self._plan_source,
+        }
+        wp = getattr(self.impl, "wire_precision", None)
+        if wp is not None:
+            config["wire_precision"] = str(wp)
+            if hasattr(self.impl, "bucket_precisions"):
+                config["bucket_precisions"] = [
+                    str(p) for p in self.impl.bucket_precisions(self.plan)
+                ]
+        payload["config"] = config
         return payload
 
     def adopt_plan_payload(self, payload: dict) -> bool:
@@ -483,11 +721,27 @@ class DistributedDataParallel:
         Returns True when the engine now runs the saved plan — either it was
         re-adopted via :meth:`rebucket`, or the fresh plan already matches it
         (same bucket assignment ⇒ nothing to swap).  Raises when the payload
-        no longer fits the model (renamed leaves, empty buckets) or the
-        algorithm holds bucketized state; callers treat that as "keep the
-        fresh plan"."""
+        no longer fits the model (renamed leaves, empty buckets), the
+        algorithm holds bucketized state, or the payload's carried
+        configuration names a different algorithm than this engine runs
+        (switching needs live state — construct the engine with the
+        snapshot's algorithm); callers treat that as "keep the fresh plan".
+
+        A carried ``config`` (see :meth:`export_plan_payload`) is re-applied
+        on top of the plan: execution mode and per-bucket wire precisions,
+        with the re-apply reason derived from the config's recorded source
+        (an autopilot-chosen configuration resumes as ``autopilot:resume``)."""
         from bagua_tpu.defs import TensorDeclaration
 
+        cfg = payload.get("config") or {}
+        if cfg.get("algorithm"):
+            mine = self.impl.algo_name or type(self.impl).__name__
+            if cfg["algorithm"] != mine:
+                raise ValueError(
+                    f"snapshot was written under algorithm {cfg['algorithm']!r} "
+                    f"but this engine runs {mine!r}; construct the engine with "
+                    "the snapshot's algorithm to resume its state"
+                )
         buckets = [
             [TensorDeclaration(**td) for td in bucket]
             for bucket in payload.get("buckets", [])
@@ -495,17 +749,41 @@ class DistributedDataParallel:
         if not buckets:
             return False
         assignment = [[td.name for td in b] for b in buckets]
-        if self.plan is not None and assignment == [
+        if self.plan is None or assignment != [
             [td.name for td in b] for b in self.plan.declarations()
         ]:
-            return True
-        plan = BucketPlan.from_declarations(
-            buckets, self._tree_template, align_elems=self.group.exchange_size
-        )
-        self.rebucket(plan)
-        if payload.get("bucket_size_bytes"):
-            self.bucket_size_bytes = int(payload["bucket_size_bytes"])
+            plan = BucketPlan.from_declarations(
+                buckets, self._tree_template, align_elems=self.group.exchange_size
+            )
+            self.rebucket(plan)
+            if payload.get("bucket_size_bytes"):
+                self.bucket_size_bytes = int(payload["bucket_size_bytes"])
+        self._adopt_config(cfg)
         return True
+
+    def _adopt_config(self, cfg: dict) -> None:
+        """Re-apply a carried configuration's non-plan knobs (best-effort:
+        knobs this algorithm lacks are skipped, a strict-verifier rejection
+        of the precisions propagates like any other precision switch)."""
+        if not cfg:
+            return
+        source = str(cfg.get("source", "manual"))
+        reason = source if source in ("planner", "manual") else f"{source}:resume"
+        ov = cfg.get("overlap")
+        if ov is not None and ov != self.overlap:
+            if not (ov is True and not self.impl.overlap_capability().supported):
+                self.overlap = ov
+                self.impl.overlap_hint = self.overlap_enabled
+                self._step_fns = {}
+        precisions = cfg.get("bucket_precisions")
+        if (
+            precisions
+            and hasattr(self.impl, "set_bucket_precision")
+            and getattr(self.impl, "wire_precision", None) == "auto"
+        ):
+            self.apply_precision_plan(list(precisions), reason=reason)
+        if source in ("planner", "health", "autopilot", "manual"):
+            self._plan_source = source
 
     # -- the step -----------------------------------------------------------
 

@@ -252,6 +252,30 @@ class FleetClient:
             return self._call("/fleet/incidents")
         return self._call(f"/fleet/incidents?gang={quote(str(gang_id), safe='')}")
 
+    # -- autopilot decisions ------------------------------------------------------
+
+    def push_decisions(self, gang_id: str, decisions) -> dict:
+        """Ship a batch of autopilot ``plan_decision`` events (e.g.
+        ``GangAutopilot.drain_decisions()``) into the gang's volatile
+        decision ring — what ``/fleet/scheduler`` surfaces as the gang's
+        ``autopilot`` column and ``/fleet/decisions`` lists."""
+        from urllib.parse import quote
+
+        return self._call(
+            f"/g/{quote(str(gang_id), safe='')}/decisions",
+            {"decisions": list(decisions)},
+        )
+
+    def decisions(self, gang_id: Optional[str] = None) -> dict:
+        """The fleet's volatile decision tier — every gang's recent
+        autopilot ``plan_decision`` events, or one gang's when ``gang_id``
+        is given."""
+        from urllib.parse import quote
+
+        if gang_id is None:
+            return self._call("/fleet/decisions")
+        return self._call(f"/fleet/decisions?gang={quote(str(gang_id), safe='')}")
+
     def metrics_text(self) -> str:
         """The server's ``/fleet/metrics`` Prometheus text exposition."""
         import urllib.request

@@ -292,9 +292,11 @@ class FleetControlPlane:
         self._client_spans: Dict[str, deque] = {}   # gang -> ingested client spans
         self._timeline_events: Dict[str, deque] = {}  # gang -> ingested events
         self._incidents: Dict[str, deque] = {}  # gang -> perf_regression events
+        self._decisions: Dict[str, deque] = {}  # gang -> plan_decision events
         self._request_counts: Dict[str, int] = {}
         self._deny_counts: Dict[str, int] = {}
         self._incident_counts: Dict[str, int] = {}
+        self._decision_counts: Dict[str, int] = {}
         self.plan_hits = 0
         self.plan_misses = 0
         self.wal = WriteAheadLog(wal_dir, compact_every=compact_every, fsync=fsync) if wal_dir else None
@@ -527,6 +529,7 @@ class FleetControlPlane:
             gangs = dict(self._gangs)
             leases = dict(self._leases)
             incidents_by_gang = {g: list(ring) for g, ring in self._incidents.items()}
+            decisions_by_gang = {g: list(ring) for g, ring in self._decisions.items()}
         view = {"gangs": {}, "n_gangs": len(gangs)}
         for gang_id, ns in sorted(gangs.items()):
             st = ns.rendezvous
@@ -561,6 +564,8 @@ class FleetControlPlane:
             else:
                 verdict = "idle"
             last = incidents[-1] if incidents else None
+            decisions = decisions_by_gang.get(gang_id, [])
+            last_dec = decisions[-1] if decisions else None
             asn = st.export_membership()
             settled = asn.get("settled")
             view["gangs"][gang_id] = {
@@ -573,6 +578,16 @@ class FleetControlPlane:
                      "stream": last.get("stream")}
                     if isinstance(last, dict) else None
                 ),
+                # what the gang's autopilot last did about its incidents —
+                # None means no controller is attached (or it never spoke)
+                "autopilot": (
+                    {"decision": last_dec.get("decision"),
+                     "verdict": last_dec.get("verdict"),
+                     "step": last_dec.get("step"),
+                     "to_config": last_dec.get("to_config")}
+                    if isinstance(last_dec, dict) else None
+                ),
+                "decisions": len(decisions),
                 "flight_ranks": sorted(flight_ranks),
                 "ranks_reporting": len(summaries),
                 "max_step": max((s.step for s in summaries), default=-1),
@@ -694,6 +709,43 @@ class FleetControlPlane:
                 )
         return {"accepted": accepted, "rejected": rejected}
 
+    def ingest_decisions(self, gang_id: str, decisions) -> dict:
+        """A batch of autopilot ``plan_decision`` events (the
+        ``POST /g/<gang>/decisions`` route).  Volatile like the incident
+        tier: bounded per-gang deque, never in the WAL or ``dump()``.  A
+        decision must carry string ``decision`` and ``verdict`` fields;
+        anything else is counted and dropped."""
+        accepted = rejected = 0
+        ring = self._ring(self._decisions, gang_id)
+        for dec in decisions or []:
+            if (not isinstance(dec, dict)
+                    or not isinstance(dec.get("decision"), str)
+                    or not isinstance(dec.get("verdict"), str)):
+                rejected += 1
+                continue
+            ring.append(dict(dec))
+            accepted += 1
+        if accepted:
+            with self._lock:
+                self._decision_counts[gang_id] = (
+                    self._decision_counts.get(gang_id, 0) + accepted
+                )
+        return {"accepted": accepted, "rejected": rejected}
+
+    def decisions(self, gang_id: Optional[str] = None) -> dict:
+        """The volatile decision tier (the ``GET /fleet/decisions`` route):
+        every gang's recent autopilot ``plan_decision`` events, or one
+        gang's when ``gang_id`` is given."""
+        with self._lock:
+            if gang_id is not None:
+                rows = list(self._decisions.get(gang_id, ()))
+                return {"gang": str(gang_id), "decisions": rows,
+                        "n_decisions": len(rows)}
+            gangs = {g: list(ring) for g, ring in sorted(self._decisions.items())
+                     if ring}
+        return {"gangs": gangs,
+                "n_decisions": sum(len(v) for v in gangs.values())}
+
     def incidents(self, gang_id: Optional[str] = None) -> dict:
         """The volatile incident tier (the ``GET /fleet/incidents`` route):
         every gang's recent ``perf_regression`` events, or one gang's when
@@ -723,6 +775,7 @@ class FleetControlPlane:
             server = list(self._server_spans.get(gang_id, ()))
             events = list(self._timeline_events.get(gang_id, ()))
             incidents = list(self._incidents.get(gang_id, ()))
+            decisions = list(self._decisions.get(gang_id, ()))
         items = []
         # the discriminator is "item", not "kind" — spans already carry a
         # "kind" of their own (internal/client/server) that must survive
@@ -734,6 +787,8 @@ class FleetControlPlane:
             items.append({"item": "event", "ts": ev.get("ts"), **ev})
         for inc in incidents:
             items.append({"item": "incident", "ts": inc.get("ts"), **inc})
+        for dec in decisions:
+            items.append({"item": "decision", "ts": dec.get("ts"), **dec})
         if ns is not None:
             st = ns.rendezvous
             for key in st.kv_keys():
@@ -795,6 +850,7 @@ class FleetControlPlane:
             "n_server_spans": len(server),
             "n_events": len(events),
             "n_incidents": len(incidents),
+            "n_decisions": len(decisions),
             "n_traces": len(traces),
         }
 
@@ -811,6 +867,7 @@ class FleetControlPlane:
             requests = dict(self._request_counts)
             denials = dict(self._deny_counts)
             incidents = dict(self._incident_counts)
+            decisions = dict(self._decision_counts)
             leases = {g: d - now for g, d in self._leases.items() if g in self._gangs}
             n_gangs = len(self._gangs)
             plan_hits, plan_misses = self.plan_hits, self.plan_misses
@@ -845,6 +902,15 @@ class FleetControlPlane:
             r.counter(
                 f"incidents_total_{_prom_name(gang_id)}",
                 help=f"perf_regression incidents ingested for gang {gang_id}",
+            ).inc(n)
+        r.counter(
+            "plan_decisions_total",
+            help="autopilot plan_decision events ingested (all gangs)",
+        ).inc(sum(decisions.values()))
+        for gang_id, n in sorted(decisions.items()):
+            r.counter(
+                f"plan_decisions_total_{_prom_name(gang_id)}",
+                help=f"autopilot plan_decision events ingested for gang {gang_id}",
             ).inc(n)
         for gang_id, remaining in sorted(leases.items()):
             r.gauge(

@@ -13,6 +13,9 @@ Route map (one port serves the whole fleet):
     /g/<gang_id>/incidents       POST: ingest a batch of regression-sentinel
                                  ``perf_regression`` incidents into the
                                  gang's volatile incident ring
+    /g/<gang_id>/decisions       POST: ingest a batch of autopilot
+                                 ``plan_decision`` events into the gang's
+                                 volatile decision ring
     /fleet/plan/publish          POST: store a proven plan in the cross-gang
                                  cache (fingerprint/topology/algorithm/
                                  wire_precision + plan payload)
@@ -20,6 +23,8 @@ Route map (one port serves the whole fleet):
     /fleet/scheduler             GET: per-gang wedged/straggler/regressed/
                                  healthy/idle verdict view
     /fleet/incidents[?gang=<id>] GET: the volatile perf_regression incident
+                                 tier (every gang, or one gang's ring)
+    /fleet/decisions[?gang=<id>] GET: the volatile autopilot plan_decision
                                  tier (every gang, or one gang's ring)
     /fleet/gangs                 GET: gang ids + lease remainders
     /fleet/timeline?gang=<id>    GET: the gang's causally ordered timeline
@@ -167,6 +172,11 @@ class FleetHandler(_RdzvHandler):
 
                 gang = (parse_qs(urlsplit(self.path).query).get("gang") or [None])[0]
                 self._reply(self.fleet.incidents(gang))
+            elif self.path.split("?", 1)[0] == "/fleet/decisions":
+                from urllib.parse import parse_qs, urlsplit
+
+                gang = (parse_qs(urlsplit(self.path).query).get("gang") or [None])[0]
+                self._reply(self.fleet.decisions(gang))
             elif self.path.split("?", 1)[0] == "/fleet/timeline":
                 from urllib.parse import parse_qs, urlsplit
 
@@ -238,6 +248,10 @@ class FleetHandler(_RdzvHandler):
                     elif sub == "/incidents":
                         self._reply(self.fleet.ingest_incidents(
                             ns.gang_id, payload.get("incidents") or [],
+                        ))
+                    elif sub == "/decisions":
+                        self._reply(self.fleet.ingest_decisions(
+                            ns.gang_id, payload.get("decisions") or [],
                         ))
                     else:
                         self._handle_post(ns.rendezvous, sub, payload)

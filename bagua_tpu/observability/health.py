@@ -81,6 +81,9 @@ class HealthConfig:
     warmup_steps: int = 5            # observations before the detector may alert
     min_std: float = 1e-6            # floor for the loss z-score denominator
     max_alerts: int = 64             # retained alert dicts (history ring)
+    #: clean observations after which latched actions (and the NaN latch)
+    #: re-arm automatically; None keeps the historical latch-forever behavior
+    rearm_windows: Optional[int] = None
 
 
 class HealthMonitor:
@@ -109,6 +112,10 @@ class HealthMonitor:
         self._loss_mean = 0.0
         self._loss_var = 0.0
         self._grad_ewma = 0.0
+        #: consecutive clean (finite, alert-free) observations since the
+        #: last anomaly — the stabilization signal re-promotion keys off
+        self._clean_streak = 0
+        self._rearmed = True  # no alert episode open yet
 
     def bind_telemetry(self, telemetry) -> None:
         """Adopt the engine's telemetry hub (and its registry) when the
@@ -214,7 +221,46 @@ class HealthMonitor:
                     )
                 except Exception:
                     logger.exception("health_alert emission failed")
+        if alerts or nonfinite > 0 or not finite:
+            self._clean_streak = 0
+            self._rearmed = False
+        else:
+            self._clean_streak += 1
+            if (
+                not self._rearmed
+                and self.config.rearm_windows is not None
+                and self._clean_streak >= self.config.rearm_windows
+            ):
+                self.rearm()
         return alerts
+
+    # -- stabilization / re-arm ----------------------------------------------
+
+    def stabilized(self, n_windows: int) -> bool:
+        """True once ``n_windows`` consecutive clean (finite, alert-free)
+        observations have accumulated since the last anomaly — the signal
+        the autopilot's precision re-promotion and the auto-re-arm key off.
+        A monitor that has never observed anything is not stabilized."""
+        return self._clean_streak >= max(1, int(n_windows))
+
+    def rearm(self) -> None:
+        """Re-arm latched state after a clean stretch: clear the NaN latch
+        and call ``rearm()`` on every registered action that has one
+        (``SnapshotOnAnomalyAction`` un-fires; actions without the method
+        are untouched).  Called automatically once ``config.rearm_windows``
+        clean observations accumulate, or explicitly by a controller that
+        watched :meth:`stabilized`."""
+        self.nan_latched = False
+        self._rearmed = True
+        for action in self.actions:
+            rearm = getattr(action, "rearm", None)
+            if rearm is None:
+                continue
+            try:
+                rearm()
+            except Exception:
+                name = getattr(action, "name", type(action).__name__)
+                logger.exception("health action %s failed to rearm", name)
 
     def _run_actions(self, alert: Dict, state) -> List[str]:
         applied = []
@@ -234,6 +280,7 @@ class HealthMonitor:
             "alerts": list(self.alerts),
             "ewma_loss": self._loss_mean,
             "ewma_grad_norm": self._grad_ewma,
+            "clean_streak": self._clean_streak,
         }
 
 
@@ -285,6 +332,11 @@ class SnapshotOnAnomalyAction:
 
     def __init__(self, snapshotter):
         self.snapshotter = snapshotter
+        self.fired = False
+
+    def rearm(self) -> None:
+        """Allow the next anomaly (after a clean stretch) its own snapshot —
+        called by ``HealthMonitor.rearm`` once the run re-stabilizes."""
         self.fired = False
 
     def __call__(self, alert: Dict, state=None) -> bool:

@@ -32,7 +32,10 @@ __all__ = [
     "MetricsRegistry",
     "rotated_metrics_files",
     "validate_metrics_event",
+    "validate_switch_reason",
+    "switch_reason_family",
     "EVENT_REQUIRED_FIELDS",
+    "SWITCH_REASON_FAMILIES",
 ]
 
 
@@ -205,9 +208,11 @@ EVENT_PAYLOAD_FIELDS = {
     },
     "compile": {"variant": str, "retrace": bool},
     "retrace_alert": {"retraces": int, "window": int},
-    # one bucket-plan swap adopted by the engine (autotune re-bucket);
+    # one bucket-plan swap adopted by the engine (autotune re-bucket, or an
+    # algorithm switch — ``algorithm`` then rides as an optional extra);
+    # reason speaks the unified switch vocabulary (validate_switch_reason);
     # predicted/measured exposed-comm ms ride as optional fields
-    "rebucket": {"plan_version": int, "n_buckets": int},
+    "rebucket": {"plan_version": int, "n_buckets": int, "reason": str},
     # one async/final state snapshot written by the resilience subsystem
     # (kind: "async" = cadenced background write, "final" = preemption drain)
     "snapshot": {"wall_ms": (int, float), "bytes": int, "kind": str},
@@ -283,7 +288,64 @@ EVENT_PAYLOAD_FIELDS = {
         "plan_version": int,
         "trace_id": str,
     },
+    # one autopilot policy decision (autopilot/controller.py): what the
+    # controller decided (decision: demote_precision / repromote_precision /
+    # switch_algorithm / rollback / hold), why (reason: the validated switch
+    # vocabulary, e.g. "autopilot:wire_slowdown"), the triggering incident's
+    # trace_id ("" when health- rather than incident-driven), the engine's
+    # plan_version AFTER the action, the before/after configuration dicts,
+    # and the verdict of the canary protocol (canary / committed /
+    # rolled_back / held / rejected).  Optional extra: modeled — the α–β
+    # priced step-ms of the stay-put vs chosen configuration.
+    "plan_decision": {
+        "decision": str,
+        "reason": str,
+        "trace_id": str,
+        "plan_version": int,
+        "from_config": dict,
+        "to_config": dict,
+        "verdict": str,
+    },
 }
+
+#: the unified ``reason`` vocabulary every configuration switch
+#: (``apply_precision_plan`` / ``rebucket`` / ``switch_algorithm``) and every
+#: ``plan_decision`` event must speak: who asked for the change.
+#: ``planner`` and ``manual`` are bare; ``health`` and ``autopilot`` carry a
+#: mandatory ``:<detail>`` suffix naming the alert kind / incident dominant.
+SWITCH_REASON_FAMILIES = ("planner", "health", "autopilot", "manual")
+
+
+def validate_switch_reason(reason: str) -> str:
+    """Validate a configuration-switch ``reason`` against the unified
+    vocabulary (``planner | health:<kind> | autopilot:<incident> | manual``)
+    and return it unchanged.  Raises ValueError on anything else — a
+    free-text reason is a bug at the switch site, not something the
+    timeline joiners should have to fuzzy-match."""
+    reason = str(reason)
+    family, sep, detail = reason.partition(":")
+    if family not in SWITCH_REASON_FAMILIES:
+        raise ValueError(
+            f"switch reason {reason!r} is not in the validated vocabulary "
+            f"(families: {'|'.join(SWITCH_REASON_FAMILIES)})"
+        )
+    if family in ("health", "autopilot") and not detail:
+        raise ValueError(
+            f"switch reason {reason!r} needs a detail suffix "
+            f"({family}:<{'kind' if family == 'health' else 'incident'}>)"
+        )
+    if family in ("planner", "manual") and sep:
+        raise ValueError(
+            f"switch reason {reason!r} must be bare ({family!r} takes no "
+            "detail suffix)"
+        )
+    return reason
+
+
+def switch_reason_family(reason: str) -> str:
+    """The vocabulary family of a (validated) switch reason — the label the
+    per-family Prometheus counters aggregate on."""
+    return str(reason).partition(":")[0]
 
 
 def validate_metrics_event(event: Dict) -> List[str]:

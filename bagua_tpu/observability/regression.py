@@ -261,6 +261,23 @@ class RegressionSentinel:
         out, self._pending = self._pending, []
         return out
 
+    def rebaseline(self, wire_ms: Optional[float] = None) -> None:
+        """A committed configuration change (rebucket, precision switch,
+        algorithm switch) legitimately moved the step wall: reset both CUSUM
+        baselines so they re-learn over a fresh warmup instead of reading
+        the new steady state as a sustained regression, and optionally
+        re-price the budget's wire expectation to the new configuration's
+        modeled wire (the autopilot passes its α–β prediction at nominal
+        bandwidth)."""
+        for detector in (self._wall, self._goodput):
+            detector.mean = None
+            detector.var = 0.0
+            detector.n = 0
+            detector.s = 0.0
+        self._budgets.clear()
+        if wire_ms is not None:
+            self.budget.wire_ms = float(wire_ms)
+
     def report(self) -> Dict:
         return {
             "steps_seen": self._steps_seen,

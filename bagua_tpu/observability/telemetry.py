@@ -464,16 +464,28 @@ class Telemetry:
         step: int = 0,
         predicted_exposed_ms: Optional[float] = None,
         measured_exposed_ms: Optional[float] = None,
+        reason: str = "planner",
+        algorithm: Optional[str] = None,
     ) -> None:
-        """The engine adopted a new bucket plan (autotune re-bucket).
+        """The engine adopted a new bucket plan (autotune re-bucket, or an
+        algorithm switch — ``algorithm`` names the newly adopted relaxation
+        in that case).
 
-        Exported as the ``plan_version`` gauge + ``rebucket_total`` counter so
-        a Prometheus scrape shows when the plan changed, and as a ``rebucket``
-        JSONL event carrying the planner's predicted exposed-communication
-        time for the new plan next to the measured value (when a device-trace
-        analysis supplied one) — the predicted-vs-measured drift record."""
+        Exported as the ``plan_version`` gauge + ``rebucket_total`` counter
+        (plus a per-reason-family counter — the unified switch vocabulary) so
+        a Prometheus scrape shows when and why the plan changed, and as a
+        ``rebucket`` JSONL event carrying the planner's predicted
+        exposed-communication time for the new plan next to the measured
+        value (when a device-trace analysis supplied one) — the
+        predicted-vs-measured drift record."""
+        from bagua_tpu.observability.metrics import switch_reason_family
+
         r = self.registry
         r.counter("rebucket_total", help="bucket-plan swaps adopted by the engine").inc()
+        r.counter(
+            f"rebucket_reason_{switch_reason_family(reason)}_total",
+            help="bucket-plan swaps by requesting reason family",
+        ).inc()
         r.gauge("plan_version", help="monotonic bucket-plan version").set(plan_version)
         if self.regression is not None:
             self.regression.plan_version = int(plan_version)
@@ -491,13 +503,16 @@ class Telemetry:
             self.tracer.record_event(
                 "rebucket",
                 attrs={"plan_version": int(plan_version),
-                       "n_buckets": int(n_buckets)},
+                       "n_buckets": int(n_buckets), "reason": str(reason)},
             )
         if self.jsonl:
             event = {
                 "event": "rebucket", "step": int(step),
                 "plan_version": int(plan_version), "n_buckets": int(n_buckets),
+                "reason": str(reason),
             }
+            if algorithm is not None:
+                event["algorithm"] = str(algorithm)
             if predicted_exposed_ms is not None:
                 event["predicted_exposed_ms"] = round(float(predicted_exposed_ms), 4)
             if measured_exposed_ms is not None:
@@ -518,10 +533,16 @@ class Telemetry:
         as the ``precision_switch_total`` counter plus per-precision bucket
         counts, and as a schema-validated ``precision_switch`` JSONL event
         carrying the full before/after per-bucket precision lists."""
+        from bagua_tpu.observability.metrics import switch_reason_family
+
         r = self.registry
         r.counter(
             "precision_switch_total",
             help="per-bucket wire-precision plan swaps adopted by the engine",
+        ).inc()
+        r.counter(
+            f"precision_switch_reason_{switch_reason_family(reason)}_total",
+            help="wire-precision plan swaps by requesting reason family",
         ).inc()
         if self.regression is not None:
             self.regression.plan_version = int(plan_version)
@@ -544,6 +565,54 @@ class Telemetry:
                  "new_precisions": new_precisions,
                  "reason": str(reason)}
             )
+
+    def on_plan_decision(
+        self,
+        step: int,
+        decision: str,
+        reason: str,
+        trace_id: str,
+        plan_version: int,
+        from_config: dict,
+        to_config: dict,
+        verdict: str,
+        modeled: Optional[dict] = None,
+    ) -> None:
+        """The gang autopilot made one policy decision
+        (:class:`~bagua_tpu.autopilot.GangAutopilot`): demote / re-promote /
+        switch / roll back / hold.  ``trace_id`` cites the triggering
+        ``perf_regression`` incident (empty when the trigger was a health
+        alert or a stabilization window); ``reason`` speaks the unified
+        switch vocabulary; ``modeled`` optionally carries the α–β priced
+        ``{"stay_ms", "chosen_ms"}`` comparison the decision rests on.
+        Exported as ``plan_decisions_total`` plus a per-verdict counter and
+        a schema-validated ``plan_decision`` JSONL event the timeline tools
+        join to incidents and switch events by ``trace_id``/``plan_version``."""
+        r = self.registry
+        r.counter("plan_decisions_total", help="autopilot policy decisions").inc()
+        r.counter(
+            f"plan_decisions_{verdict}_total",
+            help=f"autopilot decisions with verdict {verdict}",
+        ).inc()
+        if self.tracer is not None:
+            self.tracer.record_event(
+                "plan_decision",
+                attrs={"decision": str(decision), "verdict": str(verdict),
+                       "plan_version": int(plan_version)},
+            )
+        if self.jsonl:
+            event = {
+                "event": "plan_decision", "step": int(step),
+                "decision": str(decision), "reason": str(reason),
+                "trace_id": str(trace_id), "plan_version": int(plan_version),
+                "from_config": dict(from_config), "to_config": dict(to_config),
+                "verdict": str(verdict),
+            }
+            if modeled is not None:
+                event["modeled"] = {
+                    k: round(float(v), 4) for k, v in modeled.items()
+                }
+            self.jsonl.emit(event)
 
     def on_snapshot(
         self, step: int, wall_ms: float, n_bytes: int, kind: str = "async"

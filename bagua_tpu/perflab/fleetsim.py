@@ -373,6 +373,9 @@ def _window_verdict(cfg: FleetConfig, gang: int, window: int, step: int,
         "window": window,
         "ranks_reporting": view.ranks_reporting,
         "local_only": view.local_only,
+        # absolute gang pace, not just skew: what an autopilot driver feeds
+        # its regression sentinel to see a fault window's wire collapse
+        "gang_p50_ms": round(view.p50_median, 4),
         "p50_skew": round(view.skew, 4),
         "straggler": view.straggler,
         "stale_ranks": stale_ranks,

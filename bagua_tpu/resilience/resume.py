@@ -44,7 +44,9 @@ class ResumeResult:
         self.old_world_size = old_world_size
         self.new_world_size = new_world_size
         #: ``"carried"`` when the manifest's bucket plan was re-adopted,
-        #: ``"fresh"`` when the engine kept its cold-start plan
+        #: ``"autopilot"`` when that carried configuration was
+        #: autopilot-chosen, ``"fresh"`` when the engine kept its cold-start
+        #: plan
         self.plan_source = plan_source
 
 
@@ -167,6 +169,13 @@ class ElasticResumeCoordinator:
         # on the snapshot's layout.
         plan_payload = manifest.get("plan")
         plan_source = "carried" if self._adopt_plan(ddp, plan_payload) else "fresh"
+        if (
+            plan_source == "carried"
+            and (plan_payload.get("config") or {}).get("source") == "autopilot"
+        ):
+            # The configuration the snapshot ran was autopilot-chosen — say
+            # so, so dashboards can tell a tuned resume from an operator one.
+            plan_source = "autopilot"
         if hasattr(ddp, "clear_pending_reshard"):
             # The adoption above goes through ``rebucket``, which queues an
             # in-band state migration — but the snapshot was *taken* in the

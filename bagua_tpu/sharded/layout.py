@@ -188,6 +188,33 @@ def assemble_full_flats(rows_list: Sequence[np.ndarray], layout: ShardLayout):
     return [np.asarray(rows).reshape(-1) for rows in rows_list]
 
 
+def build_shard_rows(
+    values: Dict[str, np.ndarray], layout: ShardLayout, indices=None
+) -> List[np.ndarray]:
+    """Per-tensor flat values -> rank-stacked per-bucket shard rows
+    ``(layout.n_shards, shard_numel)`` — row ``r`` is exactly rank ``r``'s
+    contiguous flat shard of the bucket, alignment padding (and any tensor
+    missing from ``values``) zero-filled.  The scatter half of the
+    element-value-preserving contract: feeding a whole tree's values here
+    produces the state a sharded gang would hold, so an algorithm switch
+    can seed ``pending`` parameter shards / optimizer moments without ever
+    running an exchange.  ``indices`` restricts to a subset of buckets
+    (plan order), e.g. one dtype group's members."""
+    return _build_rows(values, layout, indices=indices)
+
+
+def flat_tree_values(tree) -> Dict[str, np.ndarray]:
+    """``{keystr(path): flattened numpy leaf}`` for a (single-rank) pytree —
+    the name-keyed form both resharding directions speak (slot names are
+    ``jax.tree_util.keystr`` paths by construction)."""
+    import jax
+
+    return {
+        jax.tree_util.keystr(p): np.asarray(l).reshape(-1)
+        for p, l in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
+
+
 def reshard_bucket_rows(
     rows_list: Sequence[np.ndarray], old: ShardLayout, new: ShardLayout
 ) -> List[np.ndarray]:

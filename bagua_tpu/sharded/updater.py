@@ -29,11 +29,12 @@ from typing import Any, Dict, List, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from bagua_tpu.bucket import BucketPlan, flatten_bucket_leaves
 from bagua_tpu.communication import rank_id
-from bagua_tpu.sharded.layout import ShardLayout
+from bagua_tpu.sharded.layout import ShardLayout, build_shard_rows
 from bagua_tpu.utils import from_bagua_datatype
 
 __all__ = ["ShardedOptState", "ShardedOptimizerUpdater", "FusedState", "fuse_optimizer"]
@@ -168,6 +169,180 @@ class ShardedOptimizerUpdater:
             ShardedOptState(sharded=tuple(new_sharded), local=new_local),
             new_params,
         )
+
+    # -- full-state remap (host, numpy) --------------------------------------
+    #
+    # The bitwise contract above means the sharded state IS the unsharded
+    # state, just re-laid-out: mu/nu shard rows are flat slices of the full
+    # moments, counts are replicated.  gather/scatter below make that
+    # isomorphism executable so ``switch_algorithm`` can move live optimizer
+    # state between zero and any unsharded algorithm (or between two plans)
+    # element-value-preservingly without running a collective.
+
+    def _inner_state_index(self) -> Dict[str, str]:
+        """``{keystr: "param"|"scalar"}`` over the inner state of a single
+        flat parameter vector.  Probing two sizes separates leaves that
+        mirror the parameter (moments — shape tracks the input) from
+        shape-free leaves (step counts)."""
+
+        def probe(n):
+            return jax.eval_shape(
+                self.inner.init, jax.ShapeDtypeStruct((n,), jnp.float32)
+            )
+
+        a = jax.tree_util.tree_flatten_with_path(probe(3))[0]
+        b = jax.tree_util.tree_flatten_with_path(probe(5))[0]
+        return {
+            jax.tree_util.keystr(pa): "param" if la.shape != lb.shape else "scalar"
+            for (pa, la), (_, lb) in zip(a, b)
+        }
+
+    def _group_slot_values(self, grp, leaf: np.ndarray) -> Dict[str, np.ndarray]:
+        """One rank-stacked per-element state leaf ``(n, shard_total)`` ->
+        ``{tensor_name: flat values}`` (row r is rank r's shard, so each
+        member bucket's rows reassemble into its full flat)."""
+        values: Dict[str, np.ndarray] = {}
+        col = 0
+        for bi in grp.buckets:
+            b = self.layout.buckets[bi]
+            full = np.ascontiguousarray(leaf[:, col : col + b.shard_numel]).reshape(-1)
+            for s in b.slots:
+                values[s.name] = full[s.offset : s.offset + s.numel]
+            col += b.shard_numel
+        return values
+
+    def gather_full_state(self, opt_state: ShardedOptState, params) -> Any:
+        """Rank-stacked sharded optimizer state -> the single unsharded inner
+        state over the full parameter tree (host numpy), exactly what
+        ``inner.init(params)`` + the same update history would hold.
+
+        ``opt_state`` leaves must be host/numpy-coercible and rank-stacked
+        (leading axis = ``layout.n_shards``); ``params`` is a single-rank
+        template (shapes/dtypes only).  Matching is structural: an unsharded
+        state leaf at keystr ``kf + <tensor name>`` is the per-element leaf
+        ``kf`` of that tensor's dtype group (slot-sliced), an exact-``kf``
+        leaf is shape-free and taken from row 0.  Optimizers whose state
+        isn't a params-mirror plus shape-free leaves (e.g. ``masked``
+        wrappers) are rejected rather than silently misfiled."""
+        index = self._inner_state_index()
+        uncovered = set(self._uncovered(params).keys())
+
+        values: Dict[str, Dict[str, np.ndarray]] = {}
+        scalars: Dict[str, np.ndarray] = {}
+        for gi, grp in enumerate(self.layout.groups):
+            for p, leaf in jax.tree_util.tree_flatten_with_path(
+                opt_state.sharded[gi]
+            )[0]:
+                kf = jax.tree_util.keystr(p)
+                leaf = np.asarray(leaf)
+                if index.get(kf) == "param":
+                    values.setdefault(kf, {}).update(
+                        self._group_slot_values(grp, leaf)
+                    )
+                else:
+                    scalars[kf] = leaf[0]
+        for p, leaf in jax.tree_util.tree_flatten_with_path(opt_state.local)[0]:
+            leaf = np.asarray(leaf)
+            if (
+                p
+                and isinstance(p[-1], jax.tree_util.DictKey)
+                and p[-1].key in uncovered
+            ):
+                kf = jax.tree_util.keystr(p[:-1])
+                values.setdefault(kf, {})[p[-1].key] = leaf[0].reshape(-1)
+            else:
+                scalars.setdefault(jax.tree_util.keystr(p), leaf[0])
+
+        u_shape = jax.eval_shape(self.inner.init, params)
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(u_shape)
+        param_keys = sorted(values, key=len, reverse=True)  # longest prefix wins
+        out = []
+        for p, leaf in leaves:
+            ku = jax.tree_util.keystr(p)
+            if index.get(ku) == "scalar":
+                out.append(
+                    np.asarray(scalars[ku]).reshape(leaf.shape).astype(leaf.dtype)
+                )
+                continue
+            flat = None
+            for kf in param_keys:
+                if ku.startswith(kf) and ku[len(kf) :] in values[kf]:
+                    flat = values[kf][ku[len(kf) :]]
+                    break
+            if flat is None:
+                raise ValueError(
+                    f"optimizer state leaf {ku!r} has no sharded counterpart — "
+                    "full-state remap supports inner optimizers whose state is "
+                    "a params-mirror plus shape-free leaves"
+                )
+            out.append(flat.reshape(leaf.shape).astype(leaf.dtype, copy=False))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def scatter_full_state(self, full_state, params) -> ShardedOptState:
+        """Inverse of :meth:`gather_full_state`: one unsharded inner state ->
+        the rank-stacked :class:`ShardedOptState` this updater's layout would
+        hold (host numpy) — per-element leaves sliced into shard rows by slot
+        name (alignment padding zero, matching init semantics), shape-free
+        leaves replicated across ranks."""
+        n = self.layout.n_shards
+        index = self._inner_state_index()
+        u_named = {
+            jax.tree_util.keystr(p): np.asarray(l)
+            for p, l in jax.tree_util.tree_flatten_with_path(full_state)[0]
+        }
+
+        def stacked(kf, leaf_shape, leaf_dtype):
+            if kf not in u_named:
+                raise ValueError(f"full optimizer state is missing leaf {kf!r}")
+            v = u_named[kf].reshape(leaf_shape).astype(leaf_dtype, copy=False)
+            return np.broadcast_to(v, (n,) + tuple(leaf_shape)).copy()
+
+        sharded = []
+        for grp in self.layout.groups:
+            f_shape = jax.eval_shape(
+                self.inner.init,
+                jax.ShapeDtypeStruct((grp.shard_total,), grp.np_dtype()),
+            )
+            leaves, treedef = jax.tree_util.tree_flatten_with_path(f_shape)
+            built = []
+            for p, leaf in leaves:
+                kf = jax.tree_util.keystr(p)
+                if index.get(kf) == "param":
+                    vals = {}
+                    for bi in grp.buckets:
+                        for s in self.layout.buckets[bi].slots:
+                            if kf + s.name not in u_named:
+                                raise ValueError(
+                                    f"full optimizer state is missing leaf "
+                                    f"{kf + s.name!r}"
+                                )
+                            vals[s.name] = u_named[kf + s.name].reshape(-1)
+                    rows = build_shard_rows(vals, self.layout, indices=grp.buckets)
+                    built.append(
+                        np.concatenate(rows, axis=1).astype(leaf.dtype, copy=False)
+                        if rows
+                        else np.zeros((n, 0), leaf.dtype)
+                    )
+                else:
+                    built.append(stacked(kf, leaf.shape, leaf.dtype))
+            sharded.append(jax.tree_util.tree_unflatten(treedef, built))
+
+        l_shape = jax.eval_shape(self.inner.init, self._uncovered(params))
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(l_shape)
+        uncovered = set(self._uncovered(params).keys())
+        built = []
+        for p, leaf in leaves:
+            if (
+                p
+                and isinstance(p[-1], jax.tree_util.DictKey)
+                and p[-1].key in uncovered
+            ):
+                kf = jax.tree_util.keystr(p[:-1]) + p[-1].key
+            else:
+                kf = jax.tree_util.keystr(p)
+            built.append(stacked(kf, leaf.shape, leaf.dtype))
+        local = jax.tree_util.tree_unflatten(treedef, built)
+        return ShardedOptState(sharded=tuple(sharded), local=local)
 
 
 # -- fused (unsharded) optimizer ----------------------------------------------

@@ -63,6 +63,12 @@ class Trainer:
             view (:class:`~bagua_tpu.observability.aggregate.GangAggregator`
             — best-effort: a missing/unreachable KV degrades to a
             local-only view with zero training-path impact).
+        autopilot: opt-in
+            :class:`~bagua_tpu.autopilot.GangAutopilot` bound to this
+            trainer's DDP engine.  The fit loop ticks it once per step with
+            the step's mean loss; the controller may switch the gang's
+            algorithm/precision configuration (the returned state replaces
+            the loop's) — every move statically verified before dispatch.
     """
 
     def __init__(
@@ -87,6 +93,7 @@ class Trainer:
         dp_axis=None,
         fsdp_axis=None,
         tp_axis=None,
+        autopilot=None,
     ):
         # Env-gated persistent compile cache (BAGUA_COMPILE_CACHE_DIR): a
         # restarted trainer deserializes the step executable instead of
@@ -105,6 +112,14 @@ class Trainer:
             health_monitor=health_monitor,
             dp_axis=dp_axis, fsdp_axis=fsdp_axis, tp_axis=tp_axis,
         )
+        # The engine is constructed here, so a pre-built controller can't be
+        # bound to it yet: accept a factory (``lambda ddp: GangAutopilot(ddp,
+        # cost_model, ...)``) or an instance whose ``ddp`` we (re)bind.
+        if callable(autopilot) and not hasattr(autopilot, "tick"):
+            autopilot = autopilot(self.ddp)
+        elif autopilot is not None:
+            autopilot.ddp = self.ddp
+        self.autopilot = autopilot
         self.gang_window = int(gang_window)
         self.gang = None  # built lazily in init_state (needs the KV client)
         self.ckpt_dir = ckpt_dir
@@ -281,6 +296,11 @@ class Trainer:
             if self._session:
                 self._session.tick(n_samples)
             step = self._state_step(state)
+            if self.autopilot is not None:
+                # the controller may remap the state (algorithm switch) —
+                # the loss sync here is what feeds its canary parity check
+                jax.block_until_ready(losses)
+                state = self.autopilot.tick(state, step, float(losses.mean()))
             if self.snapshotter is not None:
                 self.snapshotter.maybe_snapshot(state, step)
             if self.gang is not None:

@@ -27,7 +27,12 @@ mapping:
   riding a ``/fleet/timeline`` response as ``"item": "incident"`` rows —
   → ``i`` instants named ``perf_regression:<dominant>`` carrying the full
   budget-component partition in ``args``, so the regression verdict lands
-  on the same Perfetto canvas as the spans it indicts.
+  on the same Perfetto canvas as the spans it indicts;
+* autopilot ``plan_decision`` events (metrics JSONL or ``"item":
+  "decision"`` timeline rows) → ``i`` instants named
+  ``plan_decision:<decision>`` with the from/to configuration, verdict and
+  the triggering incident's ``trace_id`` in ``args`` — incident and
+  response visible on the same canvas.
 
 :func:`validate_chrome_trace` schema-checks the output — the CI tracing
 lane gates on it.  Stdlib only.
@@ -90,16 +95,23 @@ def load_timeline(payload: dict) -> "tuple[List[dict], List[dict]]":
             span = {k: v for k, v in item.items() if k != "item"}
             if not validate_span(span):
                 spans.append(span)
-        elif kind in ("event", "incident"):
-            # incident rows are perf_regression events the gang pushed to
-            # the fleet's volatile incident ring — same instant rendering
+        elif kind in ("event", "incident", "decision"):
+            # incident/decision rows are perf_regression / plan_decision
+            # events the gang pushed to the fleet's volatile rings — same
+            # instant rendering
             events.append({k: v for k, v in item.items() if k != "item"})
     return spans, events
 
 
+#: metrics-JSONL event kinds that render as timeline instants
+_ANNOTATION_EVENTS = ("perf_regression", "plan_decision")
+
+
 def load_metrics_incidents(path: str) -> List[dict]:
-    """The ``perf_regression`` events from a metrics JSONL (rotated set
-    included) — annotation instants for the timeline."""
+    """The annotation events from a metrics JSONL (rotated set included) —
+    ``perf_regression`` incidents and autopilot ``plan_decision`` rows
+    become instants on the timeline, joined to each other by
+    ``trace_id``."""
     from bagua_tpu.observability.metrics import (
         rotated_metrics_files, validate_metrics_event,
     )
@@ -119,7 +131,7 @@ def load_metrics_incidents(path: str) -> List[dict]:
                     ev = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if ev.get("event") == "perf_regression" and \
+                if ev.get("event") in _ANNOTATION_EVENTS and \
                         not validate_metrics_event(ev):
                     incidents.append(ev)
     return incidents
@@ -235,6 +247,12 @@ def spans_to_trace_events(
             # glance, with the full partition in args
             name = f"perf_regression:{ev.get('dominant') or 'unattributed'}"
             cat = "incident"
+        elif name == "plan_decision":
+            # same treatment for the autopilot: the decision kind headlines
+            # (plan_decision:demote_precision / :switch_algorithm / ...),
+            # from/to configs + verdict + citing trace_id ride in args
+            name = f"plan_decision:{ev.get('decision') or 'unknown'}"
+            cat = "decision"
         pid, tid = tracks.resolve("events", name)
         out.append({
             "ph": "i", "name": name,

@@ -1243,6 +1243,247 @@ def regression_attribution_lane(out_prefix: str, steps: int = 200):
     }
 
 
+def autopilot_lane(out_prefix: str):
+    """Executed gang-autopilot gate: the closed loop, end to end.
+
+    A real 8-rank engine (gradient_allreduce, ``wire_precision="auto"``,
+    overlap auto) trains a small MLP while a fleetsim bandwidth collapse
+    (ICI brownout, x8 for three windows, then recovery) supplies the gang
+    step-wall signal: each window's ``gang_p50_ms`` anchors the walls fed
+    to a priced :class:`RegressionSentinel`, scaled by the α–β modeled
+    cost of whatever configuration the gang is *currently* on.  A real
+    :class:`HealthMonitor` sees the (once-spiked) loss stream, and the
+    :class:`GangAutopilot` closes the loop with real recompiles under
+    ``BAGUA_STATIC_VERIFY=strict``.
+
+    The contract asserted:
+
+    * the collapse trips wire-dominant incidents; a loss spike at its
+      onset *delays* the demotion (never chase goodput while the loss
+      misbehaves);
+    * once healthy, the controller demotes to int8 — the α–β modeled
+      step-ms of the chosen configuration strictly below stay-put — rides
+      a canary to a loss-parity commit, and re-baselines the sentinel
+      (no incident storm from the legitimately changed wall);
+    * after recovery + ``repromote_windows`` clean quarantined steps it
+      re-promotes to f32 (the goodput-recovery win), again via canary;
+    * zero strict-verifier rejections were dispatched;
+    * every ``plan_decision`` cites a real incident ``trace_id``, the
+      JSONL validates, ``ci/perf_doctor.py`` joins decision ↔ incident ↔
+      switch, and the fleet control plane's scheduler view carries the
+      autopilot verdict.
+
+    tests/test_ci_lane.py greps the stderr sentinel and re-checks the
+    audit fields.
+    """
+    import bagua_tpu
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.autopilot import (
+        AutopilotConfig, Configuration, GangAutopilot, modeled_step_ms,
+    )
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.fleet.control_plane import FleetControlPlane
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+    from bagua_tpu.observability import (
+        BudgetModel, HealthMonitor, RegressionSentinel, Telemetry,
+        validate_metrics_file,
+    )
+    from bagua_tpu.perflab.fleetsim import (
+        BandwidthCollapse, FleetConfig, run_fleet,
+    )
+    from bagua_tpu.service.planner import AlphaBeta, CostModel
+
+    COMPUTE_MS, WIRE_MS, STEPS_PER_WINDOW = 6.0, 4.0, 20
+    os.environ["BAGUA_STATIC_VERIFY"] = "strict"
+    try:
+        group = bagua_tpu.init_process_group(intra_size=4)
+        metrics_path = out_prefix + "_autopilot_metrics.jsonl"
+        if os.path.exists(metrics_path):
+            os.remove(metrics_path)  # append-mode sink: fresh stream
+        tel = Telemetry(metrics_jsonl=metrics_path, flight=None)
+        ddp = DistributedDataParallel(
+            loss_fn=mse_loss, optimizer=optax.sgd(0.01),
+            algorithm=GradientAllReduceAlgorithm(wire_precision="auto"),
+            process_group=group, bucket_size_bytes=1 << 16, overlap="auto",
+            telemetry=tel,
+        )
+        params = init_mlp(jax.random.PRNGKey(3), [64, 128, 128, 64])
+        state = ddp.init(params)
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.rand(8 * group.size, 64).astype(np.float32))
+        y = jnp.asarray(rng.rand(8 * group.size, 64).astype(np.float32))
+
+        # α–β model sized to THIS plan so the ranking genuinely flips:
+        # f32 flat is pure bandwidth (4 ms nominal = the fleetsim wire
+        # span); the int8 ring is pure hop latency (6 ms at any
+        # bandwidth).  Nominal: f32 wins.  x8 collapse: int8 wins.
+        total_nbytes = sum(s.nbytes for s in ddp.plan.specs)
+        hops = 2 * (group.size - 1)
+        cm = CostModel(
+            flat=AlphaBeta(alpha=0.0, beta=total_nbytes / (WIRE_MS * 1e-3)),
+            qr8=AlphaBeta(
+                alpha=6e-3 / (hops * ddp.plan.num_buckets), beta=1e15,
+            ),
+        )
+        sentinel = RegressionSentinel(
+            budget=BudgetModel(compute_ms=COMPUTE_MS, wire_ms=WIRE_MS),
+            sink=tel.jsonl, registry=tel.registry,
+            warmup=20, threshold=8.0, cooldown=0, window=20,
+        )
+        health = HealthMonitor(telemetry=tel)
+        pilot = GangAutopilot(
+            ddp, cm,
+            AutopilotConfig(
+                cooldown_steps=15, hysteresis_incidents=2, canary_steps=5,
+                canary_loss_factor=1.5, repromote_windows=60,
+                precisions=("f32", "int8"),
+                algorithms=("gradient_allreduce",), compute_ms=COMPUTE_MS,
+            ),
+            sentinel=sentinel, health=health, telemetry=tel,
+        )
+
+        # the fleet signal: 2 clean windows, 3 collapsed x8, 3 recovered
+        sim = run_fleet(FleetConfig(
+            n_gangs=1, ranks_per_gang=4, windows=8, seed=0,
+            compute_ms=COMPUTE_MS, wire_ms=WIRE_MS,
+            steps_per_window=STEPS_PER_WINDOW,
+            faults=(BandwidthCollapse(gang=0, factor=8.0,
+                                      start_window=3, end_window=6),),
+        ))
+        windows = sim["gangs"][0]["windows"]
+        assert all(w.get("gang_p50_ms") for w in windows), windows
+
+        f32_cfg = Configuration()
+        spike_steps = {2 * STEPS_PER_WINDOW, 2 * STEPS_PER_WINDOW + 1}
+        step = 0
+        precisions_seen = set()
+        for w, wv in enumerate(windows, start=1):
+            gang_p50 = float(wv["gang_p50_ms"])
+            factor = max(1.0, (gang_p50 - COMPUTE_MS) / WIRE_MS)
+            for _ in range(STEPS_PER_WINDOW):
+                state, losses = ddp.train_step(state, (x, y))
+                loss = float(np.asarray(losses).mean())
+                if step in spike_steps:
+                    loss *= 50.0  # the injected loss spike (collapse onset)
+                # the fleetsim clocks model the f32 gang; walls for the
+                # currently-adopted configuration scale by the α–β ratio
+                cur = pilot.current_configuration()
+                wall = gang_p50 * (
+                    modeled_step_ms(cm, ddp.plan, group.size, cur,
+                                    COMPUTE_MS, bandwidth_factor=factor)
+                    / modeled_step_ms(cm, ddp.plan, group.size, f32_cfg,
+                                      COMPUTE_MS, bandwidth_factor=factor)
+                )
+                sentinel.note_wire(max(0.0, wall - COMPUTE_MS))
+                sentinel.observe_step(step, wall, host_ms=0.5,
+                                      trace_id=f"lane-w{w}-s{step}")
+                health.observe(step, loss, grad_norm=1.0, nonfinite=0)
+                state = pilot.tick(state, step, loss)
+                precisions_seen.add(pilot.current_configuration().precision)
+                step += 1
+        jax.block_until_ready(state.params)
+        tel.close()
+        ddp.shutdown()
+    finally:
+        os.environ.pop("BAGUA_STATIC_VERIFY", None)
+
+    # -- the closed loop converged, both ways ---------------------------------
+    assert pilot.verifier_rejections == 0, (
+        f"strict verifier rejected {pilot.verifier_rejections} dispatches"
+    )
+    assert precisions_seen == {"f32", "int8"}, precisions_seen
+    assert pilot.current_configuration().precision == "f32", (
+        "re-promotion never landed: still quantized after recovery"
+    )
+    demotes = [d for d in pilot.decisions if d["decision"] == "demote_precision"]
+    assert [d["verdict"] for d in demotes] == ["canary", "committed"], demotes
+    assert demotes[0]["reason"] == "autopilot:wire_slowdown"
+    assert demotes[0]["modeled"]["chosen_ms"] < demotes[0]["modeled"]["stay_ms"], (
+        f"demotion must model strictly below stay-put: {demotes[0]['modeled']}"
+    )
+    repromotes = [
+        d for d in pilot.decisions if d["decision"] == "repromote_precision"
+    ]
+    assert [d["verdict"] for d in repromotes] == ["canary", "committed"], repromotes
+    assert repromotes[0]["reason"] == "autopilot:stabilized"
+    # the loss spike was seen, and the demotion waited for health: the first
+    # action happened after the spiked steps
+    assert any(a["kind"] == "loss_spike" for a in health.alerts), health.alerts
+    assert demotes[0]["step"] > max(spike_steps), (
+        f"demotion at step {demotes[0]['step']} did not wait out the loss "
+        f"spike at {sorted(spike_steps)}"
+    )
+    # every decision cites a real incident's trace_id
+    incident_traces = {i["trace_id"] for i in sentinel.incidents}
+    for d in pilot.decisions:
+        assert d["trace_id"] in incident_traces, d
+    wire_incidents = [
+        i for i in sentinel.incidents if i["dominant"] == "wire_slowdown"
+    ]
+    assert wire_incidents, "collapse never attributed to wire_slowdown"
+    # the rebaseline held: no incidents after the demote committed
+    last_incident_step = max(i["step"] for i in sentinel.incidents)
+    assert last_incident_step < demotes[1]["step"] + STEPS_PER_WINDOW, (
+        f"incident storm after the switch: last at {last_incident_step}"
+    )
+
+    # -- stream + joins --------------------------------------------------------
+    problems = validate_metrics_file(metrics_path)
+    assert not problems, f"autopilot lane metrics failed schema: {problems}"
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import perf_doctor as doctor
+
+    events = doctor.load_events([metrics_path])
+    inc_events = [e for e in events if e.get("event") == "perf_regression"]
+    assert inc_events, "no perf_regression events reached the stream"
+    joined = doctor.build_incident_report(inc_events[-1], events)
+    assert joined["decisions"], "doctor failed to join decision <-> incident"
+    assert joined["decision_switches"], (
+        "doctor failed to join decision <-> switch (plan_version)"
+    )
+
+    # -- the fleet sees the verdict -------------------------------------------
+    fleet = FleetControlPlane()
+    gang = "autopilot-lane"
+    fleet.gang(gang)
+    ingest = fleet.ingest_decisions(gang, pilot.drain_decisions())
+    assert ingest["rejected"] == 0 and ingest["accepted"] == len(pilot.decisions)
+    row = fleet.scheduler_view()["gangs"][gang]
+    assert row["autopilot"]["decision"] == "repromote_precision", row
+    assert row["autopilot"]["verdict"] == "committed", row
+    n_timeline_decisions = sum(
+        1 for item in fleet.timeline(gang)["items"]
+        if item.get("item") == "decision"
+    )
+    assert n_timeline_decisions == len(pilot.decisions)
+
+    print(
+        f"[audit] autopilot lane passed ({len(pilot.decisions)} decisions, "
+        f"demote step {demotes[0]['step']} -> commit {demotes[1]['step']}, "
+        f"repromote step {repromotes[0]['step']} -> commit "
+        f"{repromotes[1]['step']}, {len(wire_incidents)} wire incidents, "
+        "0 verifier rejections)",
+        file=sys.stderr,
+    )
+    return {
+        "ok": True,
+        "decisions": len(pilot.decisions),
+        "verifier_rejections": 0,
+        "demote_step": demotes[0]["step"],
+        "demote_commit_step": demotes[1]["step"],
+        "repromote_step": repromotes[0]["step"],
+        "repromote_commit_step": repromotes[1]["step"],
+        "demote_modeled": demotes[0]["modeled"],
+        "repromote_modeled": repromotes[0]["modeled"],
+        "wire_incidents": len(wire_incidents),
+        "loss_spike_alerts": sum(
+            1 for a in health.alerts if a["kind"] == "loss_spike"
+        ),
+        "final_configuration": pilot.current_configuration().as_dict(),
+        "scheduler_autopilot": row["autopilot"],
+    }
+
+
 def autotune_planner_lane(fixture_path=None):
     """Recorded-span planner gate (pure cost model, no compile — CPU-safe).
 
@@ -2507,6 +2748,15 @@ def main():
     regression_result = None
     if args.algo is None and args.wire is None:
         regression_result = regression_attribution_lane(args.out)
+    # Gang-autopilot gate: a fleetsim bandwidth collapse (plus a loss spike
+    # at its onset) must drive the controller to the α–β-cheapest healthy
+    # configuration (int8 demotion, canary-committed) and BACK (f32
+    # re-promotion after recovery + quarantine), with zero strict-verifier
+    # rejections, every decision citing a real incident trace_id, and the
+    # doctor/fleet joins holding.  The focused --algo/--wire lanes skip it.
+    autopilot_result = None
+    if args.algo is None and args.wire is None:
+        autopilot_result = autopilot_lane(args.out)
     # Recorded-span planner gate: DP partition must beat the greedy seed
     # plan's predicted exposed comm on the committed VGG16 fixture.
     planner_result = autotune_planner_lane()
@@ -2550,6 +2800,7 @@ def main():
              "bench_modeled": bench_modeled_result,
              "fleet_sim": fleet_sim_result,
              "regression_attribution": regression_result,
+             "autopilot": autopilot_result,
              "resilience": resilience_result,
              "fleet_load": fleet_load_result},
             f, indent=1,

@@ -11,8 +11,11 @@ to everything else the observability stack recorded around it —
 
 * the metrics JSONL itself: ``step`` walls around the incident,
   ``compile`` / ``snapshot`` / ``rpc_retry`` / ``health_alert`` events in
-  the attribution window, and the ``rebucket`` / ``precision_switch``
-  event that produced the incident's ``plan_version``;
+  the attribution window, the ``rebucket`` / ``precision_switch`` event
+  that produced the incident's ``plan_version``, and the autopilot's
+  answer — ``plan_decision`` rows citing this incident's ``trace_id``,
+  each joined (by its post-switch ``plan_version``) to the switch event
+  it dispatched;
 * a span JSONL (``BAGUA_TRACE_PATH`` output), joined on the incident's
   ``trace_id`` — the RPCs in flight when the sentinel fired;
 * flight-recorder dumps (``flight_<rank>.json``), when the hang forensics
@@ -170,6 +173,22 @@ def build_incident_report(
                 e.get("plan_version") == plan_version:
             plan_event = e  # newest wins (events are ts-sorted)
 
+    # the autopilot's answer to THIS incident: plan_decision rows citing the
+    # incident's trace_id, plus the switch events each committed decision
+    # produced (joined by the decision's post-switch plan_version)
+    trace_id = str(incident.get("trace_id") or "")
+    decisions = [
+        e for e in events
+        if e.get("event") == "plan_decision"
+        and trace_id and e.get("trace_id") == trace_id
+    ]
+    decision_switches = []
+    decision_versions = {d.get("plan_version") for d in decisions}
+    for e in events:
+        if e.get("event") in ("rebucket", "precision_switch") and \
+                e.get("plan_version") in decision_versions:
+            decision_switches.append(e)
+
     report = {
         "incident": incident,
         "step": step,
@@ -188,6 +207,8 @@ def build_incident_report(
             "health_alerts": _window(events, "health_alert", lo, hi),
             "plan_event": plan_event,
         },
+        "decisions": decisions,
+        "decision_switches": decision_switches,
         "trace_spans": spans or [],
         "flight_by_rank": flight or {},
     }
@@ -252,6 +273,20 @@ def render_report(report: dict) -> str:
         lines.append(
             f"  plan_version {report['incident'].get('plan_version')} came "
             f"from a {pe.get('event')} at step {pe.get('step')}"
+        )
+    for dec in report.get("decisions") or []:
+        frm = dec.get("from_config") or {}
+        to = dec.get("to_config") or {}
+        lines.append(
+            f"  autopilot answered: {dec.get('decision')} "
+            f"{frm.get('algorithm')}/{frm.get('precision')} -> "
+            f"{to.get('algorithm')}/{to.get('precision')} at step "
+            f"{dec.get('step')} [{dec.get('verdict')}]"
+        )
+    for sw in report.get("decision_switches") or []:
+        lines.append(
+            f"  ... landing as a {sw.get('event')} at step {sw.get('step')} "
+            f"(plan_version {sw.get('plan_version')})"
         )
     if "straggler_rank" in report and report["straggler_rank"] >= 0:
         lines.append(f"  sentinel attributes the window to rank "

@@ -1,0 +1,345 @@
+"""Gang-autopilot policy unit tests (``bagua_tpu/autopilot/``).
+
+The controller's contract is exercised against small fakes of the engine,
+sentinel and health monitor — the heavy integration (real engine, real
+recompiles, closed loop) lives in ``tests/test_switch_algorithm.py`` and
+the ``autopilot`` lane of ``ci/perf_audit.py``.  What is pinned here:
+
+* hysteresis — one wire-dominant incident is not evidence, two are;
+* the canary protocol — probation, loss-parity commit, rollback;
+* the safety rung — a health reset while quantized re-promotes to f32
+  immediately, no canary;
+* stability re-promotion — at nominal bandwidth the α-dominated gang
+  moves back to f32 and the health monitor is re-armed;
+* cooldown — a knob just acted on holds, and the hold is *recorded*;
+* strict-verifier rejections — counted, recorded, never dispatched;
+* evidence plumbing — incidents are consumed non-destructively, every
+  decision cites the triggering trace_id, rows validate against the
+  ``plan_decision`` schema.
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from bagua_tpu.autopilot import (
+    AutopilotConfig,
+    Configuration,
+    GangAutopilot,
+    candidate_configurations,
+    degraded_cost_model,
+    price_configurations,
+)
+from bagua_tpu.observability.metrics import validate_metrics_event
+from bagua_tpu.service.planner import AlphaBeta, CostModel
+
+# A regime where the ranking genuinely flips (see pricing.py): one 16 MiB
+# bucket over 8 ranks — at nominal bandwidth the flat f32 allreduce is
+# cheapest (the quantized ring pays 2(n-1) sequential hop latencies); under
+# a bandwidth collapse the β term dominates and the compressed wire wins.
+COST_MODEL = CostModel(flat=AlphaBeta(50e-6, 40e9), qr8=AlphaBeta(60e-6, 90e9))
+PLAN = SimpleNamespace(num_buckets=1, specs=[SimpleNamespace(numel=4 << 20, nbytes=16 << 20)])
+
+
+class FakeImpl:
+    def __init__(self, precisions=None):
+        self.algo_name = "gradient_allreduce"
+        self.wire_precision = "auto"
+        self.hierarchical = False
+        self._precs = precisions
+
+    def bucket_precisions(self, plan):
+        return list(self._precs or ["f32"] * plan.num_buckets)
+
+    def set_bucket_precision(self, *a, **kw):  # existence gates the knob
+        raise AssertionError("the controller goes through apply_precision_plan")
+
+
+class FakeDdp:
+    def __init__(self, precisions=None):
+        self.impl = FakeImpl(precisions)
+        self.plan = PLAN
+        self.plan_version = 0
+        self.group = SimpleNamespace(exchange_size=8)
+        self.switches = []
+        self.precision_applies = []
+        self.fail_next = False
+
+    def switch_algorithm(self, state, name, reason=None, **kw):
+        if self.fail_next:
+            self.fail_next = False
+            raise ValueError("static verify rejected the program")
+        self.impl.algo_name = name
+        self.plan_version += 1
+        self.switches.append((name, reason))
+        return state
+
+    def apply_precision_plan(self, precisions, reason=None):
+        if self.fail_next:
+            self.fail_next = False
+            raise ValueError("static verify rejected the program")
+        if list(precisions) == self.impl.bucket_precisions(self.plan):
+            return False
+        self.impl._precs = list(precisions)
+        self.plan_version += 1
+        self.precision_applies.append((tuple(precisions), reason))
+        return True
+
+
+class FakeHealth:
+    def __init__(self, clean_streak=10**6):
+        self.clean_streak = clean_streak
+        self.rearmed = 0
+
+    def stabilized(self, n_windows):
+        return self.clean_streak >= max(1, int(n_windows))
+
+    def rearm(self):
+        self.rearmed += 1
+
+
+def _incident(trace="tr-1", measured=50.0, expected=5.0, dominant="wire_slowdown"):
+    return {
+        "dominant": dominant, "measured_ms": measured, "expected_ms": expected,
+        "trace_id": trace, "step": 0, "plan_version": 0,
+    }
+
+
+def _pilot(ddp=None, health=None, sentinel=None, **cfg):
+    cfg.setdefault("compute_ms", 1.0)
+    cfg.setdefault("algorithms", ("gradient_allreduce",))
+    sentinel = sentinel or SimpleNamespace(incidents=[], plan_version=0, budget=None)
+    return GangAutopilot(
+        ddp or FakeDdp(), COST_MODEL, AutopilotConfig(**cfg),
+        sentinel=sentinel, health=health or FakeHealth(),
+    ), sentinel
+
+
+# -- pricing ------------------------------------------------------------------
+
+
+def test_bandwidth_factor_degrades_beta_not_alpha():
+    d = degraded_cost_model(COST_MODEL, 10.0)
+    assert d.flat.alpha == COST_MODEL.flat.alpha
+    assert d.flat.beta == pytest.approx(COST_MODEL.flat.beta / 10.0)
+    assert degraded_cost_model(COST_MODEL, 1.0) is COST_MODEL
+
+
+def test_pricing_ranking_flips_with_bandwidth():
+    cands = candidate_configurations(("gradient_allreduce",), ("f32", "int8"))
+    nominal = price_configurations(COST_MODEL, PLAN, 8, cands, 1.0)
+    collapsed = price_configurations(
+        COST_MODEL, PLAN, 8, cands, 1.0, bandwidth_factor=10.0
+    )
+    assert nominal[0][0].precision == "f32"
+    assert collapsed[0][0].precision == "int8"
+
+
+# -- hysteresis + demotion ----------------------------------------------------
+
+
+def test_single_incident_is_held_by_hysteresis():
+    pilot, sentinel = _pilot()
+    sentinel.incidents.append(_incident())
+    pilot.tick(None, step=10, loss=1.0)
+    assert pilot.decisions == []
+    assert pilot.ddp.precision_applies == []
+
+
+def test_demotes_after_hysteresis_with_canary_and_trace():
+    pilot, sentinel = _pilot()
+    sentinel.incidents.extend([_incident("tr-a"), _incident("tr-b")])
+    pilot.tick(None, step=10, loss=1.0)
+    assert pilot.ddp.impl.bucket_precisions(PLAN) == ["int8"]
+    (row,) = pilot.decisions
+    assert row["decision"] == "demote_precision"
+    assert row["verdict"] == "canary"
+    assert row["reason"] == "autopilot:wire_slowdown"
+    assert row["trace_id"] == "tr-b"  # the adjudicated incident
+    assert row["modeled"]["chosen_ms"] < row["modeled"]["stay_ms"]
+    assert pilot.report()["canary_active"]
+    assert validate_metrics_event(row) == []
+
+
+def test_demotion_requires_current_health():
+    pilot, sentinel = _pilot(health=FakeHealth(clean_streak=0))
+    # gang still on f32: the safety rung is idle, but demotion must not
+    # chase goodput while the loss is misbehaving
+    sentinel.incidents.extend([_incident(), _incident()])
+    pilot.tick(None, step=10, loss=1.0)
+    assert pilot.ddp.precision_applies == []
+
+
+# -- canary adjudication ------------------------------------------------------
+
+
+def _demoted_pilot(**cfg):
+    pilot, sentinel = _pilot(**cfg)
+    sentinel.incidents.extend([_incident(), _incident()])
+    pilot.tick(None, step=10, loss=1.0)
+    assert pilot.report()["canary_active"]
+    return pilot
+
+
+def test_canary_commits_on_loss_parity():
+    pilot = _demoted_pilot(canary_steps=3)
+    for s in range(11, 14):
+        pilot.tick(None, step=s, loss=1.0)
+    assert not pilot.report()["canary_active"]
+    assert pilot.decisions[-1]["verdict"] == "committed"
+    assert pilot.decisions[-1]["decision"] == "demote_precision"
+    assert pilot.ddp.impl.bucket_precisions(PLAN) == ["int8"]
+
+
+def test_canary_rolls_back_on_loss_regression():
+    pilot = _demoted_pilot(canary_steps=3)
+    for s in range(11, 14):
+        pilot.tick(None, step=s, loss=50.0)  # blows past canary_loss_factor
+    assert not pilot.report()["canary_active"]
+    assert pilot.decisions[-1]["verdict"] == "rolled_back"
+    assert pilot.decisions[-1]["decision"] == "rollback"
+    assert pilot.ddp.impl.bucket_precisions(PLAN) == ["f32"]
+
+
+def test_no_new_moves_during_probation():
+    pilot = _demoted_pilot(canary_steps=100)
+    pilot.sentinel.incidents.extend([_incident(), _incident()])
+    pilot.tick(None, step=11, loss=1.0)
+    assert len(pilot.decisions) == 1  # still just the canary entry
+
+
+# -- safety + stability re-promotion ------------------------------------------
+
+
+def test_health_reset_repromotes_immediately_without_canary():
+    health = FakeHealth(clean_streak=0)
+    pilot, _ = _pilot(ddp=FakeDdp(precisions=["int8"]), health=health)
+    pilot.tick(None, step=10, loss=1.0)
+    assert pilot.ddp.impl.bucket_precisions(PLAN) == ["f32"]
+    (row,) = pilot.decisions
+    assert row["decision"] == "repromote_precision"
+    assert row["reason"] == "autopilot:loss_spike"
+    assert row["verdict"] == "committed"  # safety moves skip probation
+    assert not pilot.report()["canary_active"]
+
+
+def test_stabilized_repromotes_at_nominal_bandwidth_and_rearms():
+    health = FakeHealth(clean_streak=10**6)
+    pilot, _ = _pilot(ddp=FakeDdp(precisions=["int8"]), health=health)
+    pilot.tick(None, step=10, loss=1.0)
+    assert pilot.ddp.impl.bucket_precisions(PLAN) == ["f32"]
+    (row,) = pilot.decisions
+    assert row["decision"] == "repromote_precision"
+    assert row["reason"] == "autopilot:stabilized"
+    assert row["verdict"] == "canary"  # economic moves still ride probation
+    assert health.rearmed == 1
+
+
+def test_stabilized_is_quiet_when_already_cheapest():
+    pilot, _ = _pilot()  # already on gradient_allreduce/f32
+    pilot.tick(None, step=10, loss=1.0)
+    assert pilot.decisions == []
+
+
+# -- cooldown -----------------------------------------------------------------
+
+
+def test_cooldown_holds_and_records_the_hold():
+    pilot = _demoted_pilot(canary_steps=3, cooldown_steps=100)
+    for s in range(11, 14):
+        pilot.tick(None, step=s, loss=1.0)  # commit the canary
+    pilot.sentinel.incidents.extend([_incident("tr-c"), _incident("tr-d")])
+    pilot.tick(None, step=20, loss=1.0)  # precision knob still cooling down
+    row = pilot.decisions[-1]
+    assert row["decision"] == "hold"
+    assert row["verdict"] == "held"
+    assert row["trace_id"] == "tr-d"
+    assert len(pilot.ddp.precision_applies) == 1  # no second dispatch
+
+
+def test_repromotion_respects_cooldown():
+    pilot, _ = _pilot(ddp=FakeDdp(precisions=["int8"]), cooldown_steps=100)
+    pilot._start_cooldown(0, ("precision",))
+    pilot.tick(None, step=10, loss=1.0)
+    assert pilot.decisions == []
+    assert pilot.ddp.impl.bucket_precisions(PLAN) == ["int8"]
+
+
+# -- verifier rejection -------------------------------------------------------
+
+
+def test_verifier_rejection_is_counted_recorded_not_dispatched():
+    pilot, sentinel = _pilot()
+    sentinel.incidents.extend([_incident(), _incident()])
+    pilot.ddp.fail_next = True
+    pilot.tick(None, step=10, loss=1.0)
+    assert pilot.verifier_rejections == 1
+    row = pilot.decisions[-1]
+    assert row["verdict"] == "rejected"
+    assert pilot.ddp.impl.bucket_precisions(PLAN) == ["f32"]
+    assert not pilot.report()["canary_active"]
+    assert validate_metrics_event(row) == []
+
+
+# -- evidence plumbing --------------------------------------------------------
+
+
+def test_incident_consumption_is_nondestructive():
+    pilot, sentinel = _pilot()
+    sentinel.incidents.extend([_incident(), _incident()])
+    pilot.tick(None, step=10, loss=1.0)
+    # the fleet push's drain_incidents() still sees every incident
+    assert len(sentinel.incidents) == 2
+    pilot.tick(None, step=11, loss=1.0)
+    assert len(pilot._wire_evidence) == 0  # but nothing is double-counted
+
+
+def test_drain_decisions_is_incremental():
+    pilot = _demoted_pilot()
+    first = pilot.drain_decisions()
+    assert [r["decision"] for r in first] == ["demote_precision"]
+    assert pilot.drain_decisions() == []
+    assert len(pilot.decisions) == 1  # the full history stays queryable
+
+
+def test_every_decision_row_validates_and_cites():
+    pilot = _demoted_pilot(canary_steps=3)
+    for s in range(11, 14):
+        pilot.tick(None, step=s, loss=1.0)
+    assert len(pilot.decisions) == 2
+    for row in pilot.decisions:
+        assert validate_metrics_event(row) == []
+        assert row["event"] == "plan_decision"
+        assert row["trace_id"]  # incident-driven: the citation is mandatory
+        assert row["plan_version"] == pilot.ddp.plan_version
+
+
+def test_sentinel_plan_version_follows_the_engine():
+    pilot = _demoted_pilot()
+    assert pilot.sentinel.plan_version == pilot.ddp.plan_version == 1
+
+
+def test_repromotion_quarantined_after_recent_wire_incident():
+    pilot, sentinel = _pilot(
+        ddp=FakeDdp(precisions=["int8"]), repromote_windows=30
+    )
+    pilot._last_wire_step = 90
+    pilot.tick(None, step=100, loss=1.0)
+    assert pilot.decisions == []  # only 10 steps since the incident
+    pilot.tick(None, step=120, loss=1.0)
+    assert pilot.decisions[-1]["decision"] == "repromote_precision"
+
+
+def test_applied_switch_rebaselines_the_sentinel():
+    calls = []
+    pilot, sentinel = _pilot()
+    sentinel.rebaseline = lambda wire_ms=None: calls.append(wire_ms)
+    sentinel.incidents.extend([_incident(), _incident()])
+    pilot.tick(None, step=10, loss=1.0)
+    # the budget's wire expectation is re-priced to the adopted (int8)
+    # configuration's modeled wire at nominal bandwidth
+    from bagua_tpu.autopilot import wire_ms as model_wire
+    (priced,) = calls
+    assert priced == pytest.approx(model_wire(
+        COST_MODEL, PLAN, 8, Configuration(precision="int8")
+    ))

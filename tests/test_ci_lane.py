@@ -48,6 +48,7 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert "fleet sim lane passed" in proc.stderr
     assert "fleet load lane passed" in proc.stderr
     assert "regression attribution lane passed" in proc.stderr
+    assert "autopilot lane passed" in proc.stderr
 
     # The telemetry smoke emits a JSONL metrics stream next to --out; hold it
     # to the event schema here too (belt and braces: the subprocess already
@@ -262,6 +263,39 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert "bagua_step_budget_compile_ms" in reg_prom
     assert "bagua_step_budget_wire_slowdown_ms" in reg_prom
     assert "bagua_step_budget_unattributed_ms" in reg_prom
+
+    # The gang-autopilot lane's artifact: under the fleetsim bandwidth
+    # collapse the controller demoted to the α–β-cheapest healthy config
+    # (modeled strictly below stay-put), committed via canary loss-parity,
+    # and re-promoted to f32 after recovery + quarantine — the closed loop,
+    # both directions, with zero strict-verifier rejections dispatched.
+    ap = audit["autopilot"]
+    assert ap["ok"] is True
+    assert ap["verifier_rejections"] == 0
+    assert ap["demote_modeled"]["chosen_ms"] < ap["demote_modeled"]["stay_ms"]
+    assert ap["demote_modeled"]["bandwidth_factor"] > 1.0
+    assert ap["repromote_modeled"]["chosen_ms"] < ap["repromote_modeled"]["stay_ms"]
+    assert ap["repromote_modeled"]["bandwidth_factor"] == 1.0
+    # ordering: demote -> commit -> (recovery + quarantine) -> repromote -> commit
+    assert (ap["demote_step"] < ap["demote_commit_step"]
+            < ap["repromote_step"] < ap["repromote_commit_step"])
+    assert ap["final_configuration"] == {
+        "algorithm": "gradient_allreduce", "precision": "f32"}
+    assert ap["wire_incidents"] >= 1 and ap["loss_spike_alerts"] >= 1
+    assert ap["scheduler_autopilot"]["decision"] == "repromote_precision"
+    assert ap["scheduler_autopilot"]["verdict"] == "committed"
+    # the lane's own JSONL stream validated, with the decisions present
+    ap_metrics = str(out) + "_autopilot_metrics.jsonl"
+    assert os.path.exists(ap_metrics), "autopilot lane did not emit metrics"
+    assert validate_metrics_file(ap_metrics) == []
+    with open(ap_metrics) as f:
+        apev = [json.loads(line) for line in f if line.strip()]
+    decisions = [e for e in apev if e["event"] == "plan_decision"]
+    assert len(decisions) == ap["decisions"]
+    assert {d["decision"] for d in decisions} >= {
+        "demote_precision", "repromote_precision"}
+    inc_traces = {e["trace_id"] for e in apev if e["event"] == "perf_regression"}
+    assert all(d["trace_id"] in inc_traces for d in decisions), decisions
 
 
 def test_perf_audit_quick_bytegrad_compressed_census(tmp_path):

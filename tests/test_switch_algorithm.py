@@ -1,0 +1,351 @@
+"""Mid-training algorithm/precision switching (``ddp.switch_algorithm`` /
+``apply_precision_plan``): bitwise continuation, static-verify gating,
+and configuration carry-over through snapshots.
+
+The continuation contract: after a switch at step K, the trajectory is
+identical to a *fresh engine of the final configuration* warm-started from
+the switch-point state — the value-preserving state remap leaves nothing
+behind that the fresh engine wouldn't also have.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.ddp import DistributedDataParallel
+from bagua_tpu.models.mlp import init_mlp, mse_loss
+from bagua_tpu.sharded import ZeroAlgorithm
+
+N = 8
+LAYERS = [10, 16, 4]
+STEPS = 8
+SWITCH_AT = 3
+
+
+def _batches(steps=STEPS, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (jnp.asarray(rng.randn(16, LAYERS[0]), np.float32),
+         jnp.asarray(rng.randn(16, LAYERS[-1]), np.float32))
+        for _ in range(steps)
+    ]
+
+
+def _make(group, algo, overlap=True, **kwargs):
+    return DistributedDataParallel(
+        mse_loss, optax.adam(1e-2), algo, process_group=group,
+        bucket_size_bytes=1 << 9, overlap=overlap, **kwargs,
+    )
+
+
+def _fork(state):
+    """A deep on-device copy: train_step donates its input buffers, so two
+    engines continuing from the same state each need their own."""
+    return jax.tree.map(jnp.copy, state)
+
+
+def _params_equal(a_state, b_state):
+    for a, b in zip(jax.tree.leaves(jax.tree.map(np.asarray, a_state.params)),
+                    jax.tree.leaves(jax.tree.map(np.asarray, b_state.params))):
+        np.testing.assert_array_equal(a, b)
+
+
+def _ranks_synchronized(state):
+    for leaf in jax.tree.leaves(jax.tree.map(np.asarray, state.params)):
+        for r in range(1, N):
+            np.testing.assert_array_equal(leaf[0], leaf[r])
+
+
+# -- gar -> zero -> gar under overlap ----------------------------------------
+
+
+def test_switch_gar_zero_gar_losses_match_uninterrupted(group):
+    """The round trip: gradient_allreduce -> zero -> gradient_allreduce
+    mid-training with overlap on.  Each leg's loss curve is identical to an
+    uninterrupted run of that leg's configuration warm-started from the
+    switch-point state (the fresh-final-engine contract), and the ranks
+    stay synchronized throughout."""
+    batches = _batches()
+    ddp = _make(group, GradientAllReduceAlgorithm())
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    losses = []
+    for b in batches[:SWITCH_AT]:
+        state, l = ddp.train_step(state, b)
+        losses.append(float(np.asarray(l).mean()))
+
+    state = ddp.switch_algorithm(state, "zero", reason="manual")
+    assert ddp.impl.algo_name == "zero"
+    assert ddp._plan_source == "manual"
+
+    # fresh zero engine warm-started from the switch point: the reference
+    # the continuation must be bitwise against
+    ref = _make(group, ZeroAlgorithm())
+    ref.init(init_mlp(jax.random.PRNGKey(0), LAYERS))  # binds the template
+    ref.adopt_plan_payload(ddp.export_plan_payload())
+    ref.clear_pending_reshard()
+    ref_state = _fork(state)
+
+    for b in batches[SWITCH_AT:6]:
+        state, l = ddp.train_step(state, b)
+        losses.append(float(np.asarray(l).mean()))
+        ref_state, rl = ref.train_step(ref_state, b)
+        np.testing.assert_array_equal(np.asarray(l), np.asarray(rl))
+    _params_equal(ddp.finalize_pending_updates(state),
+                  ref.finalize_pending_updates(ref_state))
+
+    state = ddp.switch_algorithm(state, "gradient_allreduce", reason="manual")
+    assert ddp.impl.algo_name == "gradient_allreduce"
+    for b in batches[6:]:
+        state, l = ddp.train_step(state, b)
+        losses.append(float(np.asarray(l).mean()))
+    assert len(losses) == STEPS and all(np.isfinite(losses))
+    assert int(np.asarray(state.step)[0]) == STEPS
+    _ranks_synchronized(state)
+    ref.shutdown()
+    ddp.shutdown()
+
+
+def test_switch_to_zero_continuation_bitwise(group):
+    """gar -> zero at step K: the continued trajectory is bitwise-identical,
+    step by step, to a fresh zero engine fed the same post-switch state —
+    the optimizer-state scatter and the pending-shard seeding are
+    value-level no-ops."""
+    batches = _batches(seed=3)
+    ddp = _make(group, GradientAllReduceAlgorithm())
+    state = ddp.init(init_mlp(jax.random.PRNGKey(1), LAYERS))
+    for b in batches[:SWITCH_AT]:
+        state, _ = ddp.train_step(state, b)
+    state = ddp.switch_algorithm(state, "zero", reason="manual")
+
+    fresh = _make(group, ZeroAlgorithm())
+    fresh.init(init_mlp(jax.random.PRNGKey(1), LAYERS))
+    assert fresh.adopt_plan_payload(ddp.export_plan_payload())
+    fresh.clear_pending_reshard()
+    fresh_state = _fork(state)
+    for b in batches[SWITCH_AT:]:
+        state, l = ddp.train_step(state, b)
+        fresh_state, fl = fresh.train_step(fresh_state, b)
+        np.testing.assert_array_equal(np.asarray(l), np.asarray(fl))
+    _params_equal(ddp.finalize_pending_updates(state),
+                  fresh.finalize_pending_updates(fresh_state))
+    fresh.shutdown()
+    ddp.shutdown()
+
+
+def test_switch_from_zero_drains_pending(group):
+    """zero -> gar: the deferred all-gather pending at the switch point is
+    finalized into the params before the remap, so the gar engine starts
+    from exactly the parameters the zero engine would have gathered."""
+    batches = _batches(seed=4)
+    ddp = _make(group, ZeroAlgorithm())
+    state = ddp.init(init_mlp(jax.random.PRNGKey(2), LAYERS))
+    for b in batches[:SWITCH_AT]:
+        state, _ = ddp.train_step(state, b)
+    expect = ddp.finalize_pending_updates(state)
+    state = ddp.switch_algorithm(state, "gradient_allreduce", reason="manual")
+    _params_equal(state, expect)
+    for b in batches[SWITCH_AT:]:
+        state, l = ddp.train_step(state, b)
+    assert np.isfinite(np.asarray(l)).all()
+    _ranks_synchronized(state)
+    ddp.shutdown()
+
+
+# -- precision round trip under overlap --------------------------------------
+
+
+def test_precision_f32_int8_f32_continuation(group):
+    """f32 -> int8 -> f32 mid-training (wire_precision="auto", overlap on):
+    after the final switch back, the loss curve is bitwise-identical to a
+    fresh auto engine warm-started from the switch-point state with the
+    same adopted precision plan."""
+    batches = _batches(seed=5)
+    ddp = _make(group, GradientAllReduceAlgorithm(wire_precision="auto"), overlap="auto")
+    state = ddp.init(init_mlp(jax.random.PRNGKey(3), LAYERS))
+    for b in batches[:SWITCH_AT]:
+        state, _ = ddp.train_step(state, b)
+    nb = ddp.plan.num_buckets
+    assert ddp.apply_precision_plan(["int8"] * nb, reason="manual")
+    for b in batches[SWITCH_AT:6]:
+        state, l = ddp.train_step(state, b)
+    assert np.isfinite(np.asarray(l)).all()
+    assert ddp.apply_precision_plan(["f32"] * nb, reason="manual")
+
+    fresh = _make(group, GradientAllReduceAlgorithm(wire_precision="auto"), overlap="auto")
+    fresh.init(init_mlp(jax.random.PRNGKey(3), LAYERS))
+    assert fresh.adopt_plan_payload(ddp.export_plan_payload())
+    fresh.clear_pending_reshard()
+    fresh_state = _fork(state)
+    for b in batches[6:]:
+        state, l = ddp.train_step(state, b)
+        fresh_state, fl = fresh.train_step(fresh_state, b)
+        np.testing.assert_array_equal(np.asarray(l), np.asarray(fl))
+    _params_equal(state, fresh_state)
+    _ranks_synchronized(state)
+    fresh.shutdown()
+    ddp.shutdown()
+
+
+# -- guard rails ---------------------------------------------------------------
+
+
+def test_switch_algorithm_guards(group):
+    ddp = _make(group, GradientAllReduceAlgorithm())
+    state = ddp.init(init_mlp(jax.random.PRNGKey(4), LAYERS))
+    state, _ = ddp.train_step(state, _batches(1)[0])
+
+    with pytest.raises(ValueError, match="consensus"):
+        ddp.switch_algorithm(state, "decentralized", reason="manual")
+    with pytest.raises(ValueError, match="supported targets"):
+        ddp.switch_algorithm(state, "nonexistent_algo", reason="manual")
+    with pytest.raises(ValueError, match="reason"):
+        ddp.switch_algorithm(state, "zero", reason="operator")
+
+    # same-algorithm switch is a no-op: same state object, no version bump
+    pv = ddp.plan_version
+    out = ddp.switch_algorithm(state, "gradient_allreduce", reason="manual")
+    assert out is state and ddp.plan_version == pv
+    ddp.shutdown()
+
+
+def test_switch_rejected_by_strict_verifier_rolls_back(group, monkeypatch):
+    """A strict-verify rejection surfaces as an exception and leaves the
+    engine on its previous configuration — plan version bumped (uniqueness)
+    but the algorithm, plan and updater are the pre-switch ones, and the
+    caller's state keeps stepping."""
+    ddp = _make(group, GradientAllReduceAlgorithm())
+    state = ddp.init(init_mlp(jax.random.PRNGKey(5), LAYERS))
+    state, _ = ddp.train_step(state, _batches(1)[0])
+    old_plan = ddp.plan
+
+    def boom(reason):
+        raise RuntimeError("static verifier rejected the switch program")
+
+    monkeypatch.setattr(ddp, "_static_reverify", boom)
+    with pytest.raises(RuntimeError, match="rejected"):
+        ddp.switch_algorithm(state, "zero", reason="manual")
+    monkeypatch.undo()
+    assert ddp.impl.algo_name == "gradient_allreduce"
+    assert ddp.plan is old_plan
+    assert ddp._sharded_updater is None
+    state, l = ddp.train_step(state, _batches(2, seed=9)[1])
+    assert np.isfinite(np.asarray(l)).all()
+    ddp.shutdown()
+
+
+# -- snapshot / elastic-resume carry-over -------------------------------------
+
+
+@pytest.fixture()
+def _no_persistent_compile_cache():
+    """The bitwise-continuation assertion compares two engines compiling the
+    same step program in one process.  With the persistent compilation cache
+    on, the second engine deserializes the entry the first one just wrote,
+    and on the CPU backend that roundtrip is not execution-faithful (observed:
+    1-ULP loss drift, and intermittent heap corruption inside dispatch).
+    Compile both in-process instead."""
+    from jax._src import compilation_cache as _cc
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    _cc.reset_cache()  # the used/not-used decision is latched in globals
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+    _cc.reset_cache()
+
+
+def test_snapshot_resume_carries_autopilot_config(
+    group, tmp_path, _no_persistent_compile_cache
+):
+    """An autopilot-chosen configuration rides the snapshot manifest:
+    resume re-adopts the plan, re-applies the adopted precision, reports
+    ``plan_source="autopilot"``, and the restored trajectory continues
+    bitwise."""
+    from bagua_tpu.resilience import AsyncSnapshotter, ElasticResumeCoordinator
+
+    batches = _batches(seed=6)
+    ddp = _make(group, GradientAllReduceAlgorithm(wire_precision="auto"), overlap="auto")
+    state = ddp.init(init_mlp(jax.random.PRNGKey(6), LAYERS))
+    for b in batches[:SWITCH_AT]:
+        state, _ = ddp.train_step(state, b)
+    ddp.apply_precision_plan(
+        ["int8"] * ddp.plan.num_buckets, reason="autopilot:wire_slowdown"
+    )
+    assert ddp._plan_source == "autopilot"
+    payload = ddp.export_plan_payload()
+    assert payload["config"]["source"] == "autopilot"
+    assert payload["config"]["algorithm"] == "gradient_allreduce"
+    assert list(payload["config"]["bucket_precisions"]) == (
+        ["int8"] * ddp.plan.num_buckets
+    )
+    state, _ = ddp.train_step(state, batches[SWITCH_AT])
+
+    snap_dir = str(tmp_path / "autopilot_snap")
+    snap = AsyncSnapshotter(
+        snap_dir, every=1, world_size=group.size,
+        manifest_extra_fn=lambda: {"plan": ddp.export_plan_payload()},
+    )
+    snap.force_snapshot(state, SWITCH_AT + 1)
+    snap.close()
+
+    fresh = _make(group, GradientAllReduceAlgorithm(wire_precision="auto"), overlap="auto")
+    init = fresh.init(init_mlp(jax.random.PRNGKey(9), LAYERS))
+    res = ElasticResumeCoordinator(snap_dir).resume(fresh, init)
+    assert res is not None and res.step == SWITCH_AT + 1
+    assert res.plan_source == "autopilot"
+    assert fresh._plan_source == "autopilot"
+    assert list(fresh.impl.bucket_precisions(fresh.plan)) == (
+        ["int8"] * fresh.plan.num_buckets
+    )
+    # Run the two trajectories sequentially (not interleaved) so only one
+    # donating executable is live at a time, then compare the recorded losses.
+    expect = []
+    for b in batches[SWITCH_AT + 1:]:
+        state, l = ddp.train_step(state, b)
+        expect.append(np.asarray(l).copy())
+    rs = res.state
+    got = []
+    for b in batches[SWITCH_AT + 1:]:
+        rs, rl = fresh.train_step(rs, b)
+        got.append(np.asarray(rl).copy())
+    for l, rl in zip(expect, got):
+        np.testing.assert_array_equal(l, rl)
+    _params_equal(state, rs)
+    fresh.shutdown()
+    ddp.shutdown()
+
+
+def test_adopt_plan_payload_algorithm_mismatch(group):
+    """A snapshot taken under zero cannot be adopted by a gar engine — the
+    carried configuration names its algorithm and adoption refuses, telling
+    the operator to construct the engine to match."""
+    ddp = _make(group, ZeroAlgorithm())
+    state = ddp.init(init_mlp(jax.random.PRNGKey(7), LAYERS))
+    state, _ = ddp.train_step(state, _batches(1)[0])
+    payload = ddp.export_plan_payload()
+    assert payload["config"]["algorithm"] == "zero"
+
+    other = _make(group, GradientAllReduceAlgorithm())
+    with pytest.raises(ValueError, match="algorithm"):
+        other.adopt_plan_payload(payload)
+    other.shutdown()
+    ddp.shutdown()
+
+
+def test_reapplied_identical_precision_plan_is_noop(group):
+    """Satellite pin: re-applying the precision plan the engine is already
+    on returns False and bumps nothing — resume's re-apply path must not
+    recompile a gang that is already in the adopted configuration."""
+    ddp = _make(group, GradientAllReduceAlgorithm(wire_precision="auto"), overlap="auto")
+    state = ddp.init(init_mlp(jax.random.PRNGKey(8), LAYERS))
+    state, _ = ddp.train_step(state, _batches(1)[0])
+    nb = ddp.plan.num_buckets
+    assert ddp.apply_precision_plan(["int8"] * nb, reason="manual")
+    pv = ddp.plan_version
+    assert not ddp.apply_precision_plan(["int8"] * nb, reason="manual")
+    assert ddp.plan_version == pv
+    ddp.shutdown()

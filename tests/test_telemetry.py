@@ -536,7 +536,7 @@ def test_on_precision_switch_surfaces(tmp_path):
     )
     tel.on_precision_switch(
         step=9, plan_version=0, old_precisions=["int8", "f32", "int4"],
-        new_precisions=["int8", "int8", "int4"], reason="operator",
+        new_precisions=["int8", "int8", "int4"], reason="manual",
     )
     tel.close()
 
@@ -548,7 +548,7 @@ def test_on_precision_switch_surfaces(tmp_path):
     assert validate_metrics_file(path) == []
     events = [json.loads(l) for l in open(path) if l.strip()]
     sw = [e for e in events if e["event"] == "precision_switch"]
-    assert [e["reason"] for e in sw] == ["planner", "operator"]
+    assert [e["reason"] for e in sw] == ["planner", "manual"]
     assert sw[0]["old_precisions"] == ["f32", "f32", "f32"]
     assert sw[0]["new_precisions"] == ["int8", "f32", "int4"]
     assert sw[1]["step"] == 9
@@ -601,7 +601,7 @@ def test_precision_plan_switch_emits_telemetry_from_engine(group, tmp_path):
     nb = ddp.plan.num_buckets
     assert nb >= 2
     plan = ["int8"] + ["f32"] * (nb - 1)
-    assert ddp.apply_precision_plan(plan, reason="operator")
+    assert ddp.apply_precision_plan(plan, reason="manual")
     state, _ = ddp.train_step(state, batch)
     tel.close()
 
@@ -620,7 +620,7 @@ def test_precision_plan_switch_emits_telemetry_from_engine(group, tmp_path):
     events = [json.loads(l) for l in open(path) if l.strip()]
     (sw,) = [e for e in events if e["event"] == "precision_switch"]
     assert sw["old_precisions"] == ["f32"] * nb
-    assert sw["new_precisions"] == plan and sw["reason"] == "operator"
+    assert sw["new_precisions"] == plan and sw["reason"] == "manual"
     step_events = [e for e in events if e["event"] == "step"]
     assert "wire_bytes_by_precision" in step_events[-1]
     assert step_events[-1]["wire_bytes_by_precision"]["int8"] > 0

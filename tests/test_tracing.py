@@ -339,6 +339,53 @@ def test_chrome_trace_export_validates_and_links():
     assert any("unmatched flow" in p for p in validate_chrome_trace(broken))
 
 
+def test_chrome_trace_renders_plan_decision_instants(tmp_path):
+    """Autopilot ``plan_decision`` rows (and the ``perf_regression``
+    incidents they cite) load from a metrics JSONL and render as Perfetto
+    annotation instants — decision kind in the name, verdict + citing
+    trace_id in args — joinable on the shared trace_id."""
+    import sys as _sys
+    _sys.path.insert(0, "ci")
+    try:
+        from export_timeline import (
+            build_chrome_trace, load_metrics_incidents, validate_chrome_trace,
+        )
+    finally:
+        _sys.path.pop(0)
+    path = str(tmp_path / "metrics.jsonl")
+    tel = Telemetry(metrics_jsonl=path, flight=None)
+    tel.jsonl.emit({
+        "event": "perf_regression", "ts": 10.0, "step": 40,
+        "stream": "step_wall", "dominant": "wire_slowdown",
+        "components": {"wire_slowdown": 8.0}, "residual_ms": 8.0,
+        "expected_ms": 10.0, "measured_ms": 18.0, "plan_version": 3,
+        "trace_id": "lane-w3-s40",
+    })
+    tel.on_plan_decision(
+        step=43, decision="demote_precision", reason="autopilot:wire_slowdown",
+        trace_id="lane-w3-s40", plan_version=3,
+        from_config={"algorithm": "gradient_allreduce", "precision": "f32"},
+        to_config={"algorithm": "gradient_allreduce", "precision": "int8"},
+        verdict="canary", modeled={"stay_ms": 18.0, "chosen_ms": 12.0},
+    )
+    tel.close()
+    events = load_metrics_incidents(path)
+    assert [e["event"] for e in events] == ["perf_regression", "plan_decision"]
+    trace = build_chrome_trace([], events)
+    assert validate_chrome_trace(trace) == []
+    instants = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+    named = {e["name"]: e for e in instants}
+    assert set(named) == {
+        "perf_regression:wire_slowdown", "plan_decision:demote_precision"}
+    dec = named["plan_decision:demote_precision"]
+    assert dec["cat"] == "decision"
+    assert dec["args"]["verdict"] == "canary"
+    assert dec["args"]["to_config"]["precision"] == "int8"
+    # the join key: the decision cites the incident's trace_id
+    inc = named["perf_regression:wire_slowdown"]
+    assert dec["args"]["trace_id"] == inc["args"]["trace_id"]
+
+
 # -- the acceptance criterion: bitwise inert ----------------------------------
 
 
