@@ -272,17 +272,18 @@ class GangAutopilot:
         to = Configuration(**c["to_config"])
         if parity:
             self._record(step, c["decision"], c["reason"], frm, to,
-                         "committed", modeled=c.get("modeled"))
+                         "committed", modeled=c.get("modeled"),
+                         axis=c.get("axis"))
             return state
         try:
             state = self._apply(state, to, frm, c["reason"])
         except Exception as e:
             self._record(step, "rollback", c["reason"], to, frm, "rejected",
-                         error=e)
+                         error=e, axis=c.get("axis"))
             return state
         self._start_cooldown(step, self._knobs(frm, to))
         self._record(step, "rollback", c["reason"], to, frm, "rolled_back",
-                     modeled=c.get("modeled"))
+                     modeled=c.get("modeled"), axis=c.get("axis"))
         return state
 
     def _demote_on_wire_evidence(self, state, step: int):
@@ -295,6 +296,16 @@ class GangAutopilot:
             return None  # never chase goodput while the loss is misbehaving
         cur = self.current_configuration()
         factor = self._bandwidth_factor(incident)
+        # axis-scoped pricing: an incident that indicts a named mesh axis
+        # degrades only that axis's traffic.  When the indicted axis is not
+        # one the gradient exchange rides (group.data_axes), the candidate
+        # ranking cannot flip and the controller holds — demoting the dp
+        # wire precision does nothing for a tp/ICI brownout.
+        axis = incident.get("axis")
+        axis = str(axis) if axis else None
+        exchange_axes = tuple(
+            str(a) for a in (getattr(self.ddp.group, "data_axes", ()) or ()) if a
+        )
         candidates = candidate_configurations(cfg.algorithms, cfg.precisions)
         if cur not in candidates:
             candidates.append(cur)
@@ -307,6 +318,7 @@ class GangAutopilot:
             candidates, self._compute_ms(),
             hierarchical=bool(getattr(self.ddp.impl, "hierarchical", False)),
             bandwidth_factor=factor,
+            axis=axis, exchange_axes=exchange_axes,
         )
         stay = next(ms for c, ms in priced if c == cur)
         best, best_ms = priced[0]
@@ -317,7 +329,7 @@ class GangAutopilot:
         }
         if best == cur or best_ms > stay * (1.0 - cfg.min_saving_frac):
             self._record(step, "hold", reason, cur, cur, "held",
-                         trace_id=trace, modeled=modeled)
+                         trace_id=trace, modeled=modeled, axis=axis)
             return state
         decision = (
             "switch_algorithm" if best.algorithm != cur.algorithm
@@ -327,9 +339,10 @@ class GangAutopilot:
             state = self._apply(state, cur, best, reason)
         except Exception as e:
             self._record(step, decision, reason, cur, best, "rejected",
-                         trace_id=trace, modeled=modeled, error=e)
+                         trace_id=trace, modeled=modeled, error=e, axis=axis)
             return state
-        self._start_canary(step, decision, reason, cur, best, trace, modeled)
+        self._start_canary(step, decision, reason, cur, best, trace, modeled,
+                           axis=axis)
         return state
 
     def _repromote_on_stability(self, state, step: int):
@@ -413,7 +426,8 @@ class GangAutopilot:
                 ))
         return state
 
-    def _start_canary(self, step, decision, reason, frm, to, trace, modeled):
+    def _start_canary(self, step, decision, reason, frm, to, trace, modeled,
+                      axis: Optional[str] = None):
         self._canary = {
             "until_step": step + self.config.canary_steps,
             "pre_ewma": self._loss_ewma,
@@ -423,14 +437,16 @@ class GangAutopilot:
             "reason": reason,
             "trace_id": trace,
             "modeled": modeled,
+            "axis": axis,
         }
         self._start_cooldown(step, self._knobs(frm, to))
         self._record(step, decision, reason, frm, to, "canary",
-                     trace_id=trace, modeled=modeled)
+                     trace_id=trace, modeled=modeled, axis=axis)
 
     def _record(self, step, decision, reason, frm, to, verdict,
                 trace_id: Optional[str] = None, modeled: Optional[Dict] = None,
-                error: Optional[BaseException] = None) -> None:
+                error: Optional[BaseException] = None,
+                axis: Optional[str] = None) -> None:
         if trace_id is None:
             trace_id = (self._canary or {}).get("trace_id") or self._last_incident_trace
         row = {
@@ -445,6 +461,8 @@ class GangAutopilot:
             "to_config": to.as_dict(),
             "verdict": str(verdict),
         }
+        if axis:
+            row["axis"] = str(axis)
         if modeled:
             row["modeled"] = {k: round(float(v), 4) for k, v in modeled.items()}
         if error is not None:
@@ -464,5 +482,5 @@ class GangAutopilot:
                 step=int(step), decision=str(decision), reason=str(reason),
                 trace_id=str(trace_id or ""), plan_version=int(self.ddp.plan_version),
                 from_config=frm.as_dict(), to_config=to.as_dict(),
-                verdict=str(verdict), modeled=modeled,
+                verdict=str(verdict), modeled=modeled, axis=axis,
             )

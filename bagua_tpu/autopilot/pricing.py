@@ -86,28 +86,48 @@ def candidate_configurations(
     return out
 
 
-def degraded_cost_model(cost_model, bandwidth_factor: float = 1.0):
+def degraded_cost_model(cost_model, bandwidth_factor: float = 1.0,
+                        axis: Optional[str] = None,
+                        exchange_axes: Sequence[str] = ()):
     """``cost_model`` at ``bandwidth_factor`` times nominal wire cost: every
     recognizable α–β leg keeps its α and has its β divided by the factor.
     Returns the model unchanged at factor 1.0 or when no leg could be
-    scaled (the caller falls back to scaling the whole term)."""
+    scaled (the caller falls back to scaling the whole term — unless the
+    degradation was axis-scoped, see below).
+
+    ``axis`` scopes the collapse to one named mesh axis — the incident's
+    indicted axis.  When that axis is one of ``exchange_axes`` (the axes
+    the gradient exchange actually rides, ``group.data_axes``), the
+    exchange legs degrade exactly as in the uniform case — the relaxation
+    knobs *can* relieve the congested traffic, so the candidate ranking
+    may flip.  When the indicted axis is NOT an exchange axis (a tp/ICI
+    brownout under a dp-exchange gang), only that axis's ``axis_legs``
+    entry degrades: the exchange pricing is untouched at any factor, the
+    ranking cannot flip, and the controller correctly holds — demoting the
+    dp wire precision does nothing for a tp collapse."""
     f = max(1e-6, float(bandwidth_factor))
     if abs(f - 1.0) < 1e-9:
         return cost_model
+    axis_scoped = axis is not None
+    degrade_exchange = (not axis_scoped) or axis in tuple(exchange_axes)
     degraded = copy.copy(cost_model)
     scaled = False
-    for leg in _COST_MODEL_LEGS:
-        ab = getattr(cost_model, leg, None)
-        if ab is not None and dataclasses.is_dataclass(ab) and hasattr(ab, "beta"):
-            setattr(degraded, leg, dataclasses.replace(ab, beta=ab.beta / f))
-            scaled = True
+    if degrade_exchange:
+        for leg in _COST_MODEL_LEGS:
+            ab = getattr(cost_model, leg, None)
+            if ab is not None and dataclasses.is_dataclass(ab) and hasattr(ab, "beta"):
+                setattr(degraded, leg, dataclasses.replace(ab, beta=ab.beta / f))
+                scaled = True
     axis_legs = getattr(cost_model, "axis_legs", None)
     if isinstance(axis_legs, dict):
-        degraded.axis_legs = {
-            ax: (dataclasses.replace(ab, beta=ab.beta / f)
-                 if dataclasses.is_dataclass(ab) and hasattr(ab, "beta") else ab)
-            for ax, ab in axis_legs.items()
-        }
+        degraded.axis_legs = {}
+        for ax, ab in axis_legs.items():
+            hit = (ax == axis) if axis_scoped else True
+            if hit and dataclasses.is_dataclass(ab) and hasattr(ab, "beta"):
+                degraded.axis_legs[ax] = dataclasses.replace(ab, beta=ab.beta / f)
+                scaled = True
+            else:
+                degraded.axis_legs[ax] = ab
     return degraded if scaled else cost_model
 
 
@@ -118,12 +138,21 @@ def wire_ms(
     config: Configuration,
     hierarchical: bool = False,
     bandwidth_factor: float = 1.0,
+    axis: Optional[str] = None,
+    exchange_axes: Sequence[str] = (),
 ) -> float:
     """Modeled per-step wire milliseconds of ``config`` on ``plan``'s
     buckets, at ``bandwidth_factor`` times nominal wire cost (β-degraded
-    when the model exposes α–β legs, uniformly scaled otherwise)."""
-    degraded = degraded_cost_model(cost_model, bandwidth_factor)
-    uniform = degraded is cost_model and float(bandwidth_factor) != 1.0
+    when the model exposes α–β legs, uniformly scaled otherwise).  With
+    ``axis``, the degradation is scoped to the indicted axis's legs
+    (see :func:`degraded_cost_model`) — a collapse on a non-exchange axis
+    leaves the exchange pricing untouched, and the whole-term uniform
+    fallback is suppressed (it would smear the collapse over traffic that
+    never rides the indicted axis)."""
+    degraded = degraded_cost_model(cost_model, bandwidth_factor,
+                                   axis=axis, exchange_axes=exchange_axes)
+    uniform = (degraded is cost_model and float(bandwidth_factor) != 1.0
+               and axis is None)
     cost_model = degraded
     total = 0.0
     for spec in plan.specs:
@@ -153,6 +182,8 @@ def modeled_step_ms(
     compute_ms: float,
     hierarchical: bool = False,
     bandwidth_factor: float = 1.0,
+    axis: Optional[str] = None,
+    exchange_axes: Sequence[str] = (),
 ) -> float:
     """``compute + wire`` — the BENCH_MODELED-style whole-step prediction
     decisions are ranked on (overlap hides part of the wire in practice;
@@ -161,6 +192,7 @@ def modeled_step_ms(
     return float(compute_ms) + wire_ms(
         cost_model, plan, n_ranks, config,
         hierarchical=hierarchical, bandwidth_factor=bandwidth_factor,
+        axis=axis, exchange_axes=exchange_axes,
     )
 
 
@@ -172,6 +204,8 @@ def price_configurations(
     compute_ms: float,
     hierarchical: bool = False,
     bandwidth_factor: float = 1.0,
+    axis: Optional[str] = None,
+    exchange_axes: Sequence[str] = (),
 ) -> List[Tuple[Configuration, float]]:
     """Every candidate with its modeled step-ms, cheapest first."""
     priced = [
@@ -180,6 +214,7 @@ def price_configurations(
             modeled_step_ms(
                 cost_model, plan, n_ranks, cfg, compute_ms,
                 hierarchical=hierarchical, bandwidth_factor=bandwidth_factor,
+                axis=axis, exchange_axes=exchange_axes,
             ),
         )
         for cfg in candidates

@@ -1246,6 +1246,27 @@ class DistributedDataParallel:
             wire_by_precision = None
             if self.plan is not None and hasattr(self.impl, "wire_bytes_by_precision"):
                 wire_by_precision = self.impl.wire_bytes_by_precision(self.plan)
+            wire_by_axis = None
+            if self.plan is not None and getattr(self.group, "mesh_spec", None) is not None:
+                # Per-axis byte census on a named mesh: join the variant's
+                # captured flight program (records carry the exchange axes)
+                # against its bytes — joint multi-axis exchanges split
+                # evenly — falling back to the plan census spread over the
+                # group's data axes when no program was captured yet.
+                by_axis = {}
+                for rec in self._flight_programs.get(variant) or ():
+                    axes = [a for a in (rec.get("axes") or ()) if a]
+                    if not axes:
+                        continue
+                    share = int(rec.get("nbytes") or 0) // len(axes)
+                    for ax in axes:
+                        by_axis[ax] = by_axis.get(ax, 0) + share
+                if not by_axis:
+                    axes = [a for a in self.group.data_axes if a]
+                    if axes:
+                        share = self.plan.total_bytes() // len(axes)
+                        by_axis = {ax: share for ax in axes}
+                wire_by_axis = by_axis or None
             tel.on_step(
                 step=self._host_step - 1,
                 wall_s=wall,
@@ -1255,6 +1276,7 @@ class DistributedDataParallel:
                 host_overhead=step_ov,
                 wire_bytes_by_leg=wire_by_leg,
                 wire_bytes_by_precision=wire_by_precision,
+                wire_bytes_by_axis=wire_by_axis,
             )
         if self.health_monitor is not None and len(out) == 3:
             loss_mean, gn_max, nonfinite = self._read_health(out[2])

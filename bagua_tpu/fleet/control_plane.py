@@ -575,7 +575,12 @@ class FleetControlPlane:
                 "incidents": len(incidents),
                 "last_incident": (
                     {"step": last.get("step"), "dominant": last.get("dominant"),
-                     "stream": last.get("stream")}
+                     "stream": last.get("stream"),
+                     # axis-resolved incidents name the mesh axis and link
+                     # class (ici/dcn) the sentinel indicted
+                     **({"axis": last["axis"]} if last.get("axis") else {}),
+                     **({"link_class": last["link_class"]}
+                        if last.get("link_class") else {})}
                     if isinstance(last, dict) else None
                 ),
                 # what the gang's autopilot last did about its incidents —
@@ -584,7 +589,9 @@ class FleetControlPlane:
                     {"decision": last_dec.get("decision"),
                      "verdict": last_dec.get("verdict"),
                      "step": last_dec.get("step"),
-                     "to_config": last_dec.get("to_config")}
+                     "to_config": last_dec.get("to_config"),
+                     **({"axis": last_dec["axis"]}
+                        if last_dec.get("axis") else {})}
                     if isinstance(last_dec, dict) else None
                 ),
                 "decisions": len(decisions),

@@ -47,6 +47,7 @@ __all__ = [
     "BUDGET_COMPONENTS",
     "BudgetModel",
     "StepBudget",
+    "priced_axis_wire_ms",
 ]
 
 #: every attribution component, in report order; ``unattributed`` is always
@@ -62,11 +63,36 @@ BUDGET_COMPONENTS = (
 )
 
 
+def priced_axis_wire_ms(cost_model, program) -> Dict[str, float]:
+    """Join one step's flight/IR program against the planner's per-axis α–β
+    legs: every record carrying ``axes`` (stamped by ``annotate()`` and
+    mirrored by ``predict_flight_program``) is priced on each axis's fitted
+    leg — :meth:`~bagua_tpu.service.planner.CostModel.axis_leg` falls back
+    to the ``flat`` leg on legacy 1-D meshes — with a joint multi-axis
+    exchange's bytes split evenly across its axes.  Returns ``{axis: ms}``
+    (empty when no record carries axes)."""
+    out: Dict[str, float] = {}
+    if cost_model is None:
+        return out
+    for rec in program or ():
+        axes = [a for a in (rec.get("axes") or ()) if a]
+        nbytes = float(rec.get("nbytes") or 0.0)
+        if not axes or nbytes <= 0:
+            continue
+        share = nbytes / len(axes)
+        for ax in axes:
+            out[ax] = out.get(ax, 0.0) + cost_model.axis_leg(ax).predict(share) * 1e3
+    return out
+
+
 @dataclasses.dataclass
 class StepBudget:
     """One settled step: measured vs expected wall and the named partition
     of the difference.  ``components`` carries every name in
-    :data:`BUDGET_COMPONENTS` and sums to ``residual_ms`` exactly."""
+    :data:`BUDGET_COMPONENTS` and sums to ``residual_ms`` exactly.
+    ``wire_axis_ms`` splits ``components["wire_slowdown"]`` by mesh axis —
+    the sub-partition sums to the component exactly (same construction:
+    the component IS the sum) and is empty on axis-blind meshes."""
 
     step: int
     measured_ms: float
@@ -76,14 +102,26 @@ class StepBudget:
     dominant: str = ""
     calibrated: bool = False
     straggler_rank: int = -1
+    wire_axis_ms: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def partition_error_ms(self) -> float:
         """|sum(components) − residual| — zero up to float rounding."""
         return abs(sum(self.components.values()) - self.residual_ms)
 
+    def axis_partition_error_ms(self) -> float:
+        """|sum(wire_axis_ms) − wire_slowdown| — exactly zero when the axis
+        split exists (the component is constructed as the sum)."""
+        if not self.wire_axis_ms:
+            return 0.0
+        return abs(sum(self.wire_axis_ms[ax] for ax in sorted(self.wire_axis_ms))
+                   - self.components.get("wire_slowdown", 0.0))
+
     def payload(self) -> Dict:
         out = dataclasses.asdict(self)
         out["components"] = {k: round(v, 4) for k, v in self.components.items()}
+        out["wire_axis_ms"] = {
+            k: round(v, 4) for k, v in sorted(self.wire_axis_ms.items())
+        }
         for key in ("measured_ms", "expected_ms", "residual_ms"):
             out[key] = round(out[key], 4)
         return out
@@ -121,12 +159,26 @@ class BudgetModel:
         hierarchical: bool = False,
         wire_pattern: str = "allreduce",
         calibrate_steps: int = 20,
+        axis_wire_ms: Optional[Dict[str, float]] = None,
+        program=None,
     ):
         self.compute_ms = None if compute_ms is None else float(compute_ms)
         self.overlap_frac = min(1.0, max(0.0, float(overlap_frac)))
         self.cost_model = cost_model
         self.hierarchical = bool(hierarchical)
         self.wire_pattern = str(wire_pattern)
+        # per-axis expected wire: given directly, or joined from the step's
+        # flight/IR program (records carry ``axes``) against ``axis_legs``
+        if axis_wire_ms is None and program is not None:
+            axis_wire_ms = priced_axis_wire_ms(cost_model, program) or None
+        self.axis_wire_ms: Dict[str, float] = {
+            str(k): float(v) for k, v in (axis_wire_ms or {}).items()
+        }
+        if wire_ms is None and self.axis_wire_ms:
+            # the axis-priced ledger IS the wire expectation: the scalar is
+            # its sum, so the per-axis split partitions it by construction
+            wire_ms = sum(self.axis_wire_ms[ax]
+                          for ax in sorted(self.axis_wire_ms))
         if wire_ms is None and cost_model is not None and bucket_bytes:
             from bagua_tpu.observability.goodput import predicted_wire_time
 
@@ -139,6 +191,7 @@ class BudgetModel:
         self._wall_samples = []
         self._host_samples = []
         self._bytes_samples = []
+        self._axis_bytes_samples: Dict[str, list] = {}
         # per-step evidence, cleared on settle
         self._compile_ms = 0.0
         self._snapshot_ms = 0.0
@@ -146,6 +199,7 @@ class BudgetModel:
         self._straggler_ms = 0.0
         self._straggler_rank = -1
         self._measured_wire_ms: Optional[float] = None
+        self._measured_wire_axis_ms: Optional[Dict[str, float]] = None
 
     @classmethod
     def from_meter(cls, meter, compute_ms: Optional[float] = None,
@@ -153,15 +207,22 @@ class BudgetModel:
                    ) -> "BudgetModel":
         """Price the wire from an attached
         :class:`~bagua_tpu.observability.goodput.GoodputMeter` (its fitted
-        cost model + live bucket plan); compute stays self-calibrated
+        cost model + live bucket plan, routed through the per-axis legs
+        when the plan rides a named mesh); compute stays self-calibrated
         unless supplied."""
         wire_s = meter.predicted_wire_s() if meter is not None else None
+        by_axis = (meter.predicted_wire_by_axis_s()
+                   if meter is not None
+                   and hasattr(meter, "predicted_wire_by_axis_s") else None)
         return cls(
             compute_ms=compute_ms,
             wire_ms=None if wire_s is None else wire_s * 1e3,
             overlap_frac=overlap_frac,
             cost_model=getattr(meter, "cost_model", None),
             calibrate_steps=calibrate_steps,
+            axis_wire_ms=(
+                {ax: s * 1e3 for ax, s in by_axis.items()} if by_axis else None
+            ),
         )
 
     # -- per-step evidence hooks (cleared at settle) --------------------------
@@ -184,10 +245,17 @@ class BudgetModel:
         self._straggler_ms = max(self._straggler_ms, max(0.0, float(excess_ms)))
         self._straggler_rank = int(rank)
 
-    def note_wire(self, measured_wire_ms: float) -> None:
+    def note_wire(self, measured_wire_ms: float,
+                  by_axis: Optional[Dict[str, float]] = None) -> None:
         """A measured per-step wire time (trace analysis ``collective_ms``
-        or flight-recorder enqueue→retire deltas)."""
+        or flight-recorder enqueue→retire deltas).  ``by_axis`` optionally
+        splits the measurement by mesh axis (per-axis enqueue→retire
+        deltas) — the strongest evidence for the per-axis ledger."""
         self._measured_wire_ms = max(0.0, float(measured_wire_ms))
+        if by_axis:
+            self._measured_wire_axis_ms = {
+                str(k): max(0.0, float(v)) for k, v in by_axis.items()
+            }
 
     # -- pricing helpers ------------------------------------------------------
 
@@ -215,23 +283,89 @@ class BudgetModel:
                 wire_pattern=self.wire_pattern) * 1e3
         return None
 
-    def _wire_slowdown_ms(self, wire_bytes: Optional[float]) -> float:
-        # measured wire beyond the α–β promise wins when a measurement exists
-        if self._measured_wire_ms is not None and self.wire_ms is not None:
-            return max(0.0, self._measured_wire_ms - self.wire_ms)
-        # otherwise, price the byte inflation: census bytes over baseline
-        if wire_bytes is None or not self._bytes_samples:
+    def _price_axis_bytes_ms(self, axis: str, nbytes: float) -> Optional[float]:
+        if nbytes <= 0:
             return 0.0
+        if self.cost_model is not None and hasattr(self.cost_model, "axis_leg"):
+            return self.cost_model.axis_leg(axis).predict(float(nbytes)) * 1e3
+        return None
+
+    def _split_by_axis_share(self, total: float) -> Dict[str, float]:
+        """Partition a scalar slowdown over the priced per-axis expectations,
+        proportionally by expected share — the last axis takes the exact
+        remainder so the parts sum bitwise to ``total``."""
+        axes = sorted(self.axis_wire_ms)
+        weight = sum(self.axis_wire_ms[ax] for ax in axes)
+        if not axes or weight <= 0:
+            return {}
+        parts: Dict[str, float] = {}
+        assigned = 0.0
+        for ax in axes[:-1]:
+            part = total * self.axis_wire_ms[ax] / weight
+            parts[ax] = part
+            assigned += part
+        parts[axes[-1]] = total - assigned
+        return parts
+
+    def _wire_slowdown_parts(
+        self,
+        wire_bytes: Optional[float],
+        wire_bytes_by_axis: Optional[Dict[str, float]] = None,
+    ) -> "tuple[float, Dict[str, float]]":
+        """``(wire_slowdown_ms, {axis: ms})`` — the per-axis parts sum to
+        the scalar exactly whenever they exist (partition by construction:
+        either the scalar is computed as their sum, or the last axis takes
+        the remainder of a proportional split).  Axis-blind inputs return
+        an empty split and the legacy scalar unchanged."""
+        # per-axis measured evidence is the strongest: each axis's overshoot
+        # of its own priced promise, the scalar defined as the sum (needs a
+        # priced per-axis promise — without one, fall to the scalar path)
+        if self._measured_wire_axis_ms is not None and self.axis_wire_ms:
+            parts = {
+                ax: max(0.0, ms - self.axis_wire_ms.get(ax, 0.0))
+                for ax, ms in self._measured_wire_axis_ms.items()
+            }
+            return sum(parts[ax] for ax in sorted(parts)), parts
+        # scalar measured wire beyond the α–β promise wins next; with a
+        # priced per-axis ledger the overshoot splits by expected share
+        if self._measured_wire_ms is not None and self.wire_ms is not None:
+            total = max(0.0, self._measured_wire_ms - self.wire_ms)
+            return total, self._split_by_axis_share(total)
+        # otherwise, price the byte inflation: census bytes over baseline.
+        # Per-axis censuses price each axis's excess on its own leg and the
+        # scalar is the sum of the parts.
+        if wire_bytes_by_axis:
+            parts = {}
+            for ax in sorted(wire_bytes_by_axis):
+                samples = self._axis_bytes_samples.get(ax)
+                if not samples:
+                    continue
+                baseline = statistics.median(samples)
+                excess = float(wire_bytes_by_axis[ax]) - baseline
+                if excess <= 0 or baseline <= 0:
+                    parts[ax] = 0.0
+                    continue
+                priced = self._price_axis_bytes_ms(ax, excess)
+                if priced is not None:
+                    parts[ax] = priced
+                elif self.axis_wire_ms.get(ax):
+                    parts[ax] = self.axis_wire_ms[ax] * excess / baseline
+                else:
+                    parts[ax] = 0.0
+            if parts:
+                return sum(parts[ax] for ax in sorted(parts)), parts
+        if wire_bytes is None or not self._bytes_samples:
+            return 0.0, {}
         baseline = statistics.median(self._bytes_samples)
         excess = float(wire_bytes) - baseline
         if excess <= 0 or baseline <= 0:
-            return 0.0
+            return 0.0, {}
         priced = self._price_bytes_ms(excess)
         if priced is not None:
-            return priced
+            return priced, {}
         if self.wire_ms is not None:
-            return self.wire_ms * excess / baseline
-        return 0.0
+            return self.wire_ms * excess / baseline, {}
+        return 0.0, {}
 
     # -- the per-step settle --------------------------------------------------
 
@@ -241,11 +375,13 @@ class BudgetModel:
         measured_ms: float,
         host_ms: Optional[float] = None,
         wire_bytes: Optional[float] = None,
+        wire_bytes_by_axis: Optional[Dict[str, float]] = None,
     ) -> StepBudget:
         """Close one step: compute the residual against the expected wall
         and partition it.  ``host_ms`` is the step's total host-side
         overhead (the engine's pre + lock-wait + post), ``wire_bytes`` the
-        step's bucket-plan census.  Clears the per-step evidence hooks."""
+        step's bucket-plan census (``wire_bytes_by_axis`` the same census
+        split by mesh axis).  Clears the per-step evidence hooks."""
         measured_ms = float(measured_ms)
         clean = (self._compile_ms == 0.0 and self._snapshot_ms == 0.0
                  and self._backpressure_s == 0.0 and self._straggler_ms == 0.0)
@@ -263,7 +399,9 @@ class BudgetModel:
         if host_ms is not None and self._host_samples:
             components["host_data"] = max(
                 0.0, float(host_ms) - statistics.median(self._host_samples))
-        components["wire_slowdown"] = self._wire_slowdown_ms(wire_bytes)
+        wire_slowdown, wire_axis = self._wire_slowdown_parts(
+            wire_bytes, wire_bytes_by_axis)
+        components["wire_slowdown"] = wire_slowdown
         named = sum(components[c] for c in BUDGET_COMPONENTS[:-1])
         components["unattributed"] = residual - named
 
@@ -279,6 +417,7 @@ class BudgetModel:
             dominant=dominant,
             calibrated=settled,
             straggler_rank=self._straggler_rank,
+            wire_axis_ms=wire_axis,
         )
 
         # clean steps feed the baselines (bounded: keep the newest window).
@@ -293,9 +432,14 @@ class BudgetModel:
                 self._host_samples.append(float(host_ms))
             if wire_bytes is not None:
                 self._bytes_samples.append(float(wire_bytes))
+            if wire_bytes_by_axis:
+                for ax, nbytes in wire_bytes_by_axis.items():
+                    self._axis_bytes_samples.setdefault(str(ax), []).append(
+                        float(nbytes))
             cap = max(self.calibrate_steps, 64)
             for samples in (self._wall_samples, self._host_samples,
-                            self._bytes_samples):
+                            self._bytes_samples,
+                            *self._axis_bytes_samples.values()):
                 if len(samples) > cap:
                     del samples[: len(samples) - cap]
 
@@ -305,6 +449,7 @@ class BudgetModel:
         self._straggler_ms = 0.0
         self._straggler_rank = -1
         self._measured_wire_ms = None
+        self._measured_wire_axis_ms = None
         return budget
 
     def report(self) -> Dict:
@@ -312,6 +457,9 @@ class BudgetModel:
             "priced": self.compute_ms is not None,
             "compute_ms": self.compute_ms,
             "wire_ms": self.wire_ms,
+            "axis_wire_ms": {
+                k: round(v, 4) for k, v in sorted(self.axis_wire_ms.items())
+            },
             "overlap_frac": self.overlap_frac,
             "expected_ms": self.expected(),
             "calibrated": self.calibrated,

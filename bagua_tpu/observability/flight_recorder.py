@@ -496,6 +496,10 @@ def _blocked_on(rec: Dict) -> Dict:
             "nbytes", "plan_version", "variant")}
     if "hops" in rec:
         out["hops"] = rec["hops"]
+    if "axes" in rec:
+        # which mesh axes the blocked collective rides — lets the hang
+        # verdict name the link a wedged gang is stuck behind
+        out["axes"] = list(rec["axes"])
     return out
 
 

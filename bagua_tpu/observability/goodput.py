@@ -37,6 +37,7 @@ __all__ = [
     "flops_from_cost_analysis",
     "mlp_fwd_flops",
     "model_flops_per_sample",
+    "predicted_axis_wire_time",
     "predicted_wire_time",
     "register_model_flops",
     "vgg16_fwd_flops",
@@ -160,6 +161,27 @@ def predicted_wire_time(
             for b in bucket_bytes
         )
     )
+
+
+def predicted_axis_wire_time(
+    cost_model,
+    bucket_bytes: Sequence[float],
+    axes: Sequence[str],
+) -> Dict[str, float]:
+    """Per-mesh-axis α–β-predicted wire seconds for one step's bucketed
+    exchange: each bucket's bytes split evenly across the exchange axes and
+    priced on each axis's fitted leg
+    (:meth:`~bagua_tpu.service.planner.CostModel.axis_leg`, falling back to
+    ``flat`` on legacy 1-D meshes).  Returns ``{axis: seconds}``."""
+    axes = [str(a) for a in axes if a]
+    if not axes:
+        return {}
+    out: Dict[str, float] = {}
+    for b in bucket_bytes:
+        share = float(b) / len(axes)
+        for ax in axes:
+            out[ax] = out.get(ax, 0.0) + cost_model.axis_leg(ax).predict(share)
+    return out
 
 
 # -- the wall-clock ledger ----------------------------------------------------
@@ -306,6 +328,7 @@ class GoodputMeter:
         bucket_bytes: Optional[Sequence[float]] = None,
         hierarchical: bool = False,
         wire_pattern: str = "allreduce",
+        exchange_axes: Optional[Sequence[str]] = None,
         registry=None,
         clock: Callable[[], float] = time.perf_counter,
     ):
@@ -320,6 +343,10 @@ class GoodputMeter:
         self.bucket_bytes = list(bucket_bytes) if bucket_bytes else None
         self.hierarchical = hierarchical
         self.wire_pattern = wire_pattern
+        #: named mesh axes the live plan's exchange rides (the engine's
+        #: ``group.data_axes``); set, the wire prediction routes through the
+        #: per-axis α–β legs instead of the flat leg
+        self.exchange_axes = tuple(str(a) for a in exchange_axes or () if a)
         self.registry = registry
         self.ledger = GoodputLedger(registry=registry, clock=clock)
         self.last_mfu: Optional[float] = None
@@ -374,17 +401,39 @@ class GoodputMeter:
     def predicted_wire_s(self) -> Optional[float]:
         if self.cost_model is None or not self.bucket_bytes:
             return None
+        by_axis = self.predicted_wire_by_axis_s()
+        if by_axis:
+            # named mesh: the expected wire is the sum of the per-axis legs'
+            # predictions, NOT the flat leg's — the flat leg mis-prices a
+            # dp×tp/dp×fsdp plan and the error lands in ``unattributed``
+            return float(sum(by_axis[ax] for ax in sorted(by_axis)))
         return predicted_wire_time(
             self.cost_model, self.bucket_bytes,
             hierarchical=self.hierarchical, wire_pattern=self.wire_pattern,
         )
 
-    def observe_wire(self, measured_wire_s: float) -> Optional[float]:
+    def predicted_wire_by_axis_s(self) -> Optional[Dict[str, float]]:
+        """Per-axis α–β-predicted wire seconds for the live plan, or None
+        when the plan is axis-blind (no ``exchange_axes``)."""
+        if (self.cost_model is None or not self.bucket_bytes
+                or not self.exchange_axes
+                or not hasattr(self.cost_model, "axis_leg")):
+            return None
+        return predicted_axis_wire_time(
+            self.cost_model, self.bucket_bytes, self.exchange_axes,
+        )
+
+    def observe_wire(self, measured_wire_s: float,
+                     by_axis: Optional[Dict[str, float]] = None
+                     ) -> Optional[float]:
         """Feed a *measured* per-step wire time (e.g. the device-trace
         analysis' ``collective_ms``) and update ``wire_efficiency`` =
         predicted / measured — 1.0 means the fabric delivered exactly what
         the fitted α–β model promised; below 1.0 the wire underdelivered
-        (congestion, stragglers); above 1.0 the model is stale."""
+        (congestion, stragglers); above 1.0 the model is stale.  With
+        ``by_axis`` (per-axis measured seconds) each axis additionally gets
+        a ``wire_efficiency_<axis>`` gauge — the flat-name analog of a
+        ``wire_efficiency{axis=...}`` labeled family."""
         predicted = self.predicted_wire_s()
         if predicted is None or measured_wire_s <= 0:
             return None
@@ -395,6 +444,17 @@ class GoodputMeter:
                 "wire_efficiency",
                 help="alpha-beta-predicted wire time / measured wire time",
             ).set(round(eff, 6))
+            if by_axis:
+                predicted_by_axis = self.predicted_wire_by_axis_s() or {}
+                for ax, measured_ax in sorted(by_axis.items()):
+                    pred_ax = predicted_by_axis.get(ax)
+                    if pred_ax is None or measured_ax <= 0:
+                        continue
+                    self.registry.gauge(
+                        f"wire_efficiency_{ax}",
+                        help=("alpha-beta-predicted / measured wire time on "
+                              f"mesh axis {ax}"),
+                    ).set(round(pred_ax / measured_ax, 6))
         return eff
 
     # -- ledger feed (driven by the telemetry hub) ----------------------------
@@ -427,6 +487,7 @@ class GoodputMeter:
             "mfu": self.last_mfu,
             "wire_efficiency": self.last_wire_efficiency,
             "predicted_wire_s": self.predicted_wire_s(),
+            "predicted_wire_by_axis_s": self.predicted_wire_by_axis_s(),
             "ledger": self.ledger.report(),
         }
         return out

@@ -116,16 +116,33 @@ class RegressionSentinel:
             ``BAGUA_REGRESSION_THRESHOLD``).
         cooldown: steps after a trip before the sentinel can trip again.
         window: how many recent budgets an incident's verdict aggregates.
+        topology: :class:`~bagua_tpu.perflab.topology.TopologyAssumptions`
+            resolving an indicted axis to its physical link class
+            (``ici``/``dcn``) on wire-dominant incidents; defaults to
+            :data:`~bagua_tpu.perflab.topology.DEFAULT_TOPOLOGY`.
+
+    Beyond the wall/goodput detectors, the sentinel runs **one CUSUM stream
+    per mesh axis** over the budgets' per-axis ``wire_slowdown`` split
+    (``StepBudget.wire_axis_ms``, lazily created as axes appear).  An axis
+    stream's sustained drift trips like the scalar streams do, and any
+    wire-dominant incident names the ``axis`` whose windowed slowdown
+    dominates plus its ``link_class`` — a tp/ICI brownout and a dp/DCN
+    collapse become distinguishable verdicts.
     """
 
     def __init__(self, budget: Optional[BudgetModel] = None, sink=None,
                  registry=None, warmup: int = 30, threshold: float = 8.0,
                  drift_k: float = 1.0, alpha: float = 0.05,
                  cooldown: int = 50, window: int = 20,
-                 max_incidents: int = 256):
+                 max_incidents: int = 256, topology=None):
         self.budget = budget or BudgetModel()
         self.sink = sink
         self.registry = registry
+        if topology is None:
+            from bagua_tpu.perflab.topology import DEFAULT_TOPOLOGY
+
+            topology = DEFAULT_TOPOLOGY
+        self.topology = topology
         self.cooldown = max(0, int(cooldown))
         self.window = max(1, int(window))
         self.max_incidents = max(1, int(max_incidents))
@@ -133,6 +150,13 @@ class RegressionSentinel:
                            alpha=alpha, direction=+1)
         self._goodput = Cusum(k=drift_k, h=threshold, warmup=warmup,
                               alpha=alpha, direction=-1)
+        # one lazily-created detector per mesh axis over the per-axis wire
+        # slowdown stream; the raised σ floor (0.05 ms vs the default 1e-6)
+        # keeps an all-zeros clean split from hair-triggering on noise
+        self._axis_cusum_kwargs = dict(k=drift_k, h=threshold, warmup=warmup,
+                                       alpha=alpha, direction=+1,
+                                       abs_floor=0.05)
+        self._axis_cusums: Dict[str, Cusum] = {}
         self._budgets: collections.deque = collections.deque(maxlen=self.window)
         self._cooldown_until = -1
         self._steps_seen = 0
@@ -154,8 +178,9 @@ class RegressionSentinel:
     def note_straggler(self, excess_ms: float, rank: int = -1) -> None:
         self.budget.note_straggler(excess_ms, rank=rank)
 
-    def note_wire(self, measured_wire_ms: float) -> None:
-        self.budget.note_wire(measured_wire_ms)
+    def note_wire(self, measured_wire_ms: float,
+                  by_axis: Optional[Dict[str, float]] = None) -> None:
+        self.budget.note_wire(measured_wire_ms, by_axis=by_axis)
 
     # -- the per-step entry point ---------------------------------------------
 
@@ -165,24 +190,40 @@ class RegressionSentinel:
         wall_ms: float,
         host_ms: Optional[float] = None,
         wire_bytes: Optional[float] = None,
+        wire_bytes_by_axis: Optional[Dict[str, float]] = None,
         goodput_frac: Optional[float] = None,
         trace_id: str = "",
     ) -> StepBudget:
-        """Settle this step's budget and run both detectors; on trip, emit
+        """Settle this step's budget and run every detector; on trip, emit
         one ``perf_regression`` incident.  Returns the settled budget (the
         hub exports its components as ``step_budget_<component>_ms``
-        gauges)."""
+        gauges, and its per-axis wire split as
+        ``step_budget_wire_<axis>_ms``)."""
         self._steps_seen += 1
         budget = self.budget.settle(step, wall_ms, host_ms=host_ms,
-                                    wire_bytes=wire_bytes)
+                                    wire_bytes=wire_bytes,
+                                    wire_bytes_by_axis=wire_bytes_by_axis)
         self._budgets.append(budget)
         tripped_wall = self._wall.update(wall_ms)
         tripped_goodput = (goodput_frac is not None
                            and self._goodput.update(goodput_frac))
-        if ((tripped_wall or tripped_goodput)
+        tripped_axis = None
+        for ax in sorted(budget.wire_axis_ms):
+            detector = self._axis_cusums.get(ax)
+            if detector is None:
+                detector = self._axis_cusums[ax] = Cusum(
+                    **self._axis_cusum_kwargs)
+            if detector.update(budget.wire_axis_ms[ax]) and tripped_axis is None:
+                tripped_axis = ax
+        if ((tripped_wall or tripped_goodput or tripped_axis is not None)
                 and self._steps_seen > self._cooldown_until):
-            stream = "step_wall" if tripped_wall else "goodput"
-            self._trip(step, stream, trace_id)
+            if tripped_wall:
+                stream = "step_wall"
+            elif tripped_goodput:
+                stream = "goodput"
+            else:
+                stream = f"wire_axis:{tripped_axis}"
+            self._trip(step, stream, trace_id, axis=tripped_axis)
             self._cooldown_until = self._steps_seen + self.cooldown
         return budget
 
@@ -191,11 +232,14 @@ class RegressionSentinel:
     def _verdict(self) -> Dict:
         """Aggregate the recent window into one partition + dominant name."""
         components = dict.fromkeys(BUDGET_COMPONENTS, 0.0)
+        wire_axis: Dict[str, float] = {}
         residual = measured = expected = 0.0
         straggler_rank = -1
         for b in self._budgets:
             for c in BUDGET_COMPONENTS:
                 components[c] += b.components.get(c, 0.0)
+            for ax, ms in b.wire_axis_ms.items():
+                wire_axis[ax] = wire_axis.get(ax, 0.0) + ms
             residual += b.residual_ms
             measured += b.measured_ms
             expected += b.expected_ms
@@ -207,13 +251,15 @@ class RegressionSentinel:
         return {
             "components": {k: round(v, 4) for k, v in components.items()},
             "dominant": dominant,
+            "wire_axis": {k: round(v, 4) for k, v in sorted(wire_axis.items())},
             "residual_ms": round(residual, 4),
             "measured_ms": round(measured, 4),
             "expected_ms": round(expected, 4),
             "straggler_rank": straggler_rank,
         }
 
-    def _trip(self, step: int, stream: str, trace_id: str) -> None:
+    def _trip(self, step: int, stream: str, trace_id: str,
+              axis: Optional[str] = None) -> None:
         verdict = self._verdict()
         # ts stamped here (not left to the sink) so drained incidents carry
         # it onto the fleet timeline even when no JSONL sink is attached
@@ -232,6 +278,19 @@ class RegressionSentinel:
         }
         if verdict["straggler_rank"] >= 0:
             event["straggler_rank"] = verdict["straggler_rank"]
+        # a wire-dominant verdict indicts the axis whose windowed slowdown
+        # dominates (or the axis whose own CUSUM stream tripped), resolved
+        # through the topology to the physical link class it rides
+        wire_axis = verdict["wire_axis"]
+        if axis is None and verdict["dominant"] == "wire_slowdown" and wire_axis:
+            worst = max(sorted(wire_axis), key=lambda a: wire_axis[a])
+            if wire_axis[worst] > 0:
+                axis = worst
+        if axis is not None:
+            event["axis"] = str(axis)
+            event["link_class"] = self.topology.axis_link(str(axis))
+            if wire_axis:
+                event["wire_axis_ms"] = wire_axis
         logger.warning(
             "perf regression at step %d (%s stream): dominant=%s "
             "residual=%.2fms over the last %d steps",
@@ -261,22 +320,29 @@ class RegressionSentinel:
         out, self._pending = self._pending, []
         return out
 
-    def rebaseline(self, wire_ms: Optional[float] = None) -> None:
+    def rebaseline(self, wire_ms: Optional[float] = None,
+                   axis_wire_ms: Optional[Dict[str, float]] = None) -> None:
         """A committed configuration change (rebucket, precision switch,
-        algorithm switch) legitimately moved the step wall: reset both CUSUM
-        baselines so they re-learn over a fresh warmup instead of reading
-        the new steady state as a sustained regression, and optionally
-        re-price the budget's wire expectation to the new configuration's
-        modeled wire (the autopilot passes its α–β prediction at nominal
-        bandwidth)."""
+        algorithm switch) legitimately moved the step wall: reset every CUSUM
+        baseline — the wall/goodput pair and the per-axis streams — so they
+        re-learn over a fresh warmup instead of reading the new steady state
+        as a sustained regression, and optionally re-price the budget's wire
+        expectation to the new configuration's modeled wire (the autopilot
+        passes its α–β prediction at nominal bandwidth; ``axis_wire_ms``
+        re-prices the per-axis ledger alongside)."""
         for detector in (self._wall, self._goodput):
             detector.mean = None
             detector.var = 0.0
             detector.n = 0
             detector.s = 0.0
+        self._axis_cusums = {}
         self._budgets.clear()
         if wire_ms is not None:
             self.budget.wire_ms = float(wire_ms)
+        if axis_wire_ms is not None:
+            self.budget.axis_wire_ms = {
+                str(k): float(v) for k, v in axis_wire_ms.items()
+            }
 
     def report(self) -> Dict:
         return {
@@ -284,6 +350,10 @@ class RegressionSentinel:
             "incidents": len(self.incidents),
             "wall_trips": self._wall.trips,
             "goodput_trips": self._goodput.trips,
+            "axis_trips": {
+                ax: c.trips for ax, c in sorted(self._axis_cusums.items())
+                if c.trips
+            },
             "last_incident": self.incidents[-1] if self.incidents else None,
             "budget": self.budget.report(),
         }

@@ -359,6 +359,7 @@ class Telemetry:
         host_overhead: Optional[Dict] = None,
         wire_bytes_by_leg: Optional[Dict[str, int]] = None,
         wire_bytes_by_precision: Optional[Dict[str, int]] = None,
+        wire_bytes_by_axis: Optional[Dict[str, int]] = None,
     ) -> None:
         """One dispatched training step's host-side evidence.
 
@@ -370,7 +371,13 @@ class Telemetry:
         traffic down by wire precision (``f32``/``int8``/``int4`` — the
         quantized-ring exchange's modelled bytes); each precision gets a
         ``wire_bytes_precision_<p>_total`` counter — the flat-name analog of
-        a ``wire_bytes{precision=...}`` labeled family."""
+        a ``wire_bytes{precision=...}`` labeled family.
+        ``wire_bytes_by_axis`` breaks the traffic down by the named mesh
+        axis it rides (``{"dp": ..., "fsdp": ...}`` — the engine joins the
+        variant's flight program records' ``axes`` against the plan);
+        per-axis ``wire_bytes_axis_<ax>_total`` counters, the regression
+        sentinel's per-axis byte census, and the ``step_budget_wire_<ax>_ms``
+        per-axis budget gauges hang off it."""
         self.current_step = int(step)
         self.current_variant = variant
         self.recompile.record_step()
@@ -396,6 +403,12 @@ class Telemetry:
                     f"wire_bytes_precision_{prec}_total",
                     help=f"bytes communicated per rank at wire precision {prec}",
                 ).inc(max(0, int(nbytes)))
+        if wire_bytes_by_axis:
+            for ax, nbytes in sorted(wire_bytes_by_axis.items()):
+                r.counter(
+                    f"wire_bytes_axis_{ax}_total",
+                    help=f"bytes communicated per rank on mesh axis {ax}",
+                ).inc(max(0, int(nbytes)))
         r.histogram("step_wall_ms", help="host-observed step wall time").observe(
             wall_s * 1e3
         )
@@ -417,7 +430,9 @@ class Telemetry:
                             if self.goodput is not None else None)
             budget = self.regression.observe_step(
                 int(step), wall_s * 1e3, host_ms=host_ms,
-                wire_bytes=int(wire_bytes), goodput_frac=goodput_frac,
+                wire_bytes=int(wire_bytes),
+                wire_bytes_by_axis=wire_bytes_by_axis,
+                goodput_frac=goodput_frac,
                 trace_id=self._trace_fields().get("trace_id", ""),
             )
             # flat-name analog of a bagua_step_budget_ms{component=...}
@@ -426,6 +441,14 @@ class Telemetry:
                 r.gauge(
                     f"step_budget_{comp}_ms",
                     help=f"step-budget residual attributed to {comp}",
+                ).set(round(ms, 4))
+            # the wire_slowdown component's per-axis split — the flat-name
+            # analog of step_budget_wire_ms{axis=...}; the sub-components
+            # sum to step_budget_wire_slowdown_ms exactly
+            for ax, ms in sorted(budget.wire_axis_ms.items()):
+                r.gauge(
+                    f"step_budget_wire_{ax}_ms",
+                    help=f"wire_slowdown budget attributed to mesh axis {ax}",
                 ).set(round(ms, 4))
             r.gauge(
                 "step_budget_expected_ms",
@@ -454,6 +477,10 @@ class Telemetry:
             if wire_bytes_by_precision:
                 event["wire_bytes_by_precision"] = {
                     k: int(v) for k, v in sorted(wire_bytes_by_precision.items())
+                }
+            if wire_bytes_by_axis:
+                event["wire_bytes_by_axis"] = {
+                    k: int(v) for k, v in sorted(wire_bytes_by_axis.items())
                 }
             self.jsonl.emit(event)
 
@@ -577,6 +604,7 @@ class Telemetry:
         to_config: dict,
         verdict: str,
         modeled: Optional[dict] = None,
+        axis: Optional[str] = None,
     ) -> None:
         """The gang autopilot made one policy decision
         (:class:`~bagua_tpu.autopilot.GangAutopilot`): demote / re-promote /
@@ -584,7 +612,9 @@ class Telemetry:
         ``perf_regression`` incident (empty when the trigger was a health
         alert or a stabilization window); ``reason`` speaks the unified
         switch vocabulary; ``modeled`` optionally carries the α–β priced
-        ``{"stay_ms", "chosen_ms"}`` comparison the decision rests on.
+        ``{"stay_ms", "chosen_ms"}`` comparison the decision rests on;
+        ``axis`` names the mesh axis the incident indicted (the candidates
+        were priced with only that axis's legs degraded).
         Exported as ``plan_decisions_total`` plus a per-verdict counter and
         a schema-validated ``plan_decision`` JSONL event the timeline tools
         join to incidents and switch events by ``trace_id``/``plan_version``."""
@@ -612,6 +642,8 @@ class Telemetry:
                 event["modeled"] = {
                     k: round(float(v), 4) for k, v in modeled.items()
                 }
+            if axis:
+                event["axis"] = str(axis)
             self.jsonl.emit(event)
 
     def on_snapshot(

@@ -28,6 +28,7 @@ seed (no wall-clock, no real port numbers), so two runs diff clean.
 
 import dataclasses
 import random
+import statistics
 from typing import Callable, Dict, List, Optional, Tuple
 
 from bagua_tpu.observability.aggregate import GangAggregator, StepSummary
@@ -72,12 +73,17 @@ class Straggler:
 
 @dataclasses.dataclass(frozen=True)
 class BandwidthCollapse:
-    """A whole gang's wire span inflates by ``factor`` (ICI brownout)."""
+    """A gang's wire span inflates by ``factor`` (ICI brownout / DCN
+    congestion).  With ``axis`` set — and the fleet configured with per-axis
+    wire spans (:attr:`FleetConfig.axis_wire_ms`) — only that mesh axis's
+    modeled wire leg collapses, so the gang view's per-axis medians carry
+    the axis signature a per-axis regression sentinel must attribute."""
 
     gang: int
     factor: float = 4.0
     start_window: int = 0
     end_window: Optional[int] = None
+    axis: Optional[str] = None
 
     def active(self, window: int) -> bool:
         return self.start_window <= window and (
@@ -164,6 +170,12 @@ class FleetConfig:
     #: ``compute_ms`` / ``exposed_wire_ms``); jitter is ±3% seeded
     compute_ms: float = 6.0
     wire_ms: float = 4.0
+    #: optional per-mesh-axis split of the wire span (ms per axis).  When
+    #: set it REPLACES ``wire_ms`` as the modeled wire (the base wire is the
+    #: sum of the axis spans) and every rank summary's ``phase_ms`` gains
+    #: ``wire_<axis>`` sub-spans, so an axis-scoped
+    #: :class:`BandwidthCollapse` surfaces per axis in the gang view.
+    axis_wire_ms: Optional[Dict[str, float]] = None
     steps_per_window: int = 20
     global_batch: int = 256
     straggler_factor: float = 1.5  #: detection threshold, not injection
@@ -171,6 +183,14 @@ class FleetConfig:
     breaker_threshold: int = 2
     breaker_cooldown_s: float = 0.0
     faults: Tuple = ()
+
+    def base_wire_ms(self) -> float:
+        """The fault-free modeled wire span (the sum of the axis spans when
+        the wire is split per axis)."""
+        if self.axis_wire_ms:
+            return float(sum(self.axis_wire_ms[ax]
+                             for ax in sorted(self.axis_wire_ms)))
+        return float(self.wire_ms)
 
     def fault_descriptions(self) -> List[Dict]:
         return [
@@ -184,20 +204,39 @@ def _rank_step_ms(
 ) -> Tuple[float, Dict[str, float]]:
     """One rank's modeled step p50 for one window, faults applied."""
     compute = cfg.compute_ms
-    wire = cfg.wire_ms
+    axis_parts = (
+        {str(ax): float(cfg.axis_wire_ms[ax]) for ax in sorted(cfg.axis_wire_ms)}
+        if cfg.axis_wire_ms else None
+    )
+    wire = sum(axis_parts.values()) if axis_parts else cfg.wire_ms
     for f in cfg.faults:
         if not f.active(window) or getattr(f, "gang", None) != gang:
             continue
         if isinstance(f, BandwidthCollapse):
-            wire *= f.factor
+            if axis_parts is not None:
+                # axis-scoped collapse hits only the indicted axis's span;
+                # an axis-less collapse browns out every leg
+                hit = [f.axis] if f.axis else list(axis_parts)
+                for ax in hit:
+                    if ax in axis_parts:
+                        axis_parts[ax] *= f.factor
+                wire = sum(axis_parts.values())
+            else:
+                wire *= f.factor
         elif isinstance(f, Straggler) and f.rank == rank:
             if f.phase == "compute":
                 compute *= f.factor
             else:
                 wire *= f.factor
+                if axis_parts is not None:
+                    for ax in axis_parts:
+                        axis_parts[ax] *= f.factor
     jitter = 1.0 + 0.03 * (2.0 * rng.random() - 1.0)
     phase_ms = {"compute": round(compute * jitter, 6),
                 "wire": round(wire * jitter, 6)}
+    if axis_parts is not None:
+        for ax in sorted(axis_parts):
+            phase_ms[f"wire_{ax}"] = round(axis_parts[ax] * jitter, 6)
     return (compute + wire) * jitter, phase_ms
 
 
@@ -369,7 +408,7 @@ def _window_verdict(cfg: FleetConfig, gang: int, window: int, step: int,
     stale_ranks = sorted(
         s.rank for s in view.summaries if s.step < step
     )
-    return {
+    out = {
         "window": window,
         "ranks_reporting": view.ranks_reporting,
         "local_only": view.local_only,
@@ -380,6 +419,21 @@ def _window_verdict(cfg: FleetConfig, gang: int, window: int, step: int,
         "straggler": view.straggler,
         "stale_ranks": stale_ranks,
     }
+    # per-axis gang wire medians, present iff ranks report wire_<axis>
+    # phase sub-spans — the per-axis sentinel's measured-wire feed
+    axis_keys = sorted({
+        k for s in view.summaries
+        for k in (s.phase_ms or {}) if k.startswith("wire_")
+    })
+    if axis_keys:
+        out["gang_wire_axis_ms"] = {
+            k[len("wire_"):]: round(statistics.median(
+                s.phase_ms[k] for s in view.summaries
+                if k in (s.phase_ms or {})
+            ), 4)
+            for k in axis_keys
+        }
+    return out
 
 
 def _gang_verdict(cfg: FleetConfig, g: int, gang: Dict) -> Dict:
@@ -434,7 +488,8 @@ def gang_faults(cfg: FleetConfig, gang: int, kind) -> List:
 
 
 def _expected_ratio(cfg: FleetConfig, f: Straggler) -> float:
-    base = cfg.compute_ms + cfg.wire_ms
+    wire = cfg.base_wire_ms()
+    base = cfg.compute_ms + wire
     if f.phase == "compute":
-        return (cfg.compute_ms * f.factor + cfg.wire_ms) / base
-    return (cfg.compute_ms + cfg.wire_ms * f.factor) / base
+        return (cfg.compute_ms * f.factor + wire) / base
+    return (cfg.compute_ms + wire * f.factor) / base

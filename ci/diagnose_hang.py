@@ -118,6 +118,9 @@ def fold_incidents(report: dict, incidents) -> None:
             "residual_ms": inc.get("residual_ms"),
             **({"straggler_rank": inc["straggler_rank"]}
                if "straggler_rank" in inc else {}),
+            **({"axis": inc["axis"]} if inc.get("axis") else {}),
+            **({"link_class": inc["link_class"]}
+               if inc.get("link_class") else {}),
         }
         for inc in incidents[-8:]
     ]
@@ -163,11 +166,14 @@ def summarize(report) -> str:
         lines.append(f"lagging ranks: {report['lagging_ranks']}")
     blocked = report.get("blocked_on")
     if blocked:
+        axes = blocked.get("axes")
         lines.append(
             "blocked on: "
             f"{blocked['label']} (seq {blocked['seq']}, bucket "
             f"{blocked['bucket']}, phase {blocked['phase']}, "
-            f"plan_version {blocked['plan_version']})"
+            f"plan_version {blocked['plan_version']}"
+            + (f", axes {'x'.join(str(a) for a in axes)}" if axes else "")
+            + ")"
         )
     traces = report.get("trace_by_rank") or {}
     for rank, ctx in sorted(traces.items()):
@@ -179,10 +185,15 @@ def summarize(report) -> str:
     incidents = report.get("incidents") or []
     if incidents:
         newest = incidents[-1]
+        axis_note = (
+            f", axis {newest['axis']}"
+            + (f" [{newest['link_class']}]" if newest.get("link_class") else "")
+            if newest.get("axis") else ""
+        )
         lines.append(
             f"sentinel: {len(incidents)} perf_regression incident(s) "
             f"nearby; newest at step {newest.get('step')} "
-            f"(dominant {newest.get('dominant')})"
+            f"(dominant {newest.get('dominant')}{axis_note})"
         )
     if "straggler_confirmed_by_sentinel" in report:
         lines.append(

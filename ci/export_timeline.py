@@ -246,6 +246,12 @@ def spans_to_trace_events(
             # reads perf_regression:compile / :wire_slowdown / ... at a
             # glance, with the full partition in args
             name = f"perf_regression:{ev.get('dominant') or 'unattributed'}"
+            if ev.get("axis"):
+                # axis-resolved incidents headline the indicted mesh axis
+                # and its link class: perf_regression:wire_slowdown@tp[ici]
+                name += f"@{ev['axis']}"
+                if ev.get("link_class"):
+                    name += f"[{ev['link_class']}]"
             cat = "incident"
         elif name == "plan_decision":
             # same treatment for the autopilot: the decision kind headlines

@@ -1484,6 +1484,305 @@ def autopilot_lane(out_prefix: str):
     }
 
 
+def axis_attribution_lane(out_prefix: str):
+    """Executed per-axis wire-attribution gate: the axis ledger, end to end.
+
+    A real 8-rank engine on a **named dp4×tp2 mesh** pins the telemetry
+    discipline first: sentinel on vs off trains bitwise-identical state for
+    gradient_allreduce AND zero (overlap on) — the per-axis byte census and
+    ledger are host-side arithmetic.  The clean run also exports the
+    ``bagua_step_budget_wire_<axis>_ms`` per-axis gauges.
+
+    Then fleetsim drives the axis verdict: with the wire split per axis
+    (``axis_wire_ms={"dp": 3, "tp": 1}``), a **tp-only** bandwidth collapse
+    (x8, ICI) and later a **dp-only** collapse (x8, DCN) feed a priced
+    per-axis sentinel through ``note_wire(by_axis=...)``.  The contract:
+
+    * each collapse's incidents name the **correct axis** (``tp`` then
+      ``dp``) and link class (``ici`` then ``dcn``), the per-axis split
+      summing bitwise to ``wire_slowdown``;
+    * the autopilot **holds** on the tp collapse (tp is not an exchange
+      axis — axis-scoped pricing leaves the candidate ranking frozen, so
+      demoting the dp wire precision is correctly refused) and **demotes**
+      on the dp one (dp IS the exchange axis — the ranking flips), with
+      ``plan_decision`` rows recording the axis they acted on;
+    * the fleet scheduler view and timeline carry the incident's axis, and
+      ``ci/perf_doctor.py`` joins it into the incident report.
+
+    tests/test_ci_lane.py greps the stderr sentinel and re-checks the
+    audit fields.
+    """
+    import hashlib
+
+    import bagua_tpu
+    from bagua_tpu.algorithms import build_algorithm
+    from bagua_tpu.autopilot import (
+        AutopilotConfig, Configuration, GangAutopilot, wire_ms,
+    )
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.fleet.control_plane import FleetControlPlane
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+    from bagua_tpu.observability import (
+        BudgetModel, RegressionSentinel, Telemetry, validate_metrics_file,
+    )
+    from bagua_tpu.perflab.fleetsim import (
+        BandwidthCollapse, FleetConfig, run_fleet,
+    )
+    from bagua_tpu.service.planner import AlphaBeta, CostModel
+
+    COMPUTE_MS, STEPS_PER_WINDOW = 6.0, 20
+    AXIS_WIRE = {"dp": 3.0, "tp": 1.0}  # ms per axis; total wire 4.0
+    WIRE_MS = sum(AXIS_WIRE.values())
+
+    os.environ["BAGUA_STATIC_VERIFY"] = "strict"
+    try:
+        group = bagua_tpu.init_process_group(
+            mesh_spec=bagua_tpu.MeshSpec({"dp": 4, "tp": 2})
+        )
+        assert group.data_axes == ("dp",) and group.exchange_size == 4, group
+
+        params = init_mlp(jax.random.PRNGKey(7), [64, 128, 128, 64])
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.rand(8 * group.size, 64).astype(np.float32))
+        y = jnp.asarray(rng.rand(8 * group.size, 64).astype(np.float32))
+
+        # -- bitwise witness on the 2-D mesh: sentinel on vs off ----------
+        def run(algo_name, n_steps, sentinel_on, metrics_path=None):
+            if sentinel_on:
+                os.environ["BAGUA_REGRESSION_SENTINEL"] = "1"
+            try:
+                if metrics_path and os.path.exists(metrics_path):
+                    os.remove(metrics_path)  # append-mode sink: fresh stream
+                tel = Telemetry(metrics_jsonl=metrics_path, flight=None)
+                ddp = DistributedDataParallel(
+                    loss_fn=mse_loss, optimizer=optax.sgd(0.01, momentum=0.9),
+                    algorithm=build_algorithm(algo_name), process_group=group,
+                    bucket_size_bytes=1 << 16, overlap=True, telemetry=tel,
+                )
+                st = ddp.init(params)
+                losses = None
+                for _ in range(n_steps):
+                    st, losses = ddp.train_step(st, (x, y))
+                jax.block_until_ready(losses)
+                digest = hashlib.sha256()
+                for leaf in jax.tree.leaves((st.params, st.opt_state)):
+                    digest.update(np.asarray(leaf).tobytes())
+                report = tel.regression.report() if sentinel_on else None
+                if metrics_path:
+                    tel.export_prometheus(metrics_path + ".prom")
+                tel.close()
+                ddp.shutdown()
+                return digest.hexdigest(), report
+            finally:
+                os.environ.pop("BAGUA_REGRESSION_SENTINEL", None)
+
+        metrics_path = out_prefix + "_axis_metrics.jsonl"
+        sha_on, clean_report = run("gradient_allreduce", 30, True, metrics_path)
+        sha_off, _ = run("gradient_allreduce", 30, False)
+        assert sha_on == sha_off, (
+            f"axis ledger perturbed gradient_allreduce training on the "
+            f"named mesh: {sha_on} != {sha_off}"
+        )
+        zsha_on, _ = run("zero", 30, True)
+        zsha_off, _ = run("zero", 30, False)
+        assert zsha_on == zsha_off, (
+            f"axis ledger perturbed zero training on the named mesh: "
+            f"{zsha_on} != {zsha_off}"
+        )
+        assert clean_report["incidents"] == 0, clean_report
+        problems = validate_metrics_file(metrics_path)
+        assert not problems, f"axis lane metrics failed schema: {problems}"
+        with open(metrics_path + ".prom") as f:
+            prom = f.read()
+        for ax in ("dp",):
+            assert f"bagua_step_budget_wire_{ax}_ms" in prom, (
+                f"per-axis gauge step_budget_wire_{ax}_ms missing: the "
+                f"engine's axis byte census never reached the budget"
+            )
+
+        # -- the driven loop: tp collapse (hold), then dp collapse (demote)
+        tel = Telemetry(metrics_jsonl=None, flight=None)
+        ddp = DistributedDataParallel(
+            loss_fn=mse_loss, optimizer=optax.sgd(0.01),
+            algorithm=build_algorithm(
+                "gradient_allreduce", wire_precision="auto"),
+            process_group=group, bucket_size_bytes=1 << 16, overlap="auto",
+            telemetry=tel,
+        )
+        state = ddp.init(params)
+
+        # α–β model sized to THIS plan's dp exchange so the ranking flips
+        # only when the EXCHANGE legs degrade: f32 flat is pure bandwidth
+        # (3 ms nominal = the dp wire span), the int8 ring pure hop latency
+        # (4.5 ms at any bandwidth); axis legs price the per-axis ledger.
+        total_nbytes = sum(s.nbytes for s in ddp.plan.specs)
+        hops = 2 * (group.exchange_size - 1)
+        cm = CostModel(
+            flat=AlphaBeta(alpha=0.0,
+                           beta=total_nbytes / (AXIS_WIRE["dp"] * 1e-3)),
+            qr8=AlphaBeta(
+                alpha=4.5e-3 / (hops * ddp.plan.num_buckets), beta=1e15,
+            ),
+            axis_legs={
+                ax: AlphaBeta(alpha=0.0,
+                              beta=total_nbytes / (AXIS_WIRE[ax] * 1e-3))
+                for ax in AXIS_WIRE
+            },
+        )
+        sentinel = RegressionSentinel(
+            budget=BudgetModel(compute_ms=COMPUTE_MS, axis_wire_ms=AXIS_WIRE),
+            warmup=20, threshold=8.0, cooldown=5, window=20,
+        )
+        assert sentinel.budget.wire_ms == WIRE_MS  # the axis ledger IS the wire
+        pilot = GangAutopilot(
+            ddp, cm,
+            AutopilotConfig(
+                cooldown_steps=15, hysteresis_incidents=2, canary_steps=5,
+                canary_loss_factor=1.5, repromote_windows=1000,
+                precisions=("f32", "int8"),
+                algorithms=("gradient_allreduce",), compute_ms=COMPUTE_MS,
+            ),
+            sentinel=sentinel, health=None, telemetry=tel,
+        )
+
+        # windows 1-2 clean | 3-5 tp x8 (ICI) | 6-7 clean | 8-10 dp x8 (DCN)
+        sim = run_fleet(FleetConfig(
+            n_gangs=1, ranks_per_gang=4, windows=10, seed=0,
+            compute_ms=COMPUTE_MS, axis_wire_ms=AXIS_WIRE,
+            steps_per_window=STEPS_PER_WINDOW,
+            faults=(
+                BandwidthCollapse(gang=0, factor=8.0, axis="tp",
+                                  start_window=3, end_window=6),
+                BandwidthCollapse(gang=0, factor=8.0, axis="dp",
+                                  start_window=8, end_window=11),
+            ),
+        ))
+        windows = sim["gangs"][0]["windows"]
+        assert all(w.get("gang_wire_axis_ms") for w in windows), windows
+        tp_meas = [w["gang_wire_axis_ms"]["tp"] for w in windows]
+        assert max(tp_meas[2:5]) > 7.0 > max(tp_meas[:2]), tp_meas
+
+        f32_cfg = Configuration()
+        step = 0
+        axis_partition_errors = []
+        for w, wv in enumerate(windows, start=1):
+            meas = dict(wv["gang_wire_axis_ms"])
+            # the fleetsim clocks model the f32 gang; the dp exchange's
+            # measured wire scales by the adopted configuration's α–β
+            # ratio at the dp axis's own collapse factor (the tp span is
+            # model traffic — no engine knob touches it)
+            dp_factor = max(1.0, meas["dp"] / AXIS_WIRE["dp"])
+            cur = pilot.current_configuration()
+            if cur != f32_cfg:
+                meas["dp"] *= (
+                    wire_ms(cm, ddp.plan, group.exchange_size, cur,
+                            bandwidth_factor=dp_factor)
+                    / wire_ms(cm, ddp.plan, group.exchange_size, f32_cfg,
+                              bandwidth_factor=dp_factor)
+                )
+            wire_total = sum(meas.values())
+            wall = COMPUTE_MS + wire_total
+            for _ in range(STEPS_PER_WINDOW):
+                state, losses = ddp.train_step(state, (x, y))
+                loss = float(np.asarray(losses).mean())
+                sentinel.note_wire(wire_total, by_axis=meas)
+                budget = sentinel.observe_step(
+                    step, wall, host_ms=0.5, trace_id=f"axis-w{w}-s{step}")
+                if budget.wire_axis_ms:
+                    axis_partition_errors.append(
+                        budget.axis_partition_error_ms())
+                state = pilot.tick(state, step, loss)
+                step += 1
+        jax.block_until_ready(state.params)
+        tel.close()
+        ddp.shutdown()
+    finally:
+        os.environ.pop("BAGUA_STATIC_VERIFY", None)
+
+    # -- per-axis partition exactness held on every settled step -----------
+    assert axis_partition_errors and max(axis_partition_errors) == 0.0, (
+        f"per-axis wire split must sum bitwise to wire_slowdown: "
+        f"max error {max(axis_partition_errors or [0.0])} ms"
+    )
+
+    # -- each collapse attributed to its axis + link class -----------------
+    tp_steps = range(2 * STEPS_PER_WINDOW, 5 * STEPS_PER_WINDOW)
+    dp_steps = range(7 * STEPS_PER_WINDOW, 10 * STEPS_PER_WINDOW)
+    tp_incidents = [i for i in sentinel.incidents if i["step"] in tp_steps]
+    dp_incidents = [i for i in sentinel.incidents if i["step"] in dp_steps]
+    assert tp_incidents and dp_incidents, sentinel.incidents
+    for inc in tp_incidents:
+        assert inc["dominant"] == "wire_slowdown", inc
+        assert inc.get("axis") == "tp" and inc.get("link_class") == "ici", inc
+    for inc in dp_incidents:
+        assert inc["dominant"] == "wire_slowdown", inc
+        assert inc.get("axis") == "dp" and inc.get("link_class") == "dcn", inc
+
+    # -- the autopilot held on tp, demoted on dp ---------------------------
+    assert pilot.verifier_rejections == 0, pilot.verifier_rejections
+    holds = [d for d in pilot.decisions if d["decision"] == "hold"]
+    tp_holds = [d for d in holds if d["step"] in tp_steps]
+    assert tp_holds and all(d.get("axis") == "tp" for d in tp_holds), holds
+    demotes = [d for d in pilot.decisions if d["decision"] == "demote_precision"]
+    assert [d["verdict"] for d in demotes] == ["canary", "committed"], demotes
+    assert demotes[0]["step"] in dp_steps and demotes[0]["axis"] == "dp", demotes
+    assert not [d for d in demotes if d["step"] in tp_steps], (
+        f"autopilot demoted during the tp collapse: {demotes}"
+    )
+    assert demotes[0]["modeled"]["chosen_ms"] < demotes[0]["modeled"]["stay_ms"]
+
+    # -- fleet + doctor carry the axis -------------------------------------
+    fleet = FleetControlPlane()
+    gang = "axis-lane"
+    fleet.gang(gang)
+    ingest = fleet.ingest_incidents(gang, sentinel.drain_incidents())
+    assert ingest["rejected"] == 0 and ingest["accepted"] == len(sentinel.incidents)
+    fleet.ingest_decisions(gang, pilot.drain_decisions())
+    row = fleet.scheduler_view()["gangs"][gang]
+    assert row["verdict"] == "regressed", row
+    assert row["last_incident"]["axis"] == "dp", row
+    assert row["last_incident"]["link_class"] == "dcn", row
+    assert row["autopilot"]["decision"] == "demote_precision", row
+    assert row["autopilot"]["axis"] == "dp", row
+    timeline_axes = {
+        item.get("axis") for item in fleet.timeline(gang)["items"]
+        if item.get("item") == "incident"
+    }
+    assert timeline_axes == {"tp", "dp"}, timeline_axes
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import perf_doctor as doctor
+
+    joined = doctor.build_incident_report(dp_incidents[-1], [])
+    assert joined["axis"] == "dp" and joined["link_class"] == "dcn", joined
+    assert joined["wire_axis_ms"], joined
+    rendered = doctor.render_report(joined)
+    assert "on mesh axis dp [dcn]" in rendered, rendered
+
+    print(
+        f"[audit] axis attribution lane passed ({len(tp_incidents)} tp/ici + "
+        f"{len(dp_incidents)} dp/dcn incidents, {len(tp_holds)} axis-scoped "
+        f"holds, demote step {demotes[0]['step']} on axis dp, gar+zero "
+        "bitwise-inert on dp4xtp2)",
+        file=sys.stderr,
+    )
+    return {
+        "ok": True,
+        "mesh": {"dp": 4, "tp": 2},
+        "bitwise_identical": True,
+        "tp_incidents": len(tp_incidents),
+        "dp_incidents": len(dp_incidents),
+        "tp_link_class": "ici",
+        "dp_link_class": "dcn",
+        "axis_partition_max_error_ms": max(axis_partition_errors),
+        "tp_holds": len(tp_holds),
+        "demote_step": demotes[0]["step"],
+        "demote_axis": demotes[0]["axis"],
+        "scheduler_last_incident": row["last_incident"],
+        "scheduler_autopilot": row["autopilot"],
+    }
+
+
 def autotune_planner_lane(fixture_path=None):
     """Recorded-span planner gate (pure cost model, no compile — CPU-safe).
 
@@ -2757,6 +3056,16 @@ def main():
     autopilot_result = None
     if args.algo is None and args.wire is None:
         autopilot_result = autopilot_lane(args.out)
+    # Per-axis wire-attribution gate: on a named dp4xtp2 mesh a tp-only and
+    # then a dp-only bandwidth collapse must be attributed to the correct
+    # mesh axis + link class (ici vs dcn), with the autopilot holding on the
+    # tp collapse (axis-scoped pricing: no exchange knob can relieve model-
+    # axis traffic) and demoting on the dp one, the per-axis split summing
+    # bitwise to wire_slowdown, and the axis ledger bitwise-inert for
+    # gar+zero.  The focused --algo/--wire lanes skip it.
+    axis_attribution_result = None
+    if args.algo is None and args.wire is None:
+        axis_attribution_result = axis_attribution_lane(args.out)
     # Recorded-span planner gate: DP partition must beat the greedy seed
     # plan's predicted exposed comm on the committed VGG16 fixture.
     planner_result = autotune_planner_lane()
@@ -2801,6 +3110,7 @@ def main():
              "fleet_sim": fleet_sim_result,
              "regression_attribution": regression_result,
              "autopilot": autopilot_result,
+             "axis_attribution": axis_attribution_result,
              "resilience": resilience_result,
              "fleet_load": fleet_load_result},
             f, indent=1,

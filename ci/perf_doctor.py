@@ -214,6 +214,12 @@ def build_incident_report(
     }
     if "straggler_rank" in incident:
         report["straggler_rank"] = incident["straggler_rank"]
+    if incident.get("axis"):
+        report["axis"] = incident["axis"]
+        if incident.get("link_class"):
+            report["link_class"] = incident["link_class"]
+        if incident.get("wire_axis_ms"):
+            report["wire_axis_ms"] = incident["wire_axis_ms"]
     return report
 
 
@@ -244,7 +250,10 @@ def render_report(report: dict) -> str:
         f"{_fmt_ms(report.get('residual_ms'))}"
         + (f" (window median wall {_fmt_ms(report['baseline_wall_ms'])})"
            if report.get("baseline_wall_ms") is not None else ""),
-        f"  dominant component: {report.get('dominant')}",
+        f"  dominant component: {report.get('dominant')}"
+        + (f" on mesh axis {report['axis']}"
+           + (f" [{report['link_class']}]" if report.get("link_class") else "")
+           if report.get("axis") else ""),
         "  budget attribution (sums to residual by construction):",
     ]
     comps = report.get("components") or {}
@@ -252,6 +261,12 @@ def render_report(report: dict) -> str:
         hint = _COMPONENT_HINTS.get(name, "")
         lines.append(f"    {name:>14}: {_fmt_ms(comps[name])}"
                      + (f"  — {hint}" if hint else ""))
+    wam = report.get("wire_axis_ms") or {}
+    if wam:
+        lines.append("  wire slowdown by mesh axis "
+                     "(sums to wire_slowdown by construction):")
+        for ax in sorted(wam, key=lambda a: -float(wam[a])):
+            lines.append(f"    {ax:>14}: {_fmt_ms(wam[ax])}")
     ctx = report.get("context") or {}
     if ctx.get("compiles"):
         steps = sorted({e.get("step") for e in ctx["compiles"]})

@@ -343,3 +343,104 @@ def test_applied_switch_rebaselines_the_sentinel():
     assert priced == pytest.approx(model_wire(
         COST_MODEL, PLAN, 8, Configuration(precision="int8")
     ))
+
+
+# -- axis-scoped pricing ------------------------------------------------------
+
+# the same flip regime, on a dp4xtp2-shaped mesh: the gradient exchange
+# rides dp (the flat/qr legs), while tp keeps its own fitted leg
+AXIS_COST_MODEL = CostModel(
+    flat=AlphaBeta(50e-6, 40e9), qr8=AlphaBeta(60e-6, 90e9),
+    axis_legs={"dp": AlphaBeta(50e-6, 40e9), "tp": AlphaBeta(10e-6, 100e9)},
+)
+
+
+def test_degraded_cost_model_is_axis_scoped():
+    # a model-axis (tp) incident leaves every exchange leg untouched and
+    # degrades only the indicted axis's own leg
+    d = degraded_cost_model(AXIS_COST_MODEL, 10.0, axis="tp",
+                            exchange_axes=("dp",))
+    assert d.flat.beta == AXIS_COST_MODEL.flat.beta
+    assert d.qr8.beta == AXIS_COST_MODEL.qr8.beta
+    assert d.axis_legs["dp"].beta == AXIS_COST_MODEL.axis_legs["dp"].beta
+    assert d.axis_legs["tp"].beta == pytest.approx(
+        AXIS_COST_MODEL.axis_legs["tp"].beta / 10.0)
+    assert d.axis_legs["tp"].alpha == AXIS_COST_MODEL.axis_legs["tp"].alpha
+    # a data-axis (dp) incident degrades the exchange legs (that IS the
+    # exchange's bandwidth) plus dp's leg, and still spares tp's
+    d = degraded_cost_model(AXIS_COST_MODEL, 10.0, axis="dp",
+                            exchange_axes=("dp",))
+    assert d.flat.beta == pytest.approx(AXIS_COST_MODEL.flat.beta / 10.0)
+    assert d.qr8.beta == pytest.approx(AXIS_COST_MODEL.qr8.beta / 10.0)
+    assert d.axis_legs["dp"].beta == pytest.approx(
+        AXIS_COST_MODEL.axis_legs["dp"].beta / 10.0)
+    assert d.axis_legs["tp"].beta == AXIS_COST_MODEL.axis_legs["tp"].beta
+    # unscoped (legacy) keeps degrading everything
+    d = degraded_cost_model(AXIS_COST_MODEL, 10.0)
+    assert d.flat.beta == pytest.approx(AXIS_COST_MODEL.flat.beta / 10.0)
+    assert d.axis_legs["tp"].beta == pytest.approx(
+        AXIS_COST_MODEL.axis_legs["tp"].beta / 10.0)
+
+
+def test_pricing_ranking_frozen_under_model_axis_collapse():
+    """The ranking flips only when the indicted axis carries the gradient
+    exchange: a tp/ICI brownout cannot be fixed by demoting the dp wire."""
+    cands = candidate_configurations(("gradient_allreduce",), ("f32", "int8"))
+    tp = price_configurations(AXIS_COST_MODEL, PLAN, 8, cands, 1.0,
+                              bandwidth_factor=10.0, axis="tp",
+                              exchange_axes=("dp",))
+    assert tp[0][0].precision == "f32"
+    dp = price_configurations(AXIS_COST_MODEL, PLAN, 8, cands, 1.0,
+                              bandwidth_factor=10.0, axis="dp",
+                              exchange_axes=("dp",))
+    assert dp[0][0].precision == "int8"
+
+
+def test_axis_scoped_incidents_hold_on_tp_demote_on_dp():
+    ddp = FakeDdp()
+    ddp.group = SimpleNamespace(exchange_size=8, data_axes=("dp",))
+    sentinel = SimpleNamespace(incidents=[], plan_version=0, budget=None)
+    pilot = GangAutopilot(
+        ddp, AXIS_COST_MODEL,
+        AutopilotConfig(compute_ms=1.0, algorithms=("gradient_allreduce",),
+                        canary_steps=3),
+        sentinel=sentinel, health=FakeHealth(),
+    )
+    # tp collapse: past hysteresis, but the exchange's economics are
+    # untouched -> an explicit hold citing the indicted axis
+    sentinel.incidents.extend(
+        [dict(_incident("tr-a"), axis="tp"), dict(_incident("tr-b"), axis="tp")]
+    )
+    pilot.tick(None, step=10, loss=1.0)
+    row = pilot.decisions[-1]
+    assert row["decision"] == "hold" and row["verdict"] == "held"
+    assert row["axis"] == "tp"
+    assert ddp.precision_applies == []
+    assert validate_metrics_event(row) == []
+    # dp collapse: the exchange IS the indicted traffic -> demote; the
+    # canary row and its commit both carry the axis
+    sentinel.incidents.extend(
+        [dict(_incident("tr-c"), axis="dp"), dict(_incident("tr-d"), axis="dp")]
+    )
+    pilot.tick(None, step=11, loss=1.0)
+    row = pilot.decisions[-1]
+    assert row["decision"] == "demote_precision" and row["verdict"] == "canary"
+    assert row["axis"] == "dp"
+    assert ddp.impl.bucket_precisions(PLAN) == ["int8"]
+    for s in range(12, 16):
+        pilot.tick(None, step=s, loss=1.0)
+    assert pilot.decisions[-1]["verdict"] == "committed"
+    assert pilot.decisions[-1]["axis"] == "dp"
+    for r in pilot.decisions:
+        assert validate_metrics_event(r) == []
+
+
+def test_axis_blind_incident_keeps_legacy_demotion():
+    """No axis on the incident (legacy 1-D gang): the whole-model
+    degradation still flips the ranking and demotes."""
+    pilot, sentinel = _pilot()
+    sentinel.incidents.extend([_incident("tr-a"), _incident("tr-b")])
+    pilot.tick(None, step=10, loss=1.0)
+    row = pilot.decisions[-1]
+    assert row["decision"] == "demote_precision"
+    assert "axis" not in row

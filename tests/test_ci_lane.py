@@ -49,6 +49,7 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert "fleet load lane passed" in proc.stderr
     assert "regression attribution lane passed" in proc.stderr
     assert "autopilot lane passed" in proc.stderr
+    assert "axis attribution lane passed" in proc.stderr
 
     # The telemetry smoke emits a JSONL metrics stream next to --out; hold it
     # to the event schema here too (belt and braces: the subprocess already
@@ -296,6 +297,24 @@ def test_perf_audit_quick_overlap_census(tmp_path):
         "demote_precision", "repromote_precision"}
     inc_traces = {e["trace_id"] for e in apev if e["event"] == "perf_regression"}
     assert all(d["trace_id"] in inc_traces for d in decisions), decisions
+
+    # Axis attribution lane: a dp4xtp2 mesh run where a tp (ici) brownout is
+    # held — model-axis wire is not repriceable by exchange demotion — and a
+    # dp (dcn) brownout demotes, with the budget's per-axis split exact and
+    # the axis/link_class fields surviving the full fleet/scheduler join.
+    ax = audit["axis_attribution"]
+    assert ax["ok"] is True
+    assert ax["mesh"] == {"dp": 4, "tp": 2}
+    assert ax["bitwise_identical"] is True
+    assert ax["axis_partition_max_error_ms"] == 0.0
+    assert ax["tp_incidents"] >= 1 and ax["tp_link_class"] == "ici"
+    assert ax["dp_incidents"] >= 1 and ax["dp_link_class"] == "dcn"
+    assert ax["tp_holds"] >= 1  # every tp incident held, never demoted
+    assert ax["demote_axis"] == "dp" and ax["demote_step"] > 0
+    assert ax["scheduler_last_incident"]["axis"] == "dp"
+    assert ax["scheduler_last_incident"]["link_class"] == "dcn"
+    assert ax["scheduler_autopilot"]["decision"] == "demote_precision"
+    assert ax["scheduler_autopilot"]["axis"] == "dp"
 
 
 def test_perf_audit_quick_bytegrad_compressed_census(tmp_path):

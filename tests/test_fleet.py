@@ -955,3 +955,70 @@ def test_fleet_incident_tier_routes_metrics_and_volatility(tmp_path):
                                rdzv_kwargs=RDZV_FAST)
     assert plane2.incidents()["n_incidents"] == 0
     assert plane2.scheduler_view()["gangs"]["inc"]["regressed"] is False
+
+def test_fleet_axis_incident_and_decision_round_trip():
+    """Axis-resolved incidents and axis-scoped autopilot decisions keep
+    their axis/link_class through the HTTP round trip: the scheduler
+    view's ``last_incident`` and ``autopilot`` columns and the timeline's
+    incident/decision items carry the fields verbatim, and axis-blind
+    payloads keep the exact legacy shape (no axis key materializes)."""
+    plane = FleetControlPlane(rdzv_kwargs=RDZV_FAST)
+    server, base = _serve(plane)
+    try:
+        fc = FleetClient(base)
+        inc = {
+            "event": "perf_regression", "ts": time.time(), "step": 60,
+            "stream": "wire_axis:dp", "dominant": "wire_slowdown",
+            "components": {"wire_slowdown": 40.0}, "residual_ms": 40.0,
+            "expected_ms": 10.0, "measured_ms": 50.0, "plan_version": 1,
+            "trace_id": "", "axis": "dp", "link_class": "dcn",
+            "wire_axis_ms": {"dp": 39.0, "tp": 1.0},
+        }
+        assert fc.push_incidents("ax", [inc])["accepted"] == 1
+        dec = {
+            "event": "plan_decision", "ts": time.time(), "step": 61,
+            "decision": "demote_precision",
+            "reason": "autopilot:wire_slowdown", "trace_id": "",
+            "plan_version": 2,
+            "from_config": {"algorithm": "gradient_allreduce",
+                            "precision": "f32"},
+            "to_config": {"algorithm": "gradient_allreduce",
+                          "precision": "int8"},
+            "verdict": "canary", "axis": "dp",
+        }
+        assert fc.push_decisions("ax", [dec])["accepted"] == 1
+
+        row = fc.scheduler_view()["gangs"]["ax"]
+        assert row["verdict"] == "regressed"
+        assert row["last_incident"] == {
+            "step": 60, "dominant": "wire_slowdown",
+            "stream": "wire_axis:dp", "axis": "dp", "link_class": "dcn",
+        }
+        assert row["autopilot"] == {
+            "decision": "demote_precision", "verdict": "canary", "step": 61,
+            "to_config": {"algorithm": "gradient_allreduce",
+                          "precision": "int8"},
+            "axis": "dp",
+        }
+
+        tl = fc.timeline("ax")
+        (tl_inc,) = [i for i in tl["items"] if i["item"] == "incident"]
+        assert tl_inc["axis"] == "dp" and tl_inc["link_class"] == "dcn"
+        assert tl_inc["wire_axis_ms"] == {"dp": 39.0, "tp": 1.0}
+        (tl_dec,) = [i for i in tl["items"] if i["item"] == "decision"]
+        assert tl_dec["axis"] == "dp"
+
+        # an axis-blind gang keeps the legacy column shapes exactly
+        legacy_inc = {k: v for k, v in inc.items()
+                      if k not in ("axis", "link_class", "wire_axis_ms")}
+        legacy_dec = {k: v for k, v in dec.items() if k != "axis"}
+        fc.push_incidents("old", [legacy_inc])
+        fc.push_decisions("old", [legacy_dec])
+        old = fc.scheduler_view()["gangs"]["old"]
+        assert old["last_incident"] == {
+            "step": 60, "dominant": "wire_slowdown",
+            "stream": "wire_axis:dp",
+        }
+        assert "axis" not in old["autopilot"]
+    finally:
+        server.shutdown()

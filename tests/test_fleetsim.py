@@ -105,3 +105,52 @@ def test_flaky_client_contains_injection():
     with pytest.raises(ConnectionError):
         fc.kv_set("k", "v")
     assert fc.injected_failures == 1
+
+
+def test_axis_scoped_collapse_surfaces_in_gang_axis_medians():
+    """With per-axis wire spans configured, an axis-scoped collapse inflates
+    ONLY that axis's gang median — the signature a per-axis regression
+    sentinel attributes — while the whole-gang inflation still never reads
+    as a straggler."""
+    cfg = _cfg(
+        windows=4,
+        axis_wire_ms={"dp": 3.0, "tp": 1.0},
+        faults=(BandwidthCollapse(gang=0, factor=8.0, axis="tp",
+                                  start_window=3, end_window=5),),
+    )
+    report = run_fleet(cfg)
+    collapsed, clean = report["gangs"][0], report["gangs"][1]
+    for w in clean["windows"]:
+        meas = w["gang_wire_axis_ms"]
+        assert set(meas) == {"dp", "tp"}
+        assert meas["dp"] == pytest.approx(3.0, rel=0.1)
+        assert meas["tp"] == pytest.approx(1.0, rel=0.1)
+    for w in collapsed["windows"][:2]:  # pre-fault: nominal on both axes
+        assert w["gang_wire_axis_ms"]["tp"] == pytest.approx(1.0, rel=0.1)
+    for w in collapsed["windows"][2:]:  # fault: tp x8, dp untouched
+        meas = w["gang_wire_axis_ms"]
+        assert meas["tp"] == pytest.approx(8.0, rel=0.1)
+        assert meas["dp"] == pytest.approx(3.0, rel=0.1)
+    assert collapsed["straggler_detections"] == []
+    assert collapsed["healthy"]
+    # deterministic like every other fleetsim report
+    assert run_fleet(cfg) == report
+
+
+def test_axis_blind_collapse_inflates_every_axis_span():
+    cfg = _cfg(
+        axis_wire_ms={"dp": 3.0, "tp": 1.0},
+        faults=(BandwidthCollapse(gang=0, factor=4.0),),
+    )
+    report = run_fleet(cfg)
+    for w in report["gangs"][0]["windows"]:
+        meas = w["gang_wire_axis_ms"]
+        assert meas["dp"] == pytest.approx(12.0, rel=0.1)
+        assert meas["tp"] == pytest.approx(4.0, rel=0.1)
+
+
+def test_legacy_scalar_wire_reports_no_axis_medians():
+    report = run_fleet(_cfg())
+    for gang in report["gangs"]:
+        for w in gang["windows"]:
+            assert "gang_wire_axis_ms" not in w

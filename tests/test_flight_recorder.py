@@ -214,9 +214,11 @@ def test_capture_program_collects_and_restores():
 
 
 def rank_dump(tmp_path, rank, n_records, *, drop_idx=None, unretired_from=None,
-              phase="wait", world_size=4):
+              phase="wait", world_size=4, axes=None):
     fr = FlightRecorder(capacity=64, rank=rank, world_size=world_size)
     program = [make_record(i, bucket=i % 3, step=i // 3) for i in range(n_records)]
+    if axes is not None:  # named-mesh engines stamp the exchange axes
+        program = [dict(rec, axes=list(axes)) for rec in program]
     if drop_idx is not None:
         program = program[:drop_idx] + program[drop_idx + 1:]
     for i, rec in enumerate(program):
@@ -277,6 +279,45 @@ def test_hang_report_straggler_vs_host_wedge(tmp_path):
     assert report["verdict"] == "host_wedge"
     assert report["per_rank"]["1"]["unretired"] == 1
     assert report["blocked_on"]["seq"] == 9
+
+
+def test_hang_report_blocked_on_carries_axes(tmp_path):
+    """On a named mesh the records carry the exchange axes; the straggler
+    verdict's ``blocked_on`` must surface them (which link a wedged gang is
+    stuck behind), and the diagnose_hang summary must print them alongside
+    any nearby axis-scoped sentinel incident."""
+    import importlib.util
+    import os
+
+    dumps = [rank_dump(tmp_path, r, 9 if r == 1 else 12, axes=["dp", "fsdp"])
+             for r in range(4)]
+    report = build_hang_report(dumps)
+    assert validate_hang_report(report) == []
+    assert report["verdict"] == "straggler"
+    assert report["blocked_on"]["axes"] == ["dp", "fsdp"]
+    # axis-blind dumps keep the legacy shape: no axes key at all
+    (tmp_path / "legacy").mkdir()
+    legacy = build_hang_report(
+        [rank_dump(tmp_path / "legacy", r, 9 if r == 1 else 12)
+         for r in range(4)])
+    assert "axes" not in legacy["blocked_on"]
+
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "ci", "diagnose_hang.py")
+    spec = importlib.util.spec_from_file_location("_diagnose_hang", script)
+    dh = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(dh)
+    incident = {
+        "event": "perf_regression", "ts": 1.0, "step": 30,
+        "stream": "wire_axis:fsdp", "dominant": "wire_slowdown",
+        "residual_ms": 9.0, "axis": "fsdp", "link_class": "dcn",
+    }
+    dh.fold_incidents(report, [incident])
+    assert report["incidents"][-1]["axis"] == "fsdp"
+    assert report["incidents"][-1]["link_class"] == "dcn"
+    text = dh.summarize(report)
+    assert "axes dpxfsdp" in text
+    assert "axis fsdp [dcn]" in text
 
 
 # -- the engine integration ---------------------------------------------------

@@ -290,3 +290,78 @@ def test_flight_records_carry_data_axes():
     assert exchange, "no exchange records captured"
     for rec in exchange:
         assert rec["axes"] == ["dp"]
+
+# -- per-axis budget partition over engine-traced programs --------------------
+
+
+@pytest.mark.parametrize(
+    "axes", [{"dp": 8}, {"dp": 4, "tp": 2}, {"dp": 4, "fsdp": 2}],
+    ids=["dp8", "dp4xtp2", "dp4xfsdp2"],
+)
+@pytest.mark.parametrize("algo_cls", [GradientAllReduceAlgorithm, ZeroAlgorithm])
+@pytest.mark.parametrize("precision", ["f32", "int8"])
+def test_axis_budget_partition_exact_over_traced_program(
+        axes, algo_cls, precision):
+    """Property, over real traced programs (gar/zero x f32/int8 x three
+    mesh shapes): the BudgetModel's per-axis wire ledger joined from the
+    captured flight program covers exactly the mesh's data axes, its scalar
+    wire promise is the ledger's sum, and the settled per-axis
+    wire_slowdown split sums BITWISE to the scalar component on every
+    pricing path — partition by construction, no tolerance."""
+    from bagua_tpu.observability import BudgetModel
+    from bagua_tpu.service.planner import AlphaBeta, CostModel
+
+    g = bagua_tpu.new_group(mesh_spec=MeshSpec(axes))
+    fr = FlightRecorder(capacity=256, rank=0, world_size=1)
+    ddp = make_ddp(g, algo=algo_cls(wire_precision=precision),
+                   telemetry=Telemetry(flight=fr))
+    state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+    state, losses = ddp.train_step(state, make_batch())
+    jax.block_until_ready(losses)
+    ddp.shutdown()
+    (program,) = ddp._flight_programs.values()
+
+    legs = {ax: AlphaBeta(0.0, 1e8 * (i + 1))
+            for i, ax in enumerate(g.data_axes)}
+    cm = CostModel(flat=AlphaBeta(0.0, 1e9), axis_legs=legs)
+    model = BudgetModel(compute_ms=6.0, cost_model=cm, program=program)
+
+    # the ledger joined from the program covers exactly the data axes the
+    # exchange rides, and the scalar promise IS its sorted-key sum
+    assert set(model.axis_wire_ms) == set(g.data_axes)
+    assert all(v > 0 for v in model.axis_wire_ms.values())
+    assert model.wire_ms == sum(
+        model.axis_wire_ms[ax] for ax in sorted(model.axis_wire_ms))
+
+    def assert_exact(budget):
+        assert set(budget.wire_axis_ms) == set(g.data_axes)
+        assert budget.components["wire_slowdown"] == sum(
+            budget.wire_axis_ms[ax] for ax in sorted(budget.wire_axis_ms))
+        assert budget.axis_partition_error_ms() == 0.0
+
+    # path 1: per-axis measured wire (enqueue->retire deltas)
+    model.note_wire(
+        sum(model.axis_wire_ms.values()) * 2.0,
+        by_axis={ax: ms * 2.0 for ax, ms in model.axis_wire_ms.items()})
+    assert_exact(model.settle(0, 20.0))
+
+    # path 2: scalar measured wire, split by the ledger's expected shares
+    model.note_wire(model.wire_ms * 3.0)
+    assert_exact(model.settle(1, 20.0))
+
+    # path 3: per-axis byte census over the program's own traffic
+    census = {ax: 0.0 for ax in g.data_axes}
+    for rec in program:
+        rec_axes = [a for a in (rec.get("axes") or ()) if a]
+        if not rec_axes or not rec.get("nbytes"):
+            continue
+        for ax in rec_axes:
+            census[ax] += float(rec["nbytes"]) / len(rec_axes)
+    assert all(v > 0 for v in census.values())
+    base = model.expected()  # clean steps must land inside the 25% band
+    for step in range(2, 7):
+        model.settle(step, base, wire_bytes_by_axis=dict(census))
+    inflated = dict(census)
+    worst = sorted(inflated)[-1]
+    inflated[worst] *= 2.0
+    assert_exact(model.settle(7, base + 4.0, wire_bytes_by_axis=inflated))

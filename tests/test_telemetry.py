@@ -1085,3 +1085,208 @@ def test_telemetry_feeds_sentinel_evidence_hooks(tmp_path):
     tel.on_rebucket(plan_version=7, n_buckets=3)
     assert sentinel.plan_version == 7
     tel.close()
+
+# -- per-axis wire attribution ------------------------------------------------
+
+
+def test_perf_regression_axis_fields_ride_schema(tmp_path):
+    """An axis-scoped incident (axis, link_class, wire_axis_ms) is the same
+    schema event with extra fields — it must validate as-is so every
+    downstream consumer (fleet push, diagnose_hang, perf_doctor) can read
+    the axis without a schema bump."""
+    sink = JsonlSink(str(tmp_path / "m.jsonl"))
+    good = {
+        "event": "perf_regression", "step": 7, "stream": "wire_axis:tp",
+        "dominant": "wire_slowdown",
+        "components": {c: 0.0 for c in BUDGET_COMPONENTS},
+        "residual_ms": 8.0, "expected_ms": 10.0, "measured_ms": 18.0,
+        "plan_version": 2, "trace_id": "",
+    }
+    sink.emit(dict(good, axis="tp", link_class="ici",
+                   wire_axis_ms={"dp": 0.2, "tp": 7.8}))
+    sink.close()
+    assert not validate_metrics_file(str(tmp_path / "m.jsonl"))
+    with open(str(tmp_path / "m.jsonl")) as f:
+        (ev,) = [json.loads(line) for line in f if line.strip()]
+    assert ev["axis"] == "tp" and ev["link_class"] == "ici"
+    assert ev["wire_axis_ms"] == {"dp": 0.2, "tp": 7.8}
+
+
+def test_budget_axis_partition_exact_on_every_pricing_path():
+    """The per-axis wire split sums BITWISE to components["wire_slowdown"]
+    on all three pricing paths (measured-by-axis, scalar-measured split by
+    expected share, per-axis byte census) — partition by construction, not
+    by tolerance."""
+    axis_promise = {"dp": 3.0, "tp": 1.0}
+
+    # path 1: per-axis measured wire — each axis's overshoot of its own
+    # promise, the scalar defined as the sorted-key sum
+    model = BudgetModel(compute_ms=6.0, axis_wire_ms=dict(axis_promise))
+    assert model.wire_ms == 4.0  # the scalar promise IS the ledger's sum
+    model.note_wire(9.2, by_axis={"dp": 7.3, "tp": 1.9})
+    budget = model.settle(0, 16.0)
+    assert budget.wire_axis_ms == pytest.approx({"dp": 4.3, "tp": 0.9})
+    assert budget.components["wire_slowdown"] == (
+        budget.wire_axis_ms["dp"] + budget.wire_axis_ms["tp"]
+    )
+    assert budget.axis_partition_error_ms() == 0.0
+    assert budget.partition_error_ms() == pytest.approx(0.0, abs=1e-12)
+
+    # path 2: scalar measured wire — proportional split by expected share,
+    # the last (sorted) axis takes the exact remainder
+    model.note_wire(9.0)
+    budget = model.settle(1, 15.0)
+    assert set(budget.wire_axis_ms) == {"dp", "tp"}
+    assert budget.components["wire_slowdown"] == 5.0
+    assert budget.wire_axis_ms["dp"] == pytest.approx(5.0 * 3.0 / 4.0)
+    assert (budget.wire_axis_ms["dp"] + budget.wire_axis_ms["tp"]) == 5.0
+    assert budget.axis_partition_error_ms() == 0.0
+
+    # path 3: per-axis byte census — each axis's excess priced on its own
+    # leg (here the ledger fallback), the scalar the sum of the parts
+    census = BudgetModel(compute_ms=6.0, axis_wire_ms=dict(axis_promise))
+    for step in range(5):
+        census.settle(step, 10.0,
+                      wire_bytes_by_axis={"dp": 1 << 20, "tp": 1 << 18})
+    budget = census.settle(5, 14.0,
+                           wire_bytes_by_axis={"dp": 1 << 21, "tp": 1 << 18})
+    # dp doubled its bytes (1x excess over baseline, priced at its 3.0 ms
+    # promise); tp stayed on baseline
+    assert budget.wire_axis_ms["dp"] == pytest.approx(3.0)
+    assert budget.wire_axis_ms["tp"] == 0.0
+    assert budget.components["wire_slowdown"] == (
+        budget.wire_axis_ms["tp"] + budget.wire_axis_ms["dp"]
+    )
+    assert budget.axis_partition_error_ms() == 0.0
+
+    # axis-blind model: empty split, legacy scalar behavior unchanged
+    legacy = BudgetModel(compute_ms=6.0, wire_ms=4.0)
+    legacy.note_wire(9.0)
+    budget = legacy.settle(0, 15.0)
+    assert budget.wire_axis_ms == {}
+    assert budget.components["wire_slowdown"] == 5.0
+    assert budget.axis_partition_error_ms() == 0.0
+    assert "wire_axis_ms" in budget.payload()
+
+
+def test_budget_priced_axis_ledger_from_program_and_cost_model():
+    """BudgetModel(program=...) joins the flight/IR records' ``axes``
+    against the planner's per-axis α–β legs; a joint multi-axis record
+    splits its bytes evenly across its axes, and axis-blind records are
+    ignored."""
+    from bagua_tpu.observability.attribution import priced_axis_wire_ms
+    from bagua_tpu.service.planner import AlphaBeta, CostModel
+
+    cm = CostModel(
+        flat=AlphaBeta(0.0, 1e9),
+        axis_legs={"dp": AlphaBeta(0.0, 1e8), "tp": AlphaBeta(0.0, 1e9)},
+    )
+    program = [
+        {"algo": "gradient_allreduce", "bucket": 0, "nbytes": 1 << 20,
+         "axes": ["dp"]},
+        {"algo": "gradient_allreduce", "bucket": 1, "nbytes": 1 << 21,
+         "axes": ["dp", "tp"]},  # joint exchange: bytes split evenly
+        {"algo": "zero", "bucket": 0, "nbytes": 1 << 20},  # axis-blind
+    ]
+    ledger = priced_axis_wire_ms(cm, program)
+    dp_bytes = (1 << 20) + (1 << 20)  # own record + half the joint one
+    assert ledger["dp"] == pytest.approx(dp_bytes / 1e8 * 1e3)
+    assert ledger["tp"] == pytest.approx((1 << 20) / 1e9 * 1e3)
+
+    model = BudgetModel(compute_ms=6.0, cost_model=cm, program=program)
+    assert model.axis_wire_ms == ledger
+    # the scalar wire promise is the sorted-key sum of the ledger — bitwise
+    assert model.wire_ms == ledger["dp"] + ledger["tp"]
+    # no axes anywhere -> no ledger, wire stays unpriced
+    blind = BudgetModel(compute_ms=6.0, cost_model=cm,
+                        program=[{"algo": "zero", "bucket": 0,
+                                  "nbytes": 1 << 20}])
+    assert blind.axis_wire_ms == {} and blind.wire_ms is None
+
+
+def test_sentinel_per_axis_stream_trips_and_names_link_class(tmp_path):
+    """A sustained single-axis wire drift (wall flat: the collapse hides
+    inside overlap slack) trips that axis's own CUSUM stream; the incident
+    names the axis and resolves its physical link class (tp -> ici)."""
+    sink = JsonlSink(str(tmp_path / "m.jsonl"))
+    sentinel = RegressionSentinel(
+        budget=BudgetModel(compute_ms=6.0,
+                           axis_wire_ms={"dp": 3.0, "tp": 1.0}),
+        sink=sink, warmup=10, threshold=8.0, cooldown=5, window=10,
+    )
+    step = 0
+    for _ in range(20):
+        sentinel.note_wire(4.0, by_axis={"dp": 3.0, "tp": 1.0})
+        sentinel.observe_step(step, 10.0)
+        step += 1
+    assert not sentinel.incidents
+    while not sentinel.incidents:
+        # tp browns out; the wall stays flat so only the axis stream sees it
+        sentinel.note_wire(10.0, by_axis={"dp": 3.0, "tp": 7.0})
+        sentinel.observe_step(step, 10.0)
+        step += 1
+        assert step < 100, "axis stream never tripped"
+    inc = sentinel.incidents[0]
+    assert inc["stream"] == "wire_axis:tp"
+    assert inc["axis"] == "tp" and inc["link_class"] == "ici"
+    assert inc["wire_axis_ms"]["tp"] > inc["wire_axis_ms"]["dp"]
+    assert sentinel.report()["axis_trips"]["tp"] >= 1
+    sink.close()
+    assert not validate_metrics_file(str(tmp_path / "m.jsonl"))
+
+    # a committed config change resets the per-axis detectors and can
+    # re-price the ledger alongside the scalar promise
+    sentinel.rebaseline(wire_ms=2.0, axis_wire_ms={"dp": 1.5, "tp": 0.5})
+    assert sentinel._axis_cusums == {}
+    assert sentinel.budget.wire_ms == 2.0
+    assert sentinel.budget.axis_wire_ms == {"dp": 1.5, "tp": 0.5}
+
+
+def test_sentinel_wall_trip_indicts_dominant_axis():
+    """A wall-stream trip whose verdict is wire-dominant picks the axis
+    with the largest windowed slowdown (dp -> dcn link class)."""
+    sentinel = RegressionSentinel(
+        budget=BudgetModel(compute_ms=6.0,
+                           axis_wire_ms={"dp": 3.0, "tp": 1.0}),
+        warmup=10, threshold=8.0, cooldown=5, window=10,
+    )
+    step = 0
+    for _ in range(20):
+        sentinel.note_wire(4.0, by_axis={"dp": 3.0, "tp": 1.0})
+        sentinel.observe_step(step, 10.0)
+        step += 1
+    while not sentinel.incidents:
+        sentinel.note_wire(12.0, by_axis={"dp": 11.0, "tp": 1.0})
+        sentinel.observe_step(step, 18.0)
+        step += 1
+        assert step < 100, "sentinel never tripped"
+    inc = sentinel.incidents[0]
+    assert inc["dominant"] == "wire_slowdown"
+    assert inc["axis"] == "dp" and inc["link_class"] == "dcn"
+    # incident-level partition: the axis split sums to the windowed
+    # wire_slowdown component up to the payload rounding
+    assert sum(inc["wire_axis_ms"].values()) == pytest.approx(
+        inc["components"]["wire_slowdown"], abs=1e-2)
+
+
+def test_telemetry_exports_per_axis_counters_and_gauges(tmp_path, monkeypatch):
+    monkeypatch.setenv("BAGUA_REGRESSION_SENTINEL", "1")
+    monkeypatch.setenv("BAGUA_REGRESSION_WARMUP", "5")
+    path = str(tmp_path / "m.jsonl")
+    tel = Telemetry(metrics_jsonl=path, flight=None)
+    for step in range(6):
+        tel.on_step(step, wall_s=0.010, n_samples=32, wire_bytes=3 << 16,
+                    wire_bytes_by_axis={"dp": 1 << 17, "tp": 1 << 16})
+    prom = tel.registry.to_prometheus()
+    assert "bagua_wire_bytes_axis_dp_total" in prom
+    assert "bagua_wire_bytes_axis_tp_total" in prom
+    assert "bagua_step_budget_wire_dp_ms" in prom
+    assert "bagua_step_budget_wire_tp_ms" in prom
+    tel.close()
+    assert not validate_metrics_file(path)
+    with open(path) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    steps = [e for e in events if e.get("event") == "step"]
+    assert steps and steps[-1]["wire_bytes_by_axis"] == {
+        "dp": 1 << 17, "tp": 1 << 16,
+    }
