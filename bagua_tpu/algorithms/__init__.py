@@ -44,6 +44,18 @@ GlobalAlgorithmRegistry.register(
     "decentralized synchronous 8-bit compressed ring weight-diff exchange",
 )
 
+from bagua_tpu.algorithms.stale import (  # noqa: F401,E402
+    StaleSyncAlgorithm,
+    StaleSyncAlgorithmImpl,
+)
+
+GlobalAlgorithmRegistry.register(
+    "stale",
+    StaleSyncAlgorithm,
+    "bounded-staleness gradient allreduce: degraded ranks replay their "
+    "previous-round buckets (error-feedback accumulated) for up to tau rounds",
+)
+
 from bagua_tpu.algorithms.q_adam import (  # noqa: F401,E402
     QAdamAlgorithm,
     QAdamAlgorithmImpl,

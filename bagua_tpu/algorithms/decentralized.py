@@ -29,14 +29,28 @@ so every rank's view of every replica stays bitwise-consistent.
 ``hierarchical=True`` (the reference default) averages over the ``intra``
 axis first and runs the decentralized exchange over the ``inter`` axis only,
 so "peers" are machines, not chips.
+
+**Eager gossip** (``staleness_tau=τ``, the BAGUA sync/async relaxation axis
+applied to this weight exchange): each round a rank still enters its
+step-indexed exchange — the collective program is unconditional, identical
+to the τ=None trace — but a rank flagged by the host-side degradation
+directive may *publish its last-synced weights* and skip folding the peer
+average into its live parameters for up to τ consecutive rounds.  Per-rank
+``staleness`` counters ride the algorithm state in-graph; at staleness τ the
+gate closes and the rank rejoins with a full exchange on round τ+1, so
+divergence is bounded by construction.  Participation is gated elementwise
+on the payload with ``jnp.where`` (a rank-varying ``lax.cond`` around a
+ppermute would deadlock SPMD), and every gossip exchange is traced under a
+``bagua_stale/tau=<τ>`` sanction frame for the static verifier.
 """
 
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from bagua_tpu.algorithms.base import Algorithm, AlgorithmImpl, OverlapCapability, StepContext
+from bagua_tpu.observability.scope_grammar import format_stale_scope
 from bagua_tpu.bucket import flatten_bucket_leaves, split_bucket_flat
 from bagua_tpu.communication import (
     ALL_AXES,
@@ -103,10 +117,65 @@ class DecentralizedAlgorithmImpl(AlgorithmImpl):
         hierarchical: bool = True,
         peer_selection_mode: str = "all",
         communication_interval: int = 1,
+        staleness_tau: Optional[int] = None,
     ):
         super().__init__(process_group, hierarchical=hierarchical)
         self.peer_selection_mode = peer_selection_mode
         self.communication_interval = communication_interval
+        if peer_selection_mode == "shift_one":
+            # Construction-time fence for the step-indexed symmetric pairing:
+            # _shift_one_perm partitions ranks into lower/upper halves, so an
+            # odd peer count would silently mis-pair (rank n//2 lands in both
+            # schedules).  Failing here names the mesh; the trace-time check
+            # in _exchange stays as the backstop for hand-built groups.
+            peers = (
+                process_group.inter_size
+                if hierarchical and process_group.intra_size > 1
+                else process_group.exchange_size
+            )
+            if peers > 1 and peers % 2 != 0:
+                raise ValueError(
+                    "peer_selection_mode='shift_one' requires an even number "
+                    f"of peers, got {peers} (group {process_group!r}); use "
+                    "peer_selection_mode='all' on odd worlds — see reference "
+                    "decentralized_full_precision_synchronous.rs:71-79"
+                )
+        if staleness_tau is not None:
+            staleness_tau = int(staleness_tau)
+            if staleness_tau < 0:
+                raise ValueError(f"staleness_tau must be >= 0, got {staleness_tau}")
+            if hierarchical:
+                raise ValueError(
+                    "gossip staleness (staleness_tau=...) requires "
+                    "hierarchical=False: the per-rank staleness gate is "
+                    "defined on the full exchange, not the intra/inter split"
+                )
+            if communication_interval != 1:
+                raise ValueError(
+                    "gossip staleness (staleness_tau=...) requires "
+                    "communication_interval=1: skipped rounds are what the "
+                    "staleness counter accounts for"
+                )
+            # published replicas are laid out per-bucket on the bound plan —
+            # instance attr (not class) so plain decentralized keeps its
+            # stateless rebucket/autotune freedom.
+            self.holds_bucketized_state = True
+        self.staleness_tau = staleness_tau
+
+    def set_staleness_tau(self, tau) -> None:
+        """Host-side τ switch (the engine's ``apply_staleness``); only valid
+        on instances constructed in gossip mode — the published/staleness
+        state must exist from init for the re-trace to see it."""
+        if self.staleness_tau is None:
+            raise ValueError(
+                "this DecentralizedAlgorithmImpl was not constructed with "
+                "staleness_tau; gossip state must be allocated at init "
+                "(pass staleness_tau=0 to construct the knob disabled)"
+            )
+        tau = int(tau)
+        if tau < 0:
+            raise ValueError(f"staleness_tau must be >= 0, got {tau}")
+        self.staleness_tau = tau
 
     def tensors_to_buckets(self, tree, bucket_size_bytes=None, filter_fn=None):
         # The reference puts ALL weights in one bucket (``decentralized.py:
@@ -127,6 +196,33 @@ class DecentralizedAlgorithmImpl(AlgorithmImpl):
             return _exchange(flat, comm_round, self.peer_selection_mode, (INTER_AXIS,))
         return _exchange(flat, comm_round, self.peer_selection_mode, ALL_AXES)
 
+    def overlap_capability(self) -> OverlapCapability:
+        if self.staleness_tau is None:
+            return super().overlap_capability()
+        # Gossip holds per-bucket published replicas (normally an overlap
+        # veto), but they are laid out ON the bound plan and the gate is
+        # elementwise — the bucket split never changes numerics, same as the
+        # stateless weight exchange.
+        return OverlapCapability(True, mode="weight", auto=True)
+
+    def init_state(self, params):
+        if self.staleness_tau is None:
+            return super().init_state(params)
+        # Last-published weights start equal to the live weights (everyone is
+        # freshly synced at init), plus the per-rank staleness counter and the
+        # host-flipped degradation directive (both stacked to (n,) by the
+        # engine).
+        plan = getattr(self, "_bound_plan", None) or self.tensors_to_buckets(params)
+        return {
+            "published": tuple(plan.bucketize(params)),
+            "staleness": jnp.zeros((), jnp.int32),
+            "directive": jnp.zeros((), jnp.int32),
+        }
+
+    def _gossip_gate(self, state):
+        tau = int(self.staleness_tau)
+        return (state["directive"] > 0) & (state["staleness"] < tau)
+
     def overlap_exchange(
         self, bucket_idx: int, grads, ctx: StepContext, params_leaves=None
     ):
@@ -138,6 +234,28 @@ class DecentralizedAlgorithmImpl(AlgorithmImpl):
         # the early-issue the reference gets from starting the exchange at
         # forward-pre and syncing post-backward.
         spec = ctx.plan.specs[bucket_idx]
+        if self.staleness_tau is not None:
+            # Gossip: the collective itself is unconditional (same ppermute /
+            # allreduce as τ=None); a gossiping-stale rank ships its published
+            # replica instead of its live weights and discards the received
+            # average, all via elementwise where on the payload.  The updated
+            # replica is stashed in ctx.extras for finalize_overlap — per-
+            # bucket state cannot return through this hook (it must hand back
+            # exactly the bucket's parameter leaves).
+            state = ctx.extras["algo_state"]
+            use_stale = self._gossip_gate(state)
+            with self.annotate(bucket_idx, "overlap"), jax.named_scope(
+                format_stale_scope(self.staleness_tau)
+            ):
+                flat = flatten_bucket_leaves(params_leaves, spec)
+                flat = jax.lax.optimization_barrier((flat,) + tuple(grads))[0]
+                payload = jnp.where(use_stale, state["published"][bucket_idx], flat)
+                avg = self._exchange_flat(payload, ctx.step)
+                new = jnp.where(use_stale, flat, avg)
+                ctx.extras.setdefault("gossip_published", {})[bucket_idx] = jnp.where(
+                    use_stale, state["published"][bucket_idx], new
+                )
+                return split_bucket_flat(new, spec)
         with self.annotate(bucket_idx, "overlap"):
             flat = flatten_bucket_leaves(params_leaves, spec)
             flat = jax.lax.optimization_barrier((flat,) + tuple(grads))[0]
@@ -154,7 +272,24 @@ class DecentralizedAlgorithmImpl(AlgorithmImpl):
                 flat = self._exchange_flat(flat, comm_round)
             return split_bucket_flat(flat, spec)
 
+    def finalize_overlap(self, grads, params, state, ctx: StepContext):
+        if self.staleness_tau is None:
+            return super().finalize_overlap(grads, params, state, ctx)
+        stashed = ctx.extras.pop("gossip_published", None)
+        if stashed is None:
+            return grads, params, state
+        use_stale = self._gossip_gate(state)
+        published = tuple(
+            stashed.get(i, p) for i, p in enumerate(state["published"])
+        )
+        staleness = jnp.where(
+            use_stale, state["staleness"] + 1, jnp.zeros_like(state["staleness"])
+        )
+        return grads, params, {**state, "published": published, "staleness": staleness}
+
     def transform_gradients(self, grads, params, state, ctx: StepContext):
+        if self.staleness_tau is not None:
+            return self._gossip_transform(grads, params, state, ctx)
         # The reference op keeps its own counter incremented once per executed
         # exchange (the `step` Mutex in decentralized_full_precision_
         # synchronous.rs), so the shift_one schedule cycles through every peer
@@ -177,6 +312,32 @@ class DecentralizedAlgorithmImpl(AlgorithmImpl):
             params = communicate(params)
         return grads, params, state
 
+    def _gossip_transform(self, grads, params, state, ctx: StepContext):
+        # Monolithic gossip round (interval fenced to 1 at construction): at
+        # τ=0 the gate is constant-False and every where() is the identity —
+        # params come out bitwise-equal to the τ=None path.
+        use_stale = self._gossip_gate(state)
+        flats = ctx.plan.bucketize(params)
+        out, new_pub = [], []
+        for i, flat in enumerate(flats):
+            with self.annotate(i, "mono"), jax.named_scope(
+                format_stale_scope(self.staleness_tau)
+            ):
+                payload = jnp.where(use_stale, state["published"][i], flat)
+                avg = self._exchange_flat(payload, ctx.step)
+                new = jnp.where(use_stale, flat, avg)
+            out.append(new)
+            new_pub.append(jnp.where(use_stale, state["published"][i], new))
+        params = ctx.plan.debucketize(out, params)
+        state = {
+            **state,
+            "published": tuple(new_pub),
+            "staleness": jnp.where(
+                use_stale, state["staleness"] + 1, jnp.zeros_like(state["staleness"])
+            ),
+        }
+        return grads, params, state
+
 
 class DecentralizedAlgorithm(Algorithm):
     def __init__(
@@ -184,10 +345,12 @@ class DecentralizedAlgorithm(Algorithm):
         hierarchical: bool = True,
         peer_selection_mode: str = "all",
         communication_interval: int = 1,
+        staleness_tau: Optional[int] = None,
     ):
         self.hierarchical = hierarchical
         self.peer_selection_mode = peer_selection_mode
         self.communication_interval = communication_interval
+        self.staleness_tau = staleness_tau
 
     def reify(self, process_group) -> DecentralizedAlgorithmImpl:
         return DecentralizedAlgorithmImpl(
@@ -195,6 +358,7 @@ class DecentralizedAlgorithm(Algorithm):
             hierarchical=self.hierarchical,
             peer_selection_mode=self.peer_selection_mode,
             communication_interval=self.communication_interval,
+            staleness_tau=self.staleness_tau,
         )
 
 

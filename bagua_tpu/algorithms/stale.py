@@ -1,0 +1,188 @@
+"""Stale-sync: bounded-staleness relaxation of the bucketed gradient ring.
+
+The sync/async axis of the BAGUA design space (paper §"system relaxations":
+synchronous ⟷ bounded-async), applied to the centralized gradient path: the
+gang stays bulk-synchronous — every rank enters every collective every round,
+so the compiled program and the per-round wire bytes are EXACTLY those of
+``gradient_allreduce`` — but a rank indicted by the gang straggler score may
+contribute its *previous-round* bucket payload for up to ``τ`` consecutive
+rounds instead of blocking the ring on its late gradients.
+
+Mechanics (all in-graph, no rank-varying control flow — a rank-conditional
+``lax.cond`` around a collective would deadlock SPMD, so participation is
+gated elementwise on the *payload* with ``jnp.where``):
+
+* ``directive`` — per-rank int32 scalar in the algorithm state (stacked to
+  ``(n,)`` by the engine), flipped host-side by
+  ``DistributedDataParallel.apply_degradation_directive`` without a
+  recompile (it is data, not code).
+* ``staleness`` — per-rank consecutive-stale-round counter.  A rank replays
+  its stale payload only while ``directive > 0 AND staleness < τ``; at
+  ``staleness == τ`` the gate closes and the rank is forced back to a fresh
+  contribution on round ``τ+1`` — divergence is bounded by construction.
+* ``stale`` — the payload this rank last pushed into the ring, one f32 flat
+  buffer per bucket (what a replay re-sends).
+* ``residual`` — error feedback: the gradient a stale round *didn't* send is
+  accumulated and re-enters the next fresh contribution, so the gradient
+  signal telescopes instead of being dropped (same algebra as the int4
+  ring's requantization residual).  Uniform update, no branch:
+
+      contrib = where(use_stale, stale_prev, g + residual)
+      residual' = residual + g - contrib     # fresh → 0, stale → accrues g
+      stale'    = where(use_stale, stale_prev, g)
+
+  The replay payload is the rank's last *raw fresh gradient*, never the
+  residual-corrected contribution: replaying the correction would feed it
+  back into the next correction (``B_k = S_k − 2·B_{k−1}`` — an
+  exponentially divergent recursion), while replaying the raw gradient
+  keeps the telescoping sum exact AND every payload bounded by a real
+  measured gradient.
+
+``τ`` is a compile-time constant of the traced step (it shapes the gate);
+``DistributedDataParallel.apply_staleness`` switches it through the same
+single-recompile machinery as a precision-plan switch.  At ``τ == 0`` the
+transform delegates verbatim to :class:`GradientAllReduceAlgorithmImpl` —
+bitwise-identical to the synchronous engine, pinned in CI.
+
+The exchange is f32-only (``set_bucket_precision`` refuses): the replay
+algebra is defined on exact flat buckets, and stacking staleness on top of
+wire quantization would compound two error-feedback loops.  Every exchange
+is traced under a ``bagua_stale/tau=<τ>`` frame
+(:func:`bagua_tpu.observability.scope_grammar.format_stale_scope`) — the
+sanction marker the static verifier keys off.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from bagua_tpu.algorithms.base import Algorithm, OverlapCapability, StepContext
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithmImpl
+from bagua_tpu.communication import (
+    ReduceOp,
+    allreduce_inplace,
+    hierarchical_allreduce_inplace,
+)
+from bagua_tpu.observability.scope_grammar import format_stale_scope
+
+
+class StaleSyncAlgorithmImpl(GradientAllReduceAlgorithmImpl):
+    #: stale/residual replicas are laid out per-bucket on the bound plan;
+    #: re-bucketing would desync them (rebucket + autotune refuse).
+    holds_bucketized_state = True
+    supports_overlap = True
+    #: the exchange program is identical with overlap on or off (monolithic
+    #: transform_gradients either way; finalize_overlap is the identity) —
+    #: overlap only keeps the engine's multi-bucket plan granularity.
+    overlap_mode = "post_step"
+    algo_name = "stale"
+
+    def __init__(
+        self,
+        process_group,
+        hierarchical: bool = False,
+        average: bool = True,
+        fuse: str = "tuple",
+        staleness_tau: int = 0,
+    ):
+        super().__init__(
+            process_group,
+            hierarchical=hierarchical,
+            average=average,
+            fuse=fuse,
+            wire_precision="f32",
+        )
+        tau = int(staleness_tau)
+        if tau < 0:
+            raise ValueError(f"staleness_tau must be >= 0, got {staleness_tau}")
+        self.staleness_tau = tau
+
+    def set_staleness_tau(self, tau) -> None:
+        """Host-side τ switch — the engine's ``apply_staleness`` calls this
+        then re-traces (τ is baked into the compiled gate)."""
+        tau = int(tau)
+        if tau < 0:
+            raise ValueError(f"staleness_tau must be >= 0, got {tau}")
+        self.staleness_tau = tau
+
+    def set_bucket_precision(self, precisions) -> None:
+        raise ValueError(
+            "StaleSyncAlgorithmImpl exchanges are f32-only: the stale-replay "
+            "error-feedback algebra is defined on exact flat buckets; use "
+            "gradient_allreduce for wire quantization"
+        )
+
+    def overlap_capability(self) -> OverlapCapability:
+        # holds_bucketized_state normally vetoes overlap (base heuristic),
+        # but the replicas here are laid out ON the bound plan and the
+        # exchange stays monolithic under overlap ("post_step": the engine
+        # calls transform_gradients either way) — overlap only preserves
+        # multi-bucket granularity, so the compiled program is identical and
+        # auto is safe.
+        return OverlapCapability(True, mode="post_step", auto=True, reason="")
+
+    def init_state(self, params):
+        # Allocated unconditionally (even at τ=0) so a later apply_staleness
+        # switch re-traces against the SAME state layout — the τ=0 fast path
+        # simply passes the state through untouched.
+        plan = getattr(self, "_bound_plan", None) or self.tensors_to_buckets(params)
+        zeros = tuple(jnp.zeros((spec.numel,), jnp.float32) for spec in plan.specs)
+        return {
+            "stale": zeros,
+            "residual": zeros,
+            "staleness": jnp.zeros((), jnp.int32),
+            "directive": jnp.zeros((), jnp.int32),
+        }
+
+    def transform_gradients(self, grads, params, state, ctx: StepContext):
+        if self.staleness_tau <= 0:
+            # Bulk sync: exactly the parent's all-f32 program (state untouched).
+            return super().transform_gradients(grads, params, state, ctx)
+        tau = int(self.staleness_tau)
+        op = ReduceOp.AVG if self.average else ReduceOp.SUM
+        reduce = hierarchical_allreduce_inplace if self.hierarchical else allreduce_inplace
+        staleness = state["staleness"]
+        use_stale = (state["directive"] > 0) & (staleness < tau)
+        flats = ctx.plan.bucketize(grads)
+        out, new_stale, new_resid = [], [], []
+        for i, flat in enumerate(flats):
+            g = flat.astype(jnp.float32)
+            contrib = jnp.where(use_stale, state["stale"][i], g + state["residual"][i])
+            with self.annotate(i, "mono"), jax.named_scope(format_stale_scope(tau)):
+                avg = reduce(contrib, op=op)
+            out.append(avg.astype(flat.dtype))
+            # replay payload = last raw fresh gradient (NOT contrib: the
+            # residual correction must never re-enter a replay, or the
+            # correction-of-correction recursion diverges exponentially)
+            new_stale.append(jnp.where(use_stale, state["stale"][i], g))
+            new_resid.append(state["residual"][i] + g - contrib)
+        grads = ctx.plan.debucketize(out, grads)
+        state = {
+            **state,
+            "stale": tuple(new_stale),
+            "residual": tuple(new_resid),
+            "staleness": jnp.where(use_stale, staleness + 1, jnp.zeros_like(staleness)),
+        }
+        return grads, params, state
+
+
+class StaleSyncAlgorithm(Algorithm):
+    def __init__(
+        self,
+        hierarchical: bool = False,
+        average: bool = True,
+        fuse: str = "tuple",
+        staleness_tau: int = 0,
+    ):
+        self.hierarchical = hierarchical
+        self.average = average
+        self.fuse = fuse
+        self.staleness_tau = staleness_tau
+
+    def reify(self, process_group) -> StaleSyncAlgorithmImpl:
+        return StaleSyncAlgorithmImpl(
+            process_group,
+            hierarchical=self.hierarchical,
+            average=self.average,
+            fuse=self.fuse,
+            staleness_tau=self.staleness_tau,
+        )

@@ -248,12 +248,73 @@ class WireModelConfig:
 # ---------------------------------------------------------------------------
 
 
+def _stale_sanctioned_ids(program: CollectiveProgram) -> set:
+    """Descriptor ids the bounded-staleness sanction clears.
+
+    A rank-conditional collective is tolerated — downgraded from error to
+    info — only when (a) it carries the ``bagua_stale/tau=<k>`` scope
+    marker (:func:`~bagua_tpu.observability.scope_grammar.format_stale_scope`),
+    and (b) **every** sibling branch of its innermost rank-conditional
+    ``cond`` moves identical wire bytes.  Under those conditions the
+    branches differ in *payload* (fresh vs last-published buckets), not in
+    whether the exchange runs, so ranks stay in lockstep on the wire and
+    the per-round byte census is preserved exactly.  Note the engine's own
+    staleness modes never trip this path at all — they gate payloads with
+    elementwise ``where`` selects, not ``cond`` — so the sanction exists
+    for hand-rolled bounded-staleness programs the descriptor marks
+    explicitly."""
+    sanctioned: set = set()
+    by_cond: Dict[str, Dict[str, List]] = {}
+    for d in program.collectives:
+        if not d.rank_conditional or d.stale is None:
+            continue
+        conds = [p for p in d.path if p.startswith("cond#")]
+        if not conds:
+            continue
+        cid, _, branch = conds[-1].partition("@")
+        by_cond.setdefault(cid, {}).setdefault(branch, []).append(d)
+    for branches in by_cond.values():
+        if len(branches) < 2:
+            continue  # single-branch: ranks could skip the exchange outright
+        signatures = {
+            tuple(sorted((d.primitive, d.wire_bytes) for d in descs))
+            for descs in branches.values()
+        }
+        if len(signatures) == 1:
+            for descs in branches.values():
+                sanctioned.update(id(d) for d in descs)
+    return sanctioned
+
+
 def check_rank_invariance(program: CollectiveProgram) -> List[Finding]:
     """No collective under a control-flow predicate that can depend on
-    rank-varying (``axis_index``-derived) values."""
+    rank-varying (``axis_index``-derived) values.
+
+    One sanctioned exception: a collective carrying the bounded-staleness
+    scope marker whose innermost rank-conditional ``cond`` has ≥2 sibling
+    branches moving identical wire bytes (see
+    :func:`_stale_sanctioned_ids`) is reported as ``info`` instead —
+    the wire program is byte-identical either way the predicate falls."""
     out = []
+    sanctioned = _stale_sanctioned_ids(program)
     for d in program.collectives:
         if not d.rank_conditional:
+            continue
+        if id(d) in sanctioned:
+            out.append(
+                Finding(
+                    check="rank_invariance",
+                    severity="info",
+                    message=(
+                        f"{d.primitive} over axes {d.axes} is "
+                        f"rank-conditional but sanctioned: bounded-staleness "
+                        f"marker tau={d.stale} with byte-identical sibling "
+                        "branches — wire census preserved per round"
+                    ),
+                    label=d.label,
+                    bucket=d.bucket,
+                )
+            )
             continue
         out.append(
             Finding(

@@ -48,6 +48,7 @@ from bagua_tpu.observability.scope_grammar import (
     parse_exchange_label,
     parse_mp_label,
     parse_qr_scope,
+    parse_stale_scope,
 )
 
 __all__ = [
@@ -121,6 +122,10 @@ class CollectiveDescriptor:
                                     #: branches of one cond apart)
     rank_conditional: bool          #: under a rank-tainted predicate
     cond_label: Optional[str]       #: label of that tainted control-flow eqn
+    #: the bound τ of an enclosing ``bagua_stale/tau=<k>`` frame, or None —
+    #: the sanctioned bounded-staleness marker ``check_rank_invariance``
+    #: accepts (with structural conditions) instead of blanket-rejecting
+    stale: Optional[int] = None
 
     @property
     def bucket(self) -> Optional[int]:
@@ -243,6 +248,7 @@ class _Walk:
                 cond_label=next(
                     (lab for _, lab, t in reversed(self.ctrl) if t), None
                 ),
+                stale=parse_stale_scope(label),
             )
         )
 

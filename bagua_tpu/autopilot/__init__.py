@@ -1,10 +1,13 @@
-"""Gang autopilot: online relaxation control over {algorithm, precision}.
+"""Gang autopilot: online relaxation control over {algorithm, precision,
+staleness}.
 
 The controller consumes attributed ``perf_regression`` incidents, the
 health monitor's stability signal and the planner's fitted α–β cost model,
 and moves the gang to the cheapest healthy configuration through the
-engine's statically-verified single-recompile switch actions.  See
-``docs/autopilot.md`` for the policy contract.
+engine's statically-verified single-recompile switch actions.  The
+staleness director runs the per-rank arm of the same loop: straggler
+attribution in, bounded-staleness degradation (with a convergence
+guardrail) out.  See ``docs/autopilot.md`` for the policy contract.
 """
 
 from bagua_tpu.autopilot.controller import AutopilotConfig, GangAutopilot
@@ -17,12 +20,20 @@ from bagua_tpu.autopilot.pricing import (
     price_configurations,
     wire_ms,
 )
+from bagua_tpu.autopilot.staleness import (
+    StalenessConfig,
+    StalenessDirector,
+    StalenessTightenAction,
+)
 
 __all__ = [
     "AutopilotConfig",
     "GangAutopilot",
     "Configuration",
     "PRECISION_RUNGS",
+    "StalenessConfig",
+    "StalenessDirector",
+    "StalenessTightenAction",
     "candidate_configurations",
     "degraded_cost_model",
     "modeled_step_ms",

@@ -120,7 +120,7 @@ class GangAutopilot:
         self._wire_evidence: List[Dict] = []
         self._last_incident_trace = ""
         self._last_wire_step: Optional[int] = None
-        self._cooldown_until = {"algorithm": -1, "precision": -1}
+        self._cooldown_until = {"algorithm": -1, "precision": -1, "staleness": -1}
         self._canary: Optional[Dict] = None
         self._loss_ewma: Optional[float] = None
         #: count of strict-verifier rejections the controller absorbed (the
@@ -139,7 +139,10 @@ class GangAutopilot:
                 # its cheapest (lowest-precision) rung
                 order = {"int4": 0, "int8": 1, "f32": 2}
                 precision = min(precs, key=lambda p: order.get(str(p), 2))
-        return Configuration(algorithm=algo, precision=str(precision))
+        tau = getattr(self.ddp.impl, "staleness_tau", None) or 0
+        return Configuration(
+            algorithm=algo, precision=str(precision), staleness=int(tau)
+        )
 
     def report(self) -> Dict:
         return {
@@ -233,6 +236,8 @@ class GangAutopilot:
             knobs.append("algorithm")
         if frm.precision != to.precision:
             knobs.append("precision")
+        if frm.staleness != to.staleness:
+            knobs.append("staleness")
         return tuple(knobs) or ("precision",)
 
     # -- ladder rungs ---------------------------------------------------------

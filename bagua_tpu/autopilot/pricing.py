@@ -58,29 +58,48 @@ PRECISION_RUNGS = ("int4", "int8", "f32")
 
 @dataclasses.dataclass(frozen=True)
 class Configuration:
-    """One point in the relaxation space the autopilot moves the gang over."""
+    """One point in the relaxation space the autopilot moves the gang over.
+
+    ``staleness`` is the bounded-staleness budget τ (0 = bulk synchronous).
+    It only exists as a knob on the algorithms that implement it
+    (``stale``'s error-feedback replay, the gossip decentralized mode);
+    ``as_dict``/``label`` omit it at 0 so existing consumers of the
+    two-field shape are unchanged."""
 
     algorithm: str = "gradient_allreduce"
     precision: str = "f32"
+    staleness: int = 0
 
-    def as_dict(self) -> Dict[str, str]:
-        return {"algorithm": self.algorithm, "precision": self.precision}
+    def as_dict(self) -> Dict:
+        d: Dict = {"algorithm": self.algorithm, "precision": self.precision}
+        if self.staleness:
+            d["staleness"] = int(self.staleness)
+        return d
 
     def label(self) -> str:
-        return f"{self.algorithm}/{self.precision}"
+        base = f"{self.algorithm}/{self.precision}"
+        return f"{base}/tau={self.staleness}" if self.staleness else base
 
 
 def candidate_configurations(
     algorithms: Sequence[str] = ("gradient_allreduce", "zero"),
     precisions: Sequence[str] = ("f32", "int8"),
+    staleness_taus: Sequence[int] = (0,),
 ) -> List[Configuration]:
     """The cross product, minus combinations that don't exist as knobs
-    (``bytegrad`` compresses unconditionally — its precision is pinned)."""
+    (``bytegrad`` compresses unconditionally — its precision is pinned;
+    nonzero ``staleness`` only composes with the algorithms that carry the
+    ``set_staleness_tau`` knob: ``stale`` and the gossip ``decentralized``
+    mode — and those exchange at f32 only)."""
     out = []
-    for algo, prec in itertools.product(algorithms, precisions):
+    for algo, prec, tau in itertools.product(algorithms, precisions, staleness_taus):
         if algo == "bytegrad":
             prec = "int8"
-        cfg = Configuration(algorithm=algo, precision=prec)
+        if tau and algo not in ("stale", "decentralized"):
+            continue
+        if algo in ("stale", "decentralized"):
+            prec = "f32"  # bounded-staleness exchanges are f32-only
+        cfg = Configuration(algorithm=algo, precision=prec, staleness=int(tau))
         if cfg not in out:
             out.append(cfg)
     return out
@@ -184,12 +203,23 @@ def modeled_step_ms(
     bandwidth_factor: float = 1.0,
     axis: Optional[str] = None,
     exchange_axes: Sequence[str] = (),
+    straggler_excess_ms: float = 0.0,
 ) -> float:
     """``compute + wire`` — the BENCH_MODELED-style whole-step prediction
     decisions are ranked on (overlap hides part of the wire in practice;
     the hidden fraction is configuration-independent enough that it cancels
-    in the ranking)."""
-    return float(compute_ms) + wire_ms(
+    in the ranking).
+
+    ``straggler_excess_ms`` is the per-step excess the gang's worst rank
+    adds over the gang-median pace (straggler-score incidents carry the
+    measurement).  Under bulk sync the whole gang pays it every step; a
+    bounded-staleness configuration lets the indicted rank skip up to τ
+    consecutive rounds, so the barrier only lands the excess every τ+1
+    rounds — the modeled charge is ``excess / (τ + 1)``.  At τ=0 this is
+    exactly the bulk-sync cost, so the term is inert for every legacy
+    candidate."""
+    excess = max(0.0, float(straggler_excess_ms)) / (int(config.staleness) + 1)
+    return float(compute_ms) + excess + wire_ms(
         cost_model, plan, n_ranks, config,
         hierarchical=hierarchical, bandwidth_factor=bandwidth_factor,
         axis=axis, exchange_axes=exchange_axes,
@@ -206,8 +236,11 @@ def price_configurations(
     bandwidth_factor: float = 1.0,
     axis: Optional[str] = None,
     exchange_axes: Sequence[str] = (),
+    straggler_excess_ms: float = 0.0,
 ) -> List[Tuple[Configuration, float]]:
-    """Every candidate with its modeled step-ms, cheapest first."""
+    """Every candidate with its modeled step-ms, cheapest first.  Cost ties
+    break toward lower staleness — never pay a convergence tax for goodput
+    the model says is free."""
     priced = [
         (
             cfg,
@@ -215,9 +248,10 @@ def price_configurations(
                 cost_model, plan, n_ranks, cfg, compute_ms,
                 hierarchical=hierarchical, bandwidth_factor=bandwidth_factor,
                 axis=axis, exchange_axes=exchange_axes,
+                straggler_excess_ms=straggler_excess_ms,
             ),
         )
         for cfg in candidates
     ]
-    priced.sort(key=lambda it: it[1])
+    priced.sort(key=lambda it: (it[1], int(it[0].staleness)))
     return priced

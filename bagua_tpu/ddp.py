@@ -479,6 +479,147 @@ class DistributedDataParallel:
             )
         return True
 
+    # -- bounded staleness (autopilot / health guardrail) --------------------
+
+    def apply_staleness(self, tau: int, reason: str = "planner") -> bool:
+        """Re-bound the staleness knob of a bounded-staleness algorithm
+        (``stale``, or ``decentralized`` constructed with ``staleness_tau``):
+        swaps τ, re-jits the step (τ shapes the compiled staleness gate), and
+        emits a schema-validated ``staleness_switch`` event — the same
+        single-recompile switch arc as :meth:`apply_precision_plan`.  Returns
+        True when τ actually changed.  Algorithms without the knob reject
+        with AttributeError; an instance whose staleness state was never
+        allocated (``staleness_tau=None`` construction) rejects with
+        ValueError from the impl."""
+        validate_switch_reason(reason)
+        impl = self.impl
+        if not hasattr(impl, "set_staleness_tau"):
+            raise AttributeError(
+                f"{type(impl).__name__} has no staleness knob; bounded "
+                "staleness applies to the stale and gossip-decentralized "
+                "algorithms"
+            )
+        tau = int(tau)
+        if tau < 0:
+            raise ValueError(f"staleness tau must be >= 0, got {tau}")
+        old_tau = getattr(impl, "staleness_tau", None)
+        impl.set_staleness_tau(tau)
+        if int(old_tau or 0) == tau:
+            return False
+        self._step_fns = {}
+        self._flight_programs = {}
+        self._predicted_programs = {}
+        try:
+            # Prove the re-bounded program before any step dispatches it.
+            self._static_reverify("apply_staleness")
+        except Exception:
+            impl.set_staleness_tau(int(old_tau or 0))
+            self._step_fns = {}
+            self._flight_programs = {}
+            self._predicted_programs = {}
+            raise
+        self._plan_source = switch_reason_family(reason)
+        if self.telemetry is not None:
+            self.telemetry.on_staleness_switch(
+                step=self._host_step if self._host_step is not None else 0,
+                plan_version=self.plan_version,
+                old_tau=int(old_tau or 0),
+                new_tau=tau,
+                reason=reason,
+            )
+        return True
+
+    def apply_degradation_directive(self, state: TrainState, ranks) -> TrainState:
+        """Flip the per-rank degradation directive of a bounded-staleness
+        algorithm WITHOUT a recompile: the directive is a stacked ``(n,)``
+        int32 leaf of the algorithm state — data, not code — so indicting or
+        clearing a rank is one host-side leaf swap.  ``ranks`` is the
+        iterable of ranks allowed to run stale (empty = everyone bulk-sync).
+        Returns the updated :class:`TrainState`; per-rank
+        ``staleness_directive_rank<r>`` gauges mirror the flip."""
+        impl = self.impl
+        if not hasattr(impl, "set_staleness_tau"):
+            raise AttributeError(
+                f"{type(impl).__name__} has no staleness knob; degradation "
+                "directives apply to the stale and gossip-decentralized "
+                "algorithms"
+            )
+        algo_state = state.algo_state
+        if not (isinstance(algo_state, dict) and "directive" in algo_state):
+            raise ValueError(
+                "algorithm state carries no 'directive' leaf — was the "
+                "engine initialized with the staleness state allocated?"
+            )
+        import numpy as np
+
+        n = self.group.size
+        flags = np.zeros((n,), np.int32)
+        for r in ranks:
+            r = int(r)
+            if not (0 <= r < n):
+                raise ValueError(f"rank {r} out of range for world size {n}")
+            flags[r] = 1
+        old = algo_state["directive"]
+        if isinstance(old, jax.Array):
+            sharding = old.sharding
+        else:
+            sharding = jax.sharding.NamedSharding(
+                self.group.mesh, P(self.group.all_axes)
+            )
+        new_leaf = jax.device_put(jnp.asarray(flags), sharding)
+        if self.telemetry is not None:
+            for r in range(n):
+                self.telemetry.registry.gauge(
+                    f"staleness_directive_rank{r}",
+                    help="1 while this rank is allowed to run stale",
+                ).set(int(flags[r]))
+        return state._replace(algo_state={**algo_state, "directive": new_leaf})
+
+    def reset_staleness_state(self, state: TrainState) -> TrainState:
+        """Re-prime the bounded-staleness replay state after a τ switch, no
+        recompile (host-side leaf swaps, like the directive flip).
+
+        Replay state frozen through a τ=0 stretch is ancient by
+        construction (the bulk-sync path never touches it), so re-raising τ
+        must not resume replay from it: the per-rank staleness counters are
+        set to the CURRENT τ — every rank under a directive is forced to a
+        fresh full contribution on its next round, which rewrites the
+        replay payload (``stale`` / ``published``) before anything can
+        replay it — and the error-feedback ``residual`` is zeroed (it
+        carries pre-switch-era gradient debris that would otherwise inject
+        into that first fresh round).  Call after :meth:`apply_staleness`
+        raises τ from 0; the staleness director does."""
+        impl = self.impl
+        if not hasattr(impl, "set_staleness_tau"):
+            raise AttributeError(
+                f"{type(impl).__name__} has no staleness knob; staleness "
+                "state applies to the stale and gossip-decentralized "
+                "algorithms"
+            )
+        algo_state = state.algo_state
+        if not (isinstance(algo_state, dict) and "staleness" in algo_state):
+            raise ValueError(
+                "algorithm state carries no 'staleness' leaf — was the "
+                "engine initialized with the staleness state allocated?"
+            )
+        import numpy as np
+
+        def _swap(leaf, host):
+            if isinstance(leaf, jax.Array):
+                return jax.device_put(jnp.asarray(host), leaf.sharding)
+            return jnp.asarray(host)
+
+        tau = int(getattr(impl, "staleness_tau", None) or 0)
+        old = algo_state["staleness"]
+        counters = np.full(jnp.shape(old), tau, np.int32)
+        new_state = {**algo_state, "staleness": _swap(old, counters)}
+        if "residual" in algo_state:
+            new_state["residual"] = jax.tree.map(
+                lambda l: _swap(l, np.zeros(l.shape, l.dtype)),
+                algo_state["residual"],
+            )
+        return state._replace(algo_state=new_state)
+
     # -- mid-training algorithm switch (autopilot) ---------------------------
 
     #: algorithms the engine can move a LIVE gang between: their state is an
@@ -712,6 +853,9 @@ class DistributedDataParallel:
                 config["bucket_precisions"] = [
                     str(p) for p in self.impl.bucket_precisions(self.plan)
                 ]
+        tau = getattr(self.impl, "staleness_tau", None)
+        if hasattr(self.impl, "set_staleness_tau") and tau is not None:
+            config["staleness_tau"] = int(tau)
         payload["config"] = config
         return payload
 
@@ -782,6 +926,13 @@ class DistributedDataParallel:
             and getattr(self.impl, "wire_precision", None) == "auto"
         ):
             self.apply_precision_plan(list(precisions), reason=reason)
+        tau = cfg.get("staleness_tau")
+        if (
+            tau is not None
+            and hasattr(self.impl, "set_staleness_tau")
+            and getattr(self.impl, "staleness_tau", None) is not None
+        ):
+            self.apply_staleness(int(tau), reason=reason)
         if source in ("planner", "health", "autopilot", "manual"):
             self._plan_source = source
 

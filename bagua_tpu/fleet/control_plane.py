@@ -552,6 +552,18 @@ class FleetControlPlane:
                 attempt = max(by_attempt, key=lambda a: max(s.step for s in by_attempt[a]))
                 summaries = by_attempt[attempt]
             straggler = straggler_score(summaries) if summaries else None
+            # per-rank score vector (p50 / gang median) — who is how far off,
+            # not only who crossed the threshold; same math as
+            # GangView.rank_scores so the scheduler and gang views agree
+            rank_scores = {}
+            if len(summaries) >= 2:
+                import statistics as _stats
+
+                median = _stats.median(s.p50_ms for s in summaries)
+                if median > 0:
+                    rank_scores = {
+                        str(s.rank): round(s.p50_ms / median, 4) for s in summaries
+                    }
             incidents = incidents_by_gang.get(gang_id, [])
             if flight_ranks:
                 verdict = "wedged"
@@ -571,6 +583,7 @@ class FleetControlPlane:
             view["gangs"][gang_id] = {
                 "verdict": verdict,
                 "straggler": straggler,
+                "rank_scores": rank_scores,
                 "regressed": bool(incidents),
                 "incidents": len(incidents),
                 "last_incident": (

@@ -116,6 +116,14 @@ class GangView:
         self.p50_median = statistics.median(p50s) if p50s else 0.0
         self.skew = (max(p50s) / self.p50_median
                      if p50s and self.p50_median > 0 else 1.0)
+        # per-rank straggler scores (each rank's p50 / gang median), not just
+        # the worst rank's — what makes a per-rank degradation decision
+        # auditable end-to-end (which ranks were how far off, not only who
+        # crossed the threshold)
+        self.rank_scores: Dict[int, float] = (
+            {s.rank: round(s.p50_ms / self.p50_median, 4) for s in self.summaries}
+            if len(self.summaries) >= 2 and self.p50_median > 0 else {}
+        )
         mfus = [s.mfu for s in self.summaries if s.mfu]
         self.mfu_mean = sum(mfus) / len(mfus) if mfus else 0.0
 
@@ -132,6 +140,7 @@ class GangView:
             "p50_skew": round(self.skew, 4),
             "mfu_mean": round(self.mfu_mean, 6),
             "straggler": self.straggler,
+            "rank_scores": {str(r): v for r, v in sorted(self.rank_scores.items())},
             "heartbeat_ages_s": {str(r): round(a, 3)
                                  for r, a in sorted(self.heartbeat_ages.items())},
             "ranks": [s.payload() for s in self.summaries],
@@ -155,6 +164,9 @@ class GangView:
             self.straggler["rank"] if self.straggler else -1)
         g("gang_straggler_score", help="straggler p50 / gang median (0 when none)").set(
             self.straggler["score"] if self.straggler else 0.0)
+        for r, score in sorted(self.rank_scores.items()):
+            g(f"gang_straggler_score_rank{r}",
+              help="this rank's step-wall p50 / gang median p50").set(score)
         for r, age in sorted(self.heartbeat_ages.items()):
             g(f"gang_heartbeat_age_s_rank{r}",
               help="seconds since this rank's last rendezvous heartbeat").set(

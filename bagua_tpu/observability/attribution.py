@@ -200,6 +200,11 @@ class BudgetModel:
         self._straggler_rank = -1
         self._measured_wire_ms: Optional[float] = None
         self._measured_wire_axis_ms: Optional[Dict[str, float]] = None
+        # ranks running under a bounded-staleness degradation directive:
+        # their excess over the gang median is the *expected* behavior (the
+        # gang paces at the median, not the straggler's max), so straggler
+        # evidence naming them must not charge the budget
+        self._degraded_ranks: set = set()
 
     @classmethod
     def from_meter(cls, meter, compute_ms: Optional[float] = None,
@@ -241,9 +246,21 @@ class BudgetModel:
 
     def note_straggler(self, excess_ms: float, rank: int = -1) -> None:
         """The gang aggregator attributed this window to a straggling rank;
-        ``excess_ms`` is its p50 over the gang median."""
+        ``excess_ms`` is its p50 over the gang median.  Evidence naming a
+        rank the engine already degraded to bounded-staleness exchange is
+        dropped: under degradation the gang steps at the *median* pace by
+        construction, so the indicted rank's excess no longer stretches the
+        step wall and must not trip the sentinel again."""
+        if int(rank) in self._degraded_ranks:
+            return
         self._straggler_ms = max(self._straggler_ms, max(0.0, float(excess_ms)))
         self._straggler_rank = int(rank)
+
+    def mark_degraded(self, ranks) -> None:
+        """Replace the set of ranks running under a degradation directive
+        (``mark_degraded(())`` clears it, e.g. after the guardrail returns
+        the gang to bulk sync)."""
+        self._degraded_ranks = {int(r) for r in ranks}
 
     def note_wire(self, measured_wire_ms: float,
                   by_axis: Optional[Dict[str, float]] = None) -> None:

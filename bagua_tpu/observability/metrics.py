@@ -234,6 +234,15 @@ EVENT_PAYLOAD_FIELDS = {
         "new_precisions": list,
         "reason": str,
     },
+    # the engine re-bounded the staleness knob (autopilot degradation, the
+    # HealthMonitor convergence guardrail tightening tau to 0, or a
+    # stabilization re-promotion): before/after bound plus who asked
+    "staleness_switch": {
+        "plan_version": int,
+        "old_tau": int,
+        "new_tau": int,
+        "reason": str,
+    },
     # the health monitor detected an anomaly (kind: loss_spike /
     # grad_norm_explosion / nonfinite); actions lists the registered
     # correctives that reported applying (e.g. precision_demotion)

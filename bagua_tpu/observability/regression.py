@@ -178,6 +178,9 @@ class RegressionSentinel:
     def note_straggler(self, excess_ms: float, rank: int = -1) -> None:
         self.budget.note_straggler(excess_ms, rank=rank)
 
+    def mark_degraded(self, ranks) -> None:
+        self.budget.mark_degraded(ranks)
+
     def note_wire(self, measured_wire_ms: float,
                   by_axis: Optional[Dict[str, float]] = None) -> None:
         self.budget.note_wire(measured_wire_ms, by_axis=by_axis)

@@ -21,8 +21,12 @@ The three label forms::
 
 plus the quantized-ring sub-scopes nested *inside* a bucket-exchange frame
 (``qr8_quant``, ``qr8_hop3``, ``qr4_ag`` — see
-:mod:`bagua_tpu.kernels.quantized_ring`) and the overlap backward anchor
-``bagua_overlap_bwd/bucket=<i>`` (:mod:`bagua_tpu.bucket`).
+:mod:`bagua_tpu.kernels.quantized_ring`), the overlap backward anchor
+``bagua_overlap_bwd/bucket=<i>`` (:mod:`bagua_tpu.bucket`), and the
+bounded-staleness frame ``bagua_stale/tau=<k>`` wrapping every exchange a
+stale-sync/gossip algorithm issues — the sanction marker the static
+verifier's taint analysis keys off (a rank-conditional collective inside a
+stale frame is bounded-by-construction, not a divergence bug).
 
 Field separators are ``/`` (the scope-nesting separator, which XLA joins
 verbatim into ``op_name``) and ``=``; characters like ``@`` are truncated
@@ -35,25 +39,30 @@ from typing import Dict, Optional, Tuple
 __all__ = [
     "EXCHANGE_PREFIX",
     "STEP_PREFIX",
+    "STALE_PREFIX",
     "EXCHANGE_RE",
     "STEP_RE",
     "MP_RE",
     "QR_RE",
     "OVERLAP_BWD_RE",
+    "STALE_RE",
     "format_exchange_label",
     "format_mp_label",
     "format_step_label",
+    "format_stale_scope",
     "parse_exchange_label",
     "parse_mp_label",
     "parse_step_phase",
     "parse_qr_scope",
     "parse_overlap_bwd",
+    "parse_stale_scope",
     "hlo_op_labels",
 ]
 
 #: scope-name prefixes (kept short: every annotated HLO op carries them)
 EXCHANGE_PREFIX = "bagua_ex"
 STEP_PREFIX = "bagua_step"
+STALE_PREFIX = "bagua_stale"
 
 EXCHANGE_RE = re.compile(
     EXCHANGE_PREFIX + r"/algo=(?P<algo>[^/]+)/bucket=(?P<bucket>\d+)/phase=(?P<phase>[^/\"]+)"
@@ -66,6 +75,9 @@ MP_RE = re.compile(
 QR_RE = re.compile(r"qr(?P<bits>\d+)_(?P<stage>quant|ag|hop(?P<hop>\d+))")
 #: the custom_vjp backward anchor wrapping each bucket's overlap exchange
 OVERLAP_BWD_RE = re.compile(r"bagua_overlap_bwd/bucket=(?P<bucket>\d+)")
+#: the bounded-staleness sanction frame (τ = the staleness bound the
+#: algorithm was compiled at)
+STALE_RE = re.compile(STALE_PREFIX + r"/tau=(?P<tau>\d+)")
 
 
 # -- formatters (the single way a label string is ever built) -----------------
@@ -85,6 +97,13 @@ def format_mp_label(axis: str, phase: str) -> str:
 
 def format_step_label(phase: str) -> str:
     return f"{STEP_PREFIX}/phase={phase}"
+
+
+def format_stale_scope(tau) -> str:
+    """Render the bounded-staleness frame a stale-sync/gossip exchange is
+    traced under — the marker :func:`parse_stale_scope` (and through it the
+    static verifier's sanction) recovers from the jaxpr name stack."""
+    return f"{STALE_PREFIX}/tau={int(tau)}"
 
 
 # -- parsers ------------------------------------------------------------------
@@ -136,6 +155,12 @@ def parse_overlap_bwd(op_name: str) -> Optional[int]:
     """Bucket index of a ``bagua_overlap_bwd`` backward anchor, if present."""
     m = OVERLAP_BWD_RE.search(op_name or "")
     return int(m.group("bucket")) if m else None
+
+
+def parse_stale_scope(op_name: str) -> Optional[int]:
+    """The staleness bound τ of a ``bagua_stale`` frame, if present."""
+    m = STALE_RE.search(op_name or "")
+    return int(m.group("tau")) if m else None
 
 
 # -- the HLO join table -------------------------------------------------------

@@ -593,6 +593,52 @@ class Telemetry:
                  "reason": str(reason)}
             )
 
+    def on_staleness_switch(
+        self,
+        step: int,
+        plan_version: int,
+        old_tau: int,
+        new_tau: int,
+        reason: str = "planner",
+    ) -> None:
+        """The engine re-bounded the staleness knob
+        (``DistributedDataParallel.apply_staleness``): the autopilot degraded
+        a straggling gang to bounded-staleness exchange, the HealthMonitor
+        guardrail tightened τ back to 0 on a convergence alert, or a
+        stabilization window re-promoted it.  Exported as the
+        ``staleness_switch_total`` counter, a per-reason-family counter, the
+        live ``staleness_tau`` gauge, and a schema-validated
+        ``staleness_switch`` JSONL event."""
+        from bagua_tpu.observability.metrics import switch_reason_family
+
+        r = self.registry
+        r.counter(
+            "staleness_switch_total",
+            help="bounded-staleness bound (tau) swaps adopted by the engine",
+        ).inc()
+        r.counter(
+            f"staleness_switch_reason_{switch_reason_family(reason)}_total",
+            help="staleness bound swaps by requesting reason family",
+        ).inc()
+        r.gauge(
+            "staleness_tau",
+            help="current bounded-staleness bound (0 = bulk synchronous)",
+        ).set(int(new_tau))
+        if self.regression is not None:
+            self.regression.plan_version = int(plan_version)
+        if self.tracer is not None:
+            self.tracer.record_event(
+                "staleness_switch",
+                attrs={"plan_version": int(plan_version), "reason": str(reason)},
+            )
+        if self.jsonl:
+            self.jsonl.emit(
+                {"event": "staleness_switch", "step": int(step),
+                 "plan_version": int(plan_version),
+                 "old_tau": int(old_tau), "new_tau": int(new_tau),
+                 "reason": str(reason)}
+            )
+
     def on_plan_decision(
         self,
         step: int,

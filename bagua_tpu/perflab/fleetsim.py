@@ -56,7 +56,15 @@ __all__ = [
 
 @dataclasses.dataclass(frozen=True)
 class Straggler:
-    """One rank's phase runs ``factor`` slow over ``[start, end)`` windows."""
+    """One rank's phase runs ``factor`` slow over ``[start, end)`` windows.
+
+    ``ramp_windows`` makes the fault *transient-shaped*: instead of landing
+    at the full factor on its first active window, the slowdown climbs
+    linearly over ``ramp_windows`` windows before plateauing (onset →
+    ramp → plateau → heal at ``end_window``).  The straggler-tolerance
+    lane uses this to prove the degradation ladder rides the whole arc:
+    indictment on the ramp, bounded-staleness at the plateau, and the
+    guardrail's return to bulk sync after the fault clears."""
 
     gang: int
     rank: int
@@ -64,11 +72,24 @@ class Straggler:
     phase: str = "wire"  #: "wire" or "compute" — attribution target
     start_window: int = 0
     end_window: Optional[int] = None
+    ramp_windows: int = 0
 
     def active(self, window: int) -> bool:
         return self.start_window <= window and (
             self.end_window is None or window < self.end_window
         )
+
+    def effective_factor(self, window: int) -> float:
+        """The slowdown this window actually applies: 1.0 outside the
+        active span, a linear climb toward ``factor`` during the ramp,
+        the full factor at the plateau."""
+        if not self.active(window):
+            return 1.0
+        elapsed = window - self.start_window
+        if elapsed < self.ramp_windows:
+            frac = (elapsed + 1) / (self.ramp_windows + 1)
+            return 1.0 + (self.factor - 1.0) * frac
+        return self.factor
 
 
 @dataclasses.dataclass(frozen=True)
@@ -224,13 +245,14 @@ def _rank_step_ms(
             else:
                 wire *= f.factor
         elif isinstance(f, Straggler) and f.rank == rank:
+            eff = f.effective_factor(window)
             if f.phase == "compute":
-                compute *= f.factor
+                compute *= eff
             else:
-                wire *= f.factor
+                wire *= eff
                 if axis_parts is not None:
                     for ax in axis_parts:
-                        axis_parts[ax] *= f.factor
+                        axis_parts[ax] *= eff
     jitter = 1.0 + 0.03 * (2.0 * rng.random() - 1.0)
     phase_ms = {"compute": round(compute * jitter, 6),
                 "wire": round(wire * jitter, 6)}
@@ -488,8 +510,15 @@ def gang_faults(cfg: FleetConfig, gang: int, kind) -> List:
 
 
 def _expected_ratio(cfg: FleetConfig, f: Straggler) -> float:
+    """Peak whole-step slowdown this fault reaches inside the simulated
+    window range (a transient straggler whose ramp never plateaus before
+    ``end_window`` — or whose active span misses the run — peaks lower
+    than its nominal factor)."""
     wire = cfg.base_wire_ms()
     base = cfg.compute_ms + wire
+    peak = max(
+        f.effective_factor(w) for w in range(1, cfg.windows + 1)
+    )
     if f.phase == "compute":
-        return (cfg.compute_ms * f.factor + wire) / base
-    return (cfg.compute_ms + wire * f.factor) / base
+        return (cfg.compute_ms * peak + wire) / base
+    return (cfg.compute_ms + wire * peak) / base

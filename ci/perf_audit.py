@@ -186,6 +186,12 @@ VARIANTS = {
     # zero full-precision all-reduces anywhere in the step.
     "gradient_allreduce[int8]": ({"wire_precision": "int8"}, {"overlap": False}),
     "gradient_allreduce[int4]": ({"wire_precision": "int4"}, {"overlap": False}),
+    # Bounded-staleness exchange at tau=2: participation is gated on the
+    # *payload* (jnp.where on the contribution), never on control flow, so
+    # the census must show exactly the gradient_allreduce wire program —
+    # same all-reduce count, same f32 bytes (assert_stale_census).
+    "stale": ({"staleness_tau": 2}, {"overlap": False}),
+    "stale[overlap]": ({"staleness_tau": 2}, {"overlap": True}),
 }
 
 # Compressed/decentralized overlap rows paired with their monolithic
@@ -1484,6 +1490,408 @@ def autopilot_lane(out_prefix: str):
     }
 
 
+def _stale_bitwise_gate(group):
+    """τ=0 must be *bitwise* the synchronous engine, overlap on — for both
+    bounded-staleness families: ``stale`` vs ``gradient_allreduce``, and the
+    gossip ``decentralized`` mode (staleness knob allocated, τ=0) vs the
+    plain decentralized exchange.  Any drift here means the relaxation is
+    not actually off at τ=0."""
+    from bagua_tpu.algorithms import build_algorithm
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+
+    params = init_mlp(jax.random.PRNGKey(11), [64, 128, 128, 64])
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.rand(8 * group.size, 64).astype(np.float32))
+    y = jnp.asarray(rng.rand(8 * group.size, 64).astype(np.float32))
+
+    def run(algo):
+        ddp = DistributedDataParallel(
+            loss_fn=mse_loss, optimizer=optax.sgd(0.01, momentum=0.9),
+            algorithm=algo, process_group=group,
+            bucket_size_bytes=1 << 16, overlap="auto",
+        )
+        state = ddp.init(params)
+        for _ in range(6):
+            state, _ = ddp.train_step(state, (x, y))
+        leaves = [np.asarray(l) for l in jax.tree.leaves(state.params)]
+        overlap = ddp.overlap_enabled
+        ddp.shutdown()
+        return leaves, overlap
+
+    pairs = (
+        ("stale[tau=0]", build_algorithm("stale"),
+         "gradient_allreduce", build_algorithm("gradient_allreduce")),
+        ("decentralized[gossip,tau=0]",
+         build_algorithm("decentralized", hierarchical=False,
+                         staleness_tau=0),
+         "decentralized",
+         build_algorithm("decentralized", hierarchical=False)),
+    )
+    checked = []
+    for name_a, algo_a, name_b, algo_b in pairs:
+        a, overlap_a = run(algo_a)
+        b, overlap_b = run(algo_b)
+        assert overlap_a and overlap_b, (
+            f"{name_a}/{name_b}: the bitwise gate must run with overlap on "
+            f"(got {overlap_a}/{overlap_b})"
+        )
+        assert len(a) == len(b)
+        for la, lb in zip(a, b):
+            assert la.dtype == lb.dtype and np.array_equal(la, lb), (
+                f"tau=0 must be bitwise-identical to the synchronous engine: "
+                f"{name_a} diverged from {name_b}"
+            )
+        checked.append(f"{name_a}=={name_b}")
+    return checked
+
+
+def straggler_tolerance_lane(out_prefix: str):
+    """Executed straggler-tolerance gate: bounded staleness, end to end.
+
+    A real 8-rank engine running the ``stale`` algorithm at τ=0 (bulk
+    synchronous) trains a small MLP while a fleetsim gang supplies the
+    step-wall signal: rank 2 runs a *transient* 1.5× compute straggle
+    (onset ramp below the detection threshold, plateau, heal), the gang
+    aggregator's straggler score indicts it, and the
+    :class:`StalenessDirector` closes the per-rank degradation loop with
+    real recompiles under ``BAGUA_STATIC_VERIFY=strict``.
+
+    The contract asserted:
+
+    * τ=0 is **bitwise-identical** to the synchronous engine (both the
+      ``stale`` and the gossip decentralized family, overlap on);
+    * straggler-dominant incidents (citing rank + ``trace_id``) drive a
+      ``degrade_staleness`` decision whose modeled step-ms is strictly
+      below stay-put — and once degraded, the fed step wall tracks the
+      gang *median*, not the straggler's max, so the sentinel stops
+      indicting the rank it already relieved;
+    * the per-rank staleness counters prove the τ bound: the degraded
+      rank skips at most τ consecutive rounds, is forced back to a fresh
+      contribution on round τ+1, and its modeled *accounting* bytes drop
+      to ~1/(τ+1) of a healthy rank's while the traced per-round wire
+      bytes stay exact;
+    * an injected loss spike fires the :class:`HealthMonitor` guardrail
+      (:class:`StalenessTightenAction`): τ snaps to 0 in one verified
+      recompile, and staleness is only re-promoted after the
+      stabilization windows pass;
+    * after the fault heals, the director restores bulk sync end to end
+      (τ=0, directive cleared, budget back to worst-rank pacing);
+    * the α–β model prices both bounded-staleness families strictly
+      under bulk sync at the incident's measured excess;
+    * zero strict-verifier rejections, schema-valid metrics, and the
+      fleet control plane carries the director's verdict.
+
+    tests/test_ci_lane.py greps the stderr sentinel and re-checks the
+    audit fields.
+    """
+    import bagua_tpu
+    from bagua_tpu.algorithms import build_algorithm
+    from bagua_tpu.autopilot import (
+        Configuration, StalenessConfig, StalenessDirector,
+        StalenessTightenAction, modeled_step_ms,
+    )
+    from bagua_tpu.ddp import DistributedDataParallel
+    from bagua_tpu.fleet.control_plane import FleetControlPlane
+    from bagua_tpu.models.mlp import init_mlp, mse_loss
+    from bagua_tpu.observability import (
+        BudgetModel, HealthConfig, HealthMonitor, RegressionSentinel,
+        Telemetry, validate_metrics_file,
+    )
+    from bagua_tpu.perflab.fleetsim import FleetConfig, Straggler, run_fleet
+    from bagua_tpu.service.planner import AlphaBeta, CostModel
+
+    # compute-heavy operating point: a 1.5x compute straggler reaches a 1.4
+    # whole-step ratio (detectable at straggler_factor=1.25) while its
+    # one-window onset ramp (1.25x compute = 1.2 whole-step) stays below
+    # the detection threshold — indictment lands at the plateau, by design
+    COMPUTE_MS, WIRE_MS, STEPS_PER_WINDOW = 8.0, 2.0, 20
+    TAU = 2
+    os.environ["BAGUA_STATIC_VERIFY"] = "strict"
+    try:
+        group = bagua_tpu.init_process_group(intra_size=4)
+        bitwise_checked = _stale_bitwise_gate(group)
+
+        metrics_path = out_prefix + "_straggler_metrics.jsonl"
+        if os.path.exists(metrics_path):
+            os.remove(metrics_path)  # append-mode sink: fresh stream
+        tel = Telemetry(metrics_jsonl=metrics_path, flight=None)
+        ddp = DistributedDataParallel(
+            loss_fn=mse_loss, optimizer=optax.sgd(0.01),
+            algorithm=build_algorithm("stale"),  # τ=0 until indicted
+            process_group=group, bucket_size_bytes=1 << 16, overlap="auto",
+            telemetry=tel,
+        )
+        params = init_mlp(jax.random.PRNGKey(7), [64, 128, 128, 64])
+        state = ddp.init(params)
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.rand(8 * group.size, 64).astype(np.float32))
+        y = jnp.asarray(rng.rand(8 * group.size, 64).astype(np.float32))
+
+        total_nbytes = sum(s.nbytes for s in ddp.plan.specs)
+        cm = CostModel(
+            flat=AlphaBeta(alpha=0.0, beta=total_nbytes / (WIRE_MS * 1e-3)),
+        )
+        sentinel = RegressionSentinel(
+            budget=BudgetModel(compute_ms=COMPUTE_MS, wire_ms=WIRE_MS),
+            sink=tel.jsonl, registry=tel.registry,
+            warmup=20, threshold=8.0, cooldown=0, window=20,
+        )
+        # stale-sync replay produces benign loss wobble against a tiny EWMA
+        # std; a hair-trigger z would tighten τ on noise and steal the
+        # injected spike's guardrail arc.  z=25 ignores the wobble while the
+        # ×50 injected spike still lands orders of magnitude above it.
+        health = HealthMonitor(
+            telemetry=tel, config=HealthConfig(loss_z_threshold=25.0))
+        health.register_action(StalenessTightenAction(ddp))
+        director = StalenessDirector(
+            ddp,
+            StalenessConfig(tau=TAU, hysteresis_incidents=2,
+                            cooldown_steps=10, repromote_windows=15,
+                            heal_patience=100),
+            sentinel=sentinel, health=health, telemetry=tel, cost_model=cm,
+        )
+
+        # the fleet signal: rank 2's transient compute straggle — one ramp
+        # window (below detection), four plateau windows, heal at window 8
+        fault = Straggler(gang=0, rank=2, factor=1.5, phase="compute",
+                          start_window=3, end_window=8, ramp_windows=1)
+        sim = run_fleet(FleetConfig(
+            n_gangs=1, ranks_per_gang=4, windows=10, seed=1,
+            compute_ms=COMPUTE_MS, wire_ms=WIRE_MS,
+            steps_per_window=STEPS_PER_WINDOW, straggler_factor=1.25,
+            faults=(fault,),
+        ))
+        gang_sim = sim["gangs"][0]
+        assert gang_sim["healthy"], gang_sim["errors"]
+        windows = gang_sim["windows"]
+        detected = sorted(w["window"] for w in windows if w.get("straggler"))
+        plateau = set(range(fault.start_window + fault.ramp_windows,
+                            fault.end_window))
+        assert set(detected) == plateau, (
+            f"the score must indict exactly the plateau windows {sorted(plateau)} "
+            f"(ramp below threshold, healed after): {detected}"
+        )
+
+        fault_end_step = (fault.end_window - 1) * STEPS_PER_WINDOW
+        SPIKE_STEP = 5 * STEPS_PER_WINDOW + 10  # mid window 6: τ=2 adopted
+        step = 0
+        stale_counters = []  # (step, τ, stacked per-rank staleness counters)
+        for w, wv in enumerate(windows, start=1):
+            gang_p50 = float(wv["gang_p50_ms"])
+            straggler = wv.get("straggler")
+            excess = (
+                max(0.0, float(straggler["p50_ms"])
+                    - float(straggler["gang_median_ms"]))
+                if straggler else 0.0
+            )
+            for _ in range(STEPS_PER_WINDOW):
+                state, losses = ddp.train_step(state, (x, y))
+                loss = float(np.asarray(losses).mean())
+                if step == SPIKE_STEP:
+                    loss *= 50.0  # the injected convergence anomaly
+                if straggler:
+                    sentinel.note_straggler(excess,
+                                            rank=int(straggler["rank"]))
+                # bulk sync barriers on the straggler's max every step; a
+                # degraded gang paces at its median (the skipped rank no
+                # longer blocks the ring) — the goodput claim under test
+                degraded = (bool(director.degraded_ranks)
+                            and director.current_tau() > 0)
+                wall = gang_p50 if degraded else gang_p50 + excess
+                sentinel.observe_step(step, wall, host_ms=0.1,
+                                      trace_id=f"stale-lane-w{w}-s{step}")
+                health.observe(step, loss, grad_norm=1.0, nonfinite=0)
+                state = director.tick(state, step)
+                if director.degraded_ranks:
+                    stale_counters.append((
+                        step, director.current_tau(),
+                        np.asarray(state.algo_state["staleness"]),
+                    ))
+                step += 1
+        jax.block_until_ready(state.params)
+        tel.close()
+        ddp.shutdown()
+    finally:
+        os.environ.pop("BAGUA_STATIC_VERIFY", None)
+
+    # -- the degradation ladder rode the whole arc ----------------------------
+    rejected = [d for d in director.decisions if d["verdict"] == "rejected"]
+    assert not rejected, f"strict verifier rejected staleness moves: {rejected}"
+    by_kind = {}
+    for d in director.decisions:
+        by_kind.setdefault(d["decision"], []).append(d)
+    degrades = by_kind.get("degrade_staleness", [])
+    assert degrades and degrades[0]["verdict"] == "committed", degrades
+    degrade = degrades[0]
+    assert degrade["ranks"] == [fault.rank], degrade
+    assert degrade["reason"] == "autopilot:straggler"
+    assert degrade["to_config"]["staleness"] == TAU, degrade
+    assert degrade["modeled"]["chosen_ms"] < degrade["modeled"]["stay_ms"], (
+        f"degradation must model strictly below stay-put: {degrade['modeled']}"
+    )
+    straggler_incidents = [
+        i for i in sentinel.incidents if i["dominant"] == "straggler"
+    ]
+    assert straggler_incidents, "straggle never attributed to a straggler"
+    assert all(i["straggler_rank"] == fault.rank for i in straggler_incidents)
+    incident_traces = {i["trace_id"] for i in sentinel.incidents}
+    assert degrade["trace_id"] in incident_traces, degrade
+    for d in director.decisions:
+        if d["trace_id"]:
+            assert d["trace_id"] in incident_traces, d
+    # once degraded, the gang paces at its median: the sentinel must stop
+    # indicting the rank the engine already relieved
+    assert max(i["step"] for i in straggler_incidents) <= degrade["step"], (
+        "straggler incidents kept tripping after the degradation"
+    )
+
+    # -- the guardrail arc: spike -> tighten -> stabilize -> re-promote -------
+    spike = next(
+        (a for a in health.alerts
+         if a["kind"] == "loss_spike" and a["step"] == SPIKE_STEP), None,
+    )
+    assert spike is not None, health.alerts
+    assert "staleness_tighten" in spike["actions"], spike
+    repromotes = by_kind.get("repromote_staleness", [])
+    assert repromotes and repromotes[0]["verdict"] == "committed", repromotes
+    assert repromotes[0]["reason"] == "autopilot:stabilized"
+    assert repromotes[0]["step"] > SPIKE_STEP
+    restores = by_kind.get("restore_bulk_sync", [])
+    assert restores and restores[0]["verdict"] == "committed", restores
+    assert restores[0]["step"] > fault_end_step, (
+        f"bulk sync restored at step {restores[0]['step']}, before the fault "
+        f"healed at step {fault_end_step}"
+    )
+    assert restores[0]["ranks"] == [fault.rank]
+    assert director.current_tau() == 0 and not director.degraded_ranks, (
+        director.report()
+    )
+
+    # -- the staleness bound + the accounting ledger --------------------------
+    # counter semantics (observed after each step): +1 = the rank replayed
+    # its previous-round payload (0 accounting bytes); 0 = a fresh full
+    # contribution.  The bound: never above τ, and a rank held at τ is
+    # forced back to a fresh exchange on round τ+1.  A τ switch re-primes
+    # the counters to τ (reset_staleness_state) — classify only across
+    # consecutive same-τ samples so the re-prime jumps don't count.
+    healthy_rank = next(r for r in range(group.size) if r != fault.rank)
+    ledger = {fault.rank: 0, healthy_rank: 0}
+    prev = None  # (step, tau, counter)
+    skipped = fresh = 0
+    for s, tau_now, counters in stale_counters:
+        cur = int(counters[fault.rank])
+        if tau_now > 0:
+            assert cur <= TAU, (
+                f"staleness bound violated: counter {cur} > τ={TAU}"
+            )
+        if (prev is None or tau_now <= 0 or prev[0] != s - 1
+                or prev[1] != tau_now):
+            prev = (s, tau_now, cur)
+            continue
+        if cur == prev[2] + 1:
+            skipped += 1  # replayed round: zero accounting bytes
+        else:
+            assert cur == 0, (prev, cur)
+            fresh += 1
+            ledger[fault.rank] += total_nbytes
+        if prev[2] == TAU:
+            assert cur == 0, (
+                f"rank held at τ={TAU} must be forced to exchange on round "
+                f"τ+1, counter went {prev[2]} -> {cur}"
+            )
+        assert int(counters[healthy_rank]) == 0, (
+            "healthy rank's staleness counter moved"
+        )
+        ledger[healthy_rank] += total_nbytes  # healthy: full bytes every round
+        prev = (s, tau_now, cur)
+    assert skipped > 0 and fresh > 0, (skipped, fresh)
+    assert skipped <= TAU * fresh, (
+        f"{skipped} skipped rounds vs {fresh} fresh: more than τ per cycle"
+    )
+    assert ledger[fault.rank] <= 0.5 * ledger[healthy_rank], (
+        f"degraded rank's accounting bytes {ledger[fault.rank]} not below "
+        f"the healthy rank's {ledger[healthy_rank]}"
+    )
+
+    # -- modeled goodput: both staleness families beat bulk sync --------------
+    peak_excess = max(
+        (max(0.0, float(w["straggler"]["p50_ms"])
+             - float(w["straggler"]["gang_median_ms"]))
+         for w in windows if w.get("straggler")),
+        default=0.0,
+    )
+    assert peak_excess > 0
+    def price(algo, tau):
+        return modeled_step_ms(
+            cm, ddp.plan, group.size,
+            Configuration(algorithm=algo, precision="f32", staleness=tau),
+            COMPUTE_MS, straggler_excess_ms=peak_excess,
+        )
+    bulk_ms = price("gradient_allreduce", 0)
+    stale_ms = price("stale", TAU)
+    gossip_ms = price("decentralized", TAU)
+    assert stale_ms < bulk_ms and gossip_ms < bulk_ms, (
+        f"bounded staleness must model strictly under bulk sync at the "
+        f"measured excess: bulk={bulk_ms:.3f} stale={stale_ms:.3f} "
+        f"gossip={gossip_ms:.3f}"
+    )
+
+    # -- stream + fleet -------------------------------------------------------
+    problems = validate_metrics_file(metrics_path)
+    assert not problems, f"straggler lane metrics failed schema: {problems}"
+    with open(metrics_path) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    switches = [e for e in events if e["event"] == "staleness_switch"]
+    reasons = [e["reason"] for e in switches]
+    assert "autopilot:straggler" in reasons, reasons
+    assert "health:loss_spike" in reasons, reasons
+    assert "autopilot:stabilized" in reasons, reasons
+    assert "autopilot:straggler_healed" in reasons, reasons
+
+    fleet = FleetControlPlane()
+    gang = "straggler-lane"
+    fleet.gang(gang)
+    ingest = fleet.ingest_decisions(gang, director.drain_decisions())
+    assert ingest["rejected"] == 0
+    assert ingest["accepted"] == len(director.decisions)
+    row = fleet.scheduler_view()["gangs"][gang]
+    assert row["autopilot"]["decision"] == "restore_bulk_sync", row
+    assert row["autopilot"]["verdict"] == "committed", row
+
+    print(
+        f"[audit] straggler tolerance lane passed (degrade step "
+        f"{degrade['step']} rank {fault.rank} -> tighten {SPIKE_STEP} -> "
+        f"repromote {repromotes[0]['step']} -> restore {restores[0]['step']}, "
+        f"{len(straggler_incidents)} straggler incidents, {skipped} skipped/"
+        f"{fresh} fresh rounds, modeled bulk={bulk_ms:.2f}ms "
+        f"stale={stale_ms:.2f}ms gossip={gossip_ms:.2f}ms, "
+        f"bitwise {', '.join(bitwise_checked)}, 0 verifier rejections)",
+        file=sys.stderr,
+    )
+    return {
+        "ok": True,
+        "decisions": len(director.decisions),
+        "verifier_rejections": 0,
+        "degrade_step": degrade["step"],
+        "degrade_ranks": degrade["ranks"],
+        "degrade_modeled": degrade["modeled"],
+        "tighten_step": SPIKE_STEP,
+        "repromote_step": repromotes[0]["step"],
+        "restore_step": restores[0]["step"],
+        "straggler_incidents": len(straggler_incidents),
+        "skipped_rounds": skipped,
+        "fresh_rounds": fresh,
+        "accounting_bytes": {str(r): int(b) for r, b in ledger.items()},
+        "modeled_ms": {"bulk_sync": bulk_ms, "stale": stale_ms,
+                       "gossip": gossip_ms},
+        "bitwise_tau0": bitwise_checked,
+        "switch_reasons": reasons,
+        "final_tau": director.current_tau(),
+        "scheduler_autopilot": row["autopilot"],
+    }
+
+
 def axis_attribution_lane(out_prefix: str):
     """Executed per-axis wire-attribution gate: the axis ledger, end to end.
 
@@ -2069,6 +2477,67 @@ def assert_zero_census(ddp_results, n):
         )
     print(
         f"[audit] zero sharded wire-pattern assertion passed ({', '.join(zero_rows)})",
+        file=sys.stderr,
+    )
+
+
+def assert_stale_census(ddp_results):
+    """The bounded-staleness wire-exactness gate (runs whenever a ``stale``
+    row is audited beside the ``gradient_allreduce`` baseline).
+
+    Staleness gates *payloads* (``jnp.where`` on the contribution), never
+    control flow: a degraded rank that replays its previous-round buckets
+    still enters every collective every round.  So the compiled τ=2 step
+    must census exactly one f32 all-reduce per bucket (the contribution is
+    a materialized flat buffer, unlike the baseline's tuple fuse which
+    XLA:CPU legalizes per slot) moving EXACTLY the baseline's f32 wire
+    bytes, with zero non-f32 collective payloads anywhere.  Skipped rounds
+    only show up in the *accounting* ledger (the straggler-tolerance
+    lane), never in the traced bytes."""
+    stale_rows = [k for k in ddp_results if k.split("[")[0] == "stale"]
+    if not stale_rows:
+        return
+    base = ddp_results.get("gradient_allreduce")
+    assert base is not None, (
+        "stale census gate needs the gradient_allreduce baseline row"
+    )
+    base_ar = base["census"].get("all-reduce", {"count": 0, "by_dtype": {}})
+    base_f32 = base_ar.get("by_dtype", {}).get("f32", {"count": 0, "bytes": 0})
+    failures = []
+    for name in stale_rows:
+        row = ddp_results[name]
+        if row["buckets"] <= 1:
+            failures.append(f"{name}: single-bucket plan — gate untestable")
+            continue
+        ar = row["census"].get("all-reduce", {"count": 0, "by_dtype": {}})
+        f32 = ar.get("by_dtype", {}).get("f32", {"count": 0, "bytes": 0})
+        if ar["count"] != row["buckets"]:
+            failures.append(
+                f"{name}: {ar['count']} all-reduces, expected exactly one "
+                f"per bucket ({row['buckets']}) — staleness must not change "
+                "the wire program, only the payload"
+            )
+        if f32["bytes"] != base_f32["bytes"]:
+            failures.append(
+                f"{name}: f32 all-reduce bytes {f32['bytes']} != baseline "
+                f"{base_f32['bytes']} — per-round wire bytes must be exact"
+            )
+        for op, e in row["census"].items():
+            if op == "copy":
+                continue
+            bad = sorted(set(e["dtypes"]) - {"f32"})
+            if bad:
+                failures.append(
+                    f"{name}: {op} carries non-f32 payloads {bad} (the "
+                    "stale exchange is f32-only)"
+                )
+    if failures:
+        raise SystemExit(
+            "stale census assertion FAILED:\n  " + "\n  ".join(failures)
+        )
+    print(
+        f"[audit] stale census assertion passed ({', '.join(sorted(stale_rows))}: "
+        "wire program byte-identical to gradient_allreduce)",
         file=sys.stderr,
     )
 
@@ -2967,6 +3436,10 @@ def main():
     elif args.algo == "zero":
         # The sharded gate compares against the all-reduce baseline row.
         algos = ["gradient_allreduce", "zero", "zero[overlap]"]
+    elif args.algo == "stale":
+        # The bounded-staleness gate compares against the all-reduce
+        # baseline row (byte-identical wire program at any τ).
+        algos = ["gradient_allreduce", "stale", "stale[overlap]"]
     elif args.algo:
         algos = [args.algo, f"{args.algo}[overlap]"]
     elif args.quick:
@@ -2986,6 +3459,14 @@ def main():
     assert_overlap_census(ddp_results)
     assert_compressed_overlap_census(ddp_results)
     assert_zero_census(ddp_results, n)
+    assert_stale_census(ddp_results)
+    # Straggler-tolerance gate: the bounded-staleness degradation ladder end
+    # to end (τ=0 bitwise, indictment -> degrade -> guardrail tighten ->
+    # re-promote -> heal, accounting ledger, modeled goodput) under strict
+    # static verify.  Runs on the focused --algo=stale lane only.
+    straggler_result = None
+    if args.algo == "stale":
+        straggler_result = straggler_tolerance_lane(args.out)
     # Quantized-ring wire gates: compiled census + byte gate, then the
     # loss-parity guardrail whose certified allow-list feeds the planner's
     # per-bucket precision choice on the recorded VGG16 operating point.
@@ -3110,6 +3591,7 @@ def main():
              "fleet_sim": fleet_sim_result,
              "regression_attribution": regression_result,
              "autopilot": autopilot_result,
+             "straggler_tolerance": straggler_result,
              "axis_attribution": axis_attribution_result,
              "resilience": resilience_result,
              "fleet_load": fleet_load_result},

@@ -103,6 +103,31 @@ def test_gang_view_report_and_export():
     assert snap["gang_straggler_rank"] == -1 and snap["gang_straggler_score"] == 0.0
 
 
+def test_gang_view_per_rank_scores_report_and_export():
+    """Per-rank straggler scores (each rank's p50 / gang median) ride the
+    report AND the gauge export — the audit trail a per-rank degradation
+    decision joins against, not just the worst rank's score."""
+    reg = MetricsRegistry()
+    view = GangView(4, four_rank_summaries(slow_rank=2, slow_factor=2.0))
+    rep = view.report()
+    assert rep["rank_scores"] == {
+        "0": pytest.approx(1.0), "1": pytest.approx(1.0),
+        "2": pytest.approx(2.0), "3": pytest.approx(1.0),
+    }
+    view.export(reg)
+    snap = reg.snapshot()
+    for r, score in ((0, 1.0), (1, 1.0), (2, 2.0), (3, 1.0)):
+        assert snap[f"gang_straggler_score_rank{r}"] == pytest.approx(score)
+    assert "bagua_gang_straggler_score_rank2" in reg.to_prometheus()
+    # sub-threshold skew still exports per-rank scores (the whole point:
+    # visibility below the indictment line)...
+    view = GangView(4, four_rank_summaries(slow_rank=1, slow_factor=1.2))
+    assert view.straggler is None
+    assert view.rank_scores[1] == pytest.approx(1.2)
+    # ...while an underpopulated or zero-median gang exports none
+    assert GangView(4, four_rank_summaries()[:1]).rank_scores == {}
+
+
 def test_gang_view_heartbeat_ages_report_and_export():
     reg = MetricsRegistry()
     # keys/values arrive as JSON strings from the coordinator; the view

@@ -444,3 +444,320 @@ def test_axis_blind_incident_keeps_legacy_demotion():
     row = pilot.decisions[-1]
     assert row["decision"] == "demote_precision"
     assert "axis" not in row
+
+
+# -- the staleness director ---------------------------------------------------
+
+
+from bagua_tpu.autopilot import (  # noqa: E402
+    StalenessConfig,
+    StalenessDirector,
+    StalenessTightenAction,
+    modeled_step_ms,
+)
+from bagua_tpu.observability.attribution import BudgetModel  # noqa: E402
+
+
+class FakeStaleImpl:
+    algo_name = "stale"
+    hierarchical = False
+
+    def __init__(self, tau=0):
+        self.staleness_tau = tau
+
+    def set_staleness_tau(self, tau):
+        self.staleness_tau = int(tau)
+
+
+class FakeStaleDdp:
+    def __init__(self, tau=0):
+        self.impl = FakeStaleImpl(tau)
+        self.plan = PLAN
+        self.plan_version = 0
+        self.group = SimpleNamespace(exchange_size=8)
+        self.staleness_switches = []
+        self.directives = []
+        self.resets = 0
+
+    def apply_staleness(self, tau, reason=None):
+        old = self.impl.staleness_tau
+        self.impl.set_staleness_tau(tau)
+        if old == int(tau):
+            return False
+        self.plan_version += 1
+        self.staleness_switches.append((int(tau), reason))
+        return True
+
+    def apply_degradation_directive(self, state, ranks):
+        self.directives.append(tuple(int(r) for r in ranks))
+        return state
+
+    def reset_staleness_state(self, state):
+        self.resets += 1
+        return state
+
+
+class FakeStaleSentinel:
+    def __init__(self):
+        self.incidents = []
+        self.degraded = None
+        self.budget = SimpleNamespace(compute_ms=8.0)
+
+    def mark_degraded(self, ranks):
+        self.degraded = tuple(ranks)
+
+
+def _straggler_incident(trace="tr-s", rank=2, excess=4.0, step=0):
+    return {
+        "dominant": "straggler", "straggler_rank": rank, "trace_id": trace,
+        "step": step, "plan_version": 0,
+        "components": {"straggler": excess},
+        "measured_ms": 14.0, "expected_ms": 10.0,
+    }
+
+
+def _director(tau=2, health=None, **cfg):
+    cfg.setdefault("hysteresis_incidents", 2)
+    cfg.setdefault("cooldown_steps", 0)
+    cfg.setdefault("heal_patience", 10**6)
+    ddp = FakeStaleDdp()
+    sent = FakeStaleSentinel()
+    health = health or FakeHealth()
+    d = StalenessDirector(
+        ddp, StalenessConfig(tau=tau, **cfg), sentinel=sent, health=health,
+    )
+    return d, ddp, sent, health
+
+
+def test_director_single_incident_held_by_hysteresis():
+    d, ddp, sent, _ = _director()
+    sent.incidents.append(_straggler_incident())
+    d.tick(None, step=10)
+    assert d.decisions == [] and ddp.staleness_switches == []
+    assert d.degraded_ranks == ()
+
+
+def test_director_degrades_with_trace_rank_and_reprime():
+    d, ddp, sent, _ = _director()
+    sent.incidents.extend([
+        _straggler_incident("tr-a"), _straggler_incident("tr-b"),
+    ])
+    d.tick(None, step=10)
+    # one τ switch + one state re-prime (fresh first round) + the directive
+    assert ddp.staleness_switches == [(2, "autopilot:straggler")]
+    assert ddp.resets == 1
+    assert ddp.directives == [(2,)]
+    assert d.degraded_ranks == (2,)
+    assert sent.degraded == (2,)  # budget paces the gang at its median
+    (row,) = d.decisions
+    assert row["decision"] == "degrade_staleness"
+    assert row["verdict"] == "committed"
+    assert row["reason"] == "autopilot:straggler"
+    assert row["trace_id"] == "tr-b"  # cites the adjudicated incident
+    assert row["ranks"] == [2]
+    assert row["to_config"]["staleness"] == 2
+    assert validate_metrics_event(row) == []
+
+
+def test_director_wire_incidents_are_not_straggler_evidence():
+    d, ddp, sent, _ = _director()
+    sent.incidents.extend([_incident("tr-1"), _incident("tr-2")])
+    d.tick(None, step=10)
+    assert d.decisions == [] and ddp.directives == []
+
+
+def test_director_degrade_requires_current_health():
+    d, ddp, sent, _ = _director(health=FakeHealth(clean_streak=0))
+    sent.incidents.extend([
+        _straggler_incident("tr-a"), _straggler_incident("tr-b"),
+    ])
+    d.tick(None, step=10)
+    (row,) = d.decisions
+    assert row["decision"] == "hold" and row["verdict"] == "held"
+    assert ddp.staleness_switches == [] and ddp.directives == []
+
+
+def test_director_cooldown_blocks_further_moves():
+    d, ddp, sent, _ = _director(cooldown_steps=100)
+    sent.incidents.extend([
+        _straggler_incident("tr-a"), _straggler_incident("tr-b"),
+    ])
+    d.tick(None, step=10)
+    assert d.degraded_ranks == (2,)
+    sent.incidents.extend([
+        _straggler_incident("tr-c", rank=3), _straggler_incident("tr-d", rank=3),
+    ])
+    d.tick(None, step=20)  # inside the cooldown: rank 3 must wait
+    assert d.degraded_ranks == (2,)
+    sent.incidents.extend([
+        _straggler_incident("tr-e", rank=3), _straggler_incident("tr-f", rank=3),
+    ])
+    d.tick(None, step=120)
+    assert d.degraded_ranks == (2, 3)
+    assert ddp.directives[-1] == (2, 3)
+
+
+def test_tighten_action_snaps_to_zero_and_noops_at_zero():
+    ddp = FakeStaleDdp(tau=2)
+    action = StalenessTightenAction(ddp)
+    assert action({"kind": "loss_spike"}) is True
+    assert ddp.impl.staleness_tau == 0
+    assert ddp.staleness_switches == [(0, "health:loss_spike")]
+    # already bulk-sync: the guardrail has nothing to tighten
+    assert action({"kind": "loss_spike"}) is False
+    assert len(ddp.staleness_switches) == 1
+    # no staleness knob at all: clean False, no throw
+    assert StalenessTightenAction(FakeDdp())({"kind": "loss_spike"}) is False
+
+
+def test_director_adopts_external_tighten_then_repromotes():
+    d, ddp, sent, health = _director(repromote_windows=5)
+    sent.incidents.extend([
+        _straggler_incident("tr-a"), _straggler_incident("tr-b"),
+    ])
+    d.tick(None, step=10)
+    assert d.current_tau() == 2
+
+    # the registered guardrail action fires outside the ladder...
+    StalenessTightenAction(ddp)({"kind": "loss_spike"})
+    health.clean_streak = 0
+    d.tick(None, step=20)
+    assert d.report()["tightened"] is True  # adopted, no decision forged
+    assert ddp.staleness_switches[-1] == (0, "health:loss_spike")
+
+    # ...and after the stabilization arc the degradation gets τ back
+    health.clean_streak = 10**6
+    d.tick(None, step=30)
+    assert ddp.staleness_switches[-1] == (2, "autopilot:stabilized")
+    assert ddp.resets == 2  # replay state re-primed on the re-raise too
+    assert health.rearmed == 1
+    row = d.decisions[-1]
+    assert row["decision"] == "repromote_staleness"
+    assert row["verdict"] == "committed"
+    assert d.report()["tightened"] is False
+    assert d.degraded_ranks == (2,)  # the directive never lapsed
+
+
+def test_director_tightens_on_anomaly_without_registered_action():
+    d, ddp, sent, health = _director()
+    sent.incidents.extend([
+        _straggler_incident("tr-a"), _straggler_incident("tr-b"),
+    ])
+    d.tick(None, step=10)
+    health.clean_streak = 0
+    d.tick(None, step=11)
+    assert ddp.staleness_switches[-1] == (0, "health:anomaly")
+    row = d.decisions[-1]
+    assert row["decision"] == "tighten_staleness"
+    assert row["verdict"] == "committed"
+
+
+def test_director_heals_after_patience():
+    d, ddp, sent, _ = _director(heal_patience=50)
+    sent.incidents.extend([
+        _straggler_incident("tr-a", step=8), _straggler_incident("tr-b", step=10),
+    ])
+    d.tick(None, step=10)
+    assert d.degraded_ranks == (2,)
+    d.tick(None, step=40)  # patience not yet elapsed
+    assert d.degraded_ranks == (2,)
+    d.tick(None, step=70)
+    assert d.degraded_ranks == ()
+    assert ddp.staleness_switches[-1] == (0, "autopilot:straggler_healed")
+    assert ddp.directives[-1] == ()
+    assert sent.degraded == ()  # budget back to worst-rank pacing
+    row = d.decisions[-1]
+    assert row["decision"] == "restore_bulk_sync"
+    assert row["verdict"] == "committed"
+    assert row["ranks"] == [2]
+
+
+def test_director_modeled_block_prices_staleness():
+    d, ddp, sent, _ = _director()
+    d.cost_model = COST_MODEL
+    sent.incidents.extend([
+        _straggler_incident("tr-a", excess=6.0),
+        _straggler_incident("tr-b", excess=6.0),
+    ])
+    d.tick(None, step=10)
+    modeled = d.decisions[-1]["modeled"]
+    assert modeled["straggler_excess_ms"] == pytest.approx(6.0)
+    # τ=2 amortizes the excess to a third: strictly cheaper than staying
+    assert modeled["chosen_ms"] < modeled["stay_ms"]
+
+
+def test_director_drain_decisions_is_incremental():
+    d, ddp, sent, _ = _director()
+    sent.incidents.extend([
+        _straggler_incident("tr-a"), _straggler_incident("tr-b"),
+    ])
+    d.tick(None, step=10)
+    first = d.drain_decisions()
+    assert [r["decision"] for r in first] == ["degrade_staleness"]
+    assert d.drain_decisions() == []
+
+
+# -- staleness pricing + budget pacing ----------------------------------------
+
+
+def test_modeled_step_ms_amortizes_straggler_excess():
+    def price(tau):
+        return modeled_step_ms(
+            COST_MODEL, PLAN, 8,
+            Configuration(algorithm="stale", precision="f32", staleness=tau),
+            1.0, straggler_excess_ms=6.0,
+        )
+
+    assert price(2) == pytest.approx(price(0) - 4.0)  # 6 -> 6/(τ+1)
+    assert price(1) == pytest.approx(price(0) - 3.0)
+    # no excess, no discount: staleness is never a win on a healthy gang
+    healthy = modeled_step_ms(
+        COST_MODEL, PLAN, 8,
+        Configuration(algorithm="stale", precision="f32", staleness=2), 1.0,
+    )
+    assert healthy == pytest.approx(modeled_step_ms(
+        COST_MODEL, PLAN, 8,
+        Configuration(algorithm="stale", precision="f32", staleness=0), 1.0,
+    ))
+
+
+def test_candidate_configurations_staleness_composes_only_with_the_knob():
+    cands = candidate_configurations(
+        ("gradient_allreduce", "stale"), ("f32", "int8"), staleness_taus=(0, 2)
+    )
+    labels = {c.label() for c in cands}
+    assert "stale/f32/tau=2" in labels
+    assert "gradient_allreduce/int8" in labels
+    # no τ>0 on algorithms without the knob, no quantized staleness
+    assert not any(
+        c.staleness and c.algorithm == "gradient_allreduce" for c in cands
+    )
+    assert all(c.precision == "f32" for c in cands if c.algorithm == "stale")
+    # the tie-break: equal price prefers lower τ (no free convergence tax)
+    priced = price_configurations(
+        COST_MODEL, PLAN, 8,
+        candidate_configurations(("stale",), ("f32",), staleness_taus=(2, 0)),
+        1.0,
+    )
+    assert priced[0][0].staleness == 0
+
+
+def test_budget_drops_straggler_evidence_for_degraded_ranks():
+    bm = BudgetModel(compute_ms=8.0, wire_ms=2.0)
+    bm.note_straggler(5.0, rank=2)
+    row = bm.settle(0, 15.0)
+    assert row.components["straggler"] == pytest.approx(5.0)
+    assert row.straggler_rank == 2
+    # under a degradation directive the gang paces at its median: the
+    # indicted rank's excess is expected, not budgetable evidence
+    bm.mark_degraded((2,))
+    bm.note_straggler(5.0, rank=2)
+    row = bm.settle(1, 10.0)
+    assert row.components["straggler"] == 0.0
+    assert row.straggler_rank == -1
+    # other ranks still charge; clearing the directive restores rank 2
+    bm.note_straggler(4.0, rank=1)
+    assert bm.settle(2, 14.0).components["straggler"] == pytest.approx(4.0)
+    bm.mark_degraded(())
+    bm.note_straggler(5.0, rank=2)
+    assert bm.settle(3, 15.0).components["straggler"] == pytest.approx(5.0)

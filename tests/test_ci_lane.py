@@ -354,6 +354,81 @@ def test_perf_audit_quick_bytegrad_compressed_census(tmp_path):
         )
 
 
+def test_perf_audit_quick_stale_straggler_tolerance(tmp_path):
+    """Satellite lane: ``--quick --algo=stale`` drives the full
+    straggler-tolerance arc as a subprocess — a transient 1.5× compute
+    straggler degrades rank 2 into bounded-staleness replay (decision citing
+    the incident trace), an injected loss spike tightens τ→0 through the
+    health guardrail, stabilized windows re-promote, and the healed
+    straggler restores bulk sync — with modeled goodput under both
+    relaxations strictly better than bulk sync and τ=0 bitwise gates held."""
+    out = tmp_path / "audit_stale"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "ci", "perf_audit.py"),
+            "--quick", "--algo=stale", "--model=mlp", "--ddp-only",
+            "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (
+        f"perf_audit --quick --algo=stale failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    assert "stale census assertion passed" in proc.stderr
+    assert "straggler tolerance lane passed" in proc.stderr
+
+    with open(str(out) + ".json") as f:
+        audit = json.load(f)
+    rows = audit["ddp"]
+    assert "stale" in rows and "stale[overlap]" in rows
+    # stale-sync materializes the flat contribution: ONE all-reduce per
+    # bucket, byte-identical to the baseline's gradient exchange
+    base = rows["gradient_allreduce"]
+    for name in ("stale", "stale[overlap]"):
+        row = rows[name]
+        assert row["census"]["all-reduce"]["count"] == row["buckets"]
+        assert (
+            row["census"]["all-reduce"]["by_dtype"]["f32"]["bytes"]
+            == base["census"]["all-reduce"]["by_dtype"]["f32"]["bytes"]
+        )
+
+    st = audit["straggler_tolerance"]
+    assert st["ok"] is True
+    assert st["verifier_rejections"] == 0
+    # the degradation decision targeted the injected straggler...
+    assert st["degrade_ranks"] == [2]
+    assert st["degrade_modeled"]["chosen_ms"] < st["degrade_modeled"]["stay_ms"]
+    assert st["degrade_modeled"]["straggler_excess_ms"] > 0
+    # ...and the arc ran in order: degrade -> tighten -> repromote -> restore
+    assert (st["degrade_step"] < st["tighten_step"]
+            < st["repromote_step"] < st["restore_step"])
+    assert st["switch_reasons"] == [
+        "autopilot:straggler", "health:loss_spike",
+        "autopilot:stabilized", "autopilot:straggler_healed",
+    ]
+    assert st["final_tau"] == 0
+    assert st["scheduler_autopilot"]["decision"] == "restore_bulk_sync"
+    assert st["scheduler_autopilot"]["verdict"] == "committed"
+    # replay genuinely skipped exchanges, and the bound forced fresh rounds
+    assert st["straggler_incidents"] >= 1
+    assert st["skipped_rounds"] > 0 and st["fresh_rounds"] > 0
+    # the wire ledger shows the degraded rank shipping fewer bytes than a
+    # healthy rank over the degraded span
+    assert st["accounting_bytes"]["2"] < st["accounting_bytes"]["0"]
+    # modeled goodput: both relaxations strictly beat bulk sync under the
+    # 1.5x transient straggler
+    m = st["modeled_ms"]
+    assert m["stale"] < m["bulk_sync"] and m["gossip"] < m["bulk_sync"]
+    # τ=0 bitwise gates, both families
+    assert set(st["bitwise_tau0"]) == {
+        "stale[tau=0]==gradient_allreduce",
+        "decentralized[gossip,tau=0]==decentralized",
+    }
+
+
 def test_perf_audit_quick_zero_sharded_census(tmp_path):
     """Satellite lane: ``--quick --algo=zero`` audits the sharded three-leg
     exchange — exactly one reduce-scatter and one all-gather per bucket, no

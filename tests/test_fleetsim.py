@@ -154,3 +154,54 @@ def test_legacy_scalar_wire_reports_no_axis_medians():
     for gang in report["gangs"]:
         for w in gang["windows"]:
             assert "gang_wire_axis_ms" not in w
+
+
+def test_transient_straggler_ramps_plateaus_and_heals():
+    """The transient profile: onset below the detection threshold (one ramp
+    window at half the excess), indictment only at the plateau, and clean
+    windows after ``end_window`` — the arc the straggler-tolerance lane's
+    degradation ladder rides."""
+    fault = Straggler(
+        gang=0, rank=1, factor=1.5, phase="compute",
+        start_window=2, end_window=5, ramp_windows=1,
+    )
+    cfg = _cfg(
+        n_gangs=1, windows=6, compute_ms=8.0, wire_ms=2.0,
+        straggler_factor=1.25, faults=(fault,),
+    )
+    # the shape of the injected clock: 1.25x compute on the ramp window
+    # (1.2 whole-step, below threshold), 1.5x at the plateau (1.4, above)
+    assert fault.effective_factor(1) == 1.0
+    assert fault.effective_factor(2) == pytest.approx(1.25)
+    assert fault.effective_factor(3) == fault.effective_factor(4) == 1.5
+    assert fault.effective_factor(5) == 1.0  # healed at end_window
+
+    report = run_fleet(cfg)
+    gang = report["gangs"][0]
+    dets = gang["straggler_detections"]
+    assert [d["window"] for d in dets] == [3, 4], dets
+    for d in dets:
+        assert d["rank"] == 1 and d["phase"] == "compute"
+        assert d["score"] >= cfg.straggler_factor
+    # healthy: detections match the expectation derived from the profile
+    assert gang["expected_stragglers"] == [[1, "compute"]]
+    assert gang["healthy"]
+
+
+def test_transient_straggler_that_never_plateaus_is_not_expected():
+    """A ramp longer than the active span peaks below the detection
+    threshold: the verdict must expect (and get) zero detections."""
+    fault = Straggler(
+        gang=0, rank=1, factor=1.5, phase="compute",
+        start_window=2, end_window=4, ramp_windows=8,
+    )
+    cfg = _cfg(
+        n_gangs=1, windows=4, compute_ms=8.0, wire_ms=2.0,
+        straggler_factor=1.25, faults=(fault,),
+    )
+    assert max(fault.effective_factor(w) for w in range(1, 5)) < 1.25
+    report = run_fleet(cfg)
+    gang = report["gangs"][0]
+    assert gang["straggler_detections"] == []
+    assert gang["expected_stragglers"] == []
+    assert gang["healthy"]

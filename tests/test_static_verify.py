@@ -486,6 +486,125 @@ def test_warn_gate_logs_but_dispatches(group, monkeypatch, caplog):
 
 
 # ---------------------------------------------------------------------------
+# The bounded-staleness sanction
+# ---------------------------------------------------------------------------
+
+
+def _stale_cond_program(group, mark=True, equal_bytes=True, both_exchange=True):
+    """Hand-rolled bounded-staleness shape: a *rank-conditional* cond whose
+    branches differ in payload.  Knobs degrade it into the rejectable
+    variants: drop the scope marker, shrink one branch's wire bytes, or
+    skip the exchange in one branch entirely."""
+    from contextlib import nullcontext
+
+    from bagua_tpu.observability.scope_grammar import format_stale_scope
+
+    scope = (lambda: jax.named_scope(format_stale_scope(2))) if mark \
+        else nullcontext
+
+    def body(x):
+        r = jax.lax.axis_index("intra")
+
+        def fresh(v):
+            with scope():
+                return jax.lax.psum(v, "intra")
+
+        def replay(v):
+            if not both_exchange:
+                return v * 2.0
+            if not equal_bytes:
+                with scope():
+                    half = jax.lax.psum(v[:, :2], "intra")
+                return jnp.concatenate([half, v[:, 2:]], axis=1)
+            with scope():
+                return jax.lax.psum(v * 0.5, "intra")
+
+        return jax.lax.cond(r == 0, fresh, replay, x)
+
+    fn = group.shard_map(body, in_specs=(P("intra"),), out_specs=P("intra"))
+    x = jnp.ones((8, 4), jnp.float32)
+    program, _ = collect_ir(fn, (x,), dict(group.mesh.shape))
+    return program
+
+
+def test_stale_marker_with_equal_bytes_is_sanctioned_info(group):
+    """The sanctioned exception: rank-conditional cond, BOTH branches under
+    the ``bagua_stale/tau=<k>`` marker moving identical wire bytes — the
+    wire census is preserved either way the predicate falls, so the finding
+    downgrades to info and strict verification would pass."""
+    program = _stale_cond_program(group)
+    flagged = [d for d in program.collectives if d.rank_conditional]
+    assert flagged and all(d.stale == 2 for d in flagged)
+    findings = check_rank_invariance(program)
+    assert not [f for f in findings if f.severity == "error"], findings
+    infos = [f for f in findings if f.severity == "info"]
+    assert infos and all("sanctioned" in f.message for f in infos)
+    assert any("tau=2" in f.message for f in infos)
+
+
+def test_stale_marker_with_unequal_bytes_is_rejected(group):
+    """Marker present but the branches move different wire bytes: the
+    staleness sanction must NOT launder a genuine census divergence."""
+    program = _stale_cond_program(group, equal_bytes=False)
+    errors = [
+        f for f in check_rank_invariance(program) if f.severity == "error"
+    ]
+    assert errors, "unequal-byte staleness cond was sanctioned"
+
+
+def test_stale_marker_single_branch_exchange_is_rejected(group):
+    """Marker present but only one branch exchanges at all: ranks could skip
+    the collective outright — never sanctionable."""
+    program = _stale_cond_program(group, both_exchange=False)
+    errors = [
+        f for f in check_rank_invariance(program) if f.severity == "error"
+    ]
+    assert errors, "single-branch staleness cond was sanctioned"
+
+
+def test_unmarked_equal_bytes_cond_is_still_rejected(group):
+    """Equal bytes alone don't earn the sanction — the descriptor must opt
+    in with the scope marker, otherwise the program is presumed buggy."""
+    program = _stale_cond_program(group, mark=False)
+    assert all(d.stale is None for d in program.collectives)
+    errors = [
+        f for f in check_rank_invariance(program) if f.severity == "error"
+    ]
+    assert errors, "unmarked rank-conditional cond was sanctioned"
+
+
+def test_strict_gate_passes_bounded_staleness_engines(group, monkeypatch):
+    """The real relaxations under the strict gate: stale τ=2 (directive up)
+    and gossip decentralized τ=2 verify and dispatch — their where-gated
+    payloads never introduce rank-conditional control flow — and a τ
+    switch re-verifies before the re-bounded step dispatches."""
+    import optax
+
+    from bagua_tpu.algorithms.decentralized import DecentralizedAlgorithm
+    from bagua_tpu.algorithms.stale import StaleSyncAlgorithm
+
+    monkeypatch.setenv("BAGUA_STATIC_VERIFY", "strict")
+    for algo in (
+        StaleSyncAlgorithm(staleness_tau=2),
+        DecentralizedAlgorithm(hierarchical=False, staleness_tau=2),
+    ):
+        ddp = DistributedDataParallel(
+            mse_loss, optax.sgd(0.1), algo,
+            process_group=group, bucket_size_bytes=1 << 12,
+        )
+        try:
+            state = ddp.init(init_mlp(jax.random.PRNGKey(0), LAYERS))
+            state = ddp.apply_degradation_directive(state, (2,))
+            state, losses = ddp.train_step(state, make_batch())
+            jax.block_until_ready(losses)
+            assert ddp.apply_staleness(1, reason="planner") is True
+            state, losses = ddp.train_step(state, make_batch())
+            jax.block_until_ready(losses)
+        finally:
+            ddp.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # Re-verification on plan adoption
 # ---------------------------------------------------------------------------
 
