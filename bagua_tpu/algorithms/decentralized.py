@@ -90,8 +90,13 @@ def _exchange(flat: jnp.ndarray, step, mode: str, axes) -> jnp.ndarray:
     if mode == "shift_one":
         if n % 2 != 0:
             raise ValueError(
-                "shift_one requires an even number of peers "
-                f"(got {n}); see reference decentralized_full_precision_synchronous.rs:71-79"
+                "shift_one requires an even number of peers: world size "
+                f"{n} cannot be symmetrically paired (ranks split into "
+                "lower/upper halves, and the middle rank would land in "
+                "both schedules). Resize the gang to an even world size "
+                f"(e.g. {n - 1} or {n + 1}) or use "
+                "peer_selection_mode='all' — see reference "
+                "decentralized_full_precision_synchronous.rs:71-79"
             )
         h = n // 2
         branches = [
@@ -136,8 +141,11 @@ class DecentralizedAlgorithmImpl(AlgorithmImpl):
             if peers > 1 and peers % 2 != 0:
                 raise ValueError(
                     "peer_selection_mode='shift_one' requires an even number "
-                    f"of peers, got {peers} (group {process_group!r}); use "
-                    "peer_selection_mode='all' on odd worlds — see reference "
+                    f"of peers: this group exchanges across {peers} peers "
+                    f"(group {process_group!r}), which cannot be "
+                    "symmetrically paired. Resize the gang to an even peer "
+                    f"count (e.g. {peers - 1} or {peers + 1}) or use "
+                    "peer_selection_mode='all' — see reference "
                     "decentralized_full_precision_synchronous.rs:71-79"
                 )
         if staleness_tau is not None:

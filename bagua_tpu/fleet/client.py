@@ -180,17 +180,23 @@ class FleetClient:
         return out["key"]
 
     def lookup_plan(
-        self, fingerprint: str, topology: str, algorithm: str, wire_precision: str
+        self, fingerprint: str, topology: str, algorithm: str,
+        wire_precision: str, gang: Optional[str] = None,
     ) -> Optional[dict]:
-        out = self._call(
-            "/fleet/plan/lookup",
-            {
-                "fingerprint": fingerprint,
-                "topology": topology,
-                "algorithm": algorithm,
-                "wire_precision": wire_precision,
-            },
-        )
+        """Cache lookup.  Passing the gang's identity journals the adoption
+        on the control plane (the remediation tier's correlation record)
+        and applies canary gating — a plan still proving itself is only
+        served to its cohort.  Without ``gang`` this is the legacy
+        read-only lookup."""
+        payload = {
+            "fingerprint": fingerprint,
+            "topology": topology,
+            "algorithm": algorithm,
+            "wire_precision": wire_precision,
+        }
+        if gang is not None:
+            payload["gang"] = str(gang)
+        out = self._call("/fleet/plan/lookup", payload)
         return out if out.get("found") else None
 
     # -- fleet views --------------------------------------------------------------
@@ -284,6 +290,46 @@ class FleetClient:
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             return resp.read().decode()
 
+    # -- remediation --------------------------------------------------------------
+
+    def remediate(self, quarantine_threshold: Optional[int] = None) -> dict:
+        """Run one RemediationEngine sweep on the control plane; returns
+        the sweep summary (quarantined plans, rollback/resize directives
+        issued, canary graduations, emitted events)."""
+        payload = {}
+        if quarantine_threshold is not None:
+            payload["quarantine_threshold"] = int(quarantine_threshold)
+        return self._call("/fleet/remediate", payload)
+
+    def remediation(self) -> dict:
+        """The durable remediation tier: every plan's quarantine/canary
+        status, per-gang directives, and action counters."""
+        return self._call("/fleet/remediation")
+
+    def shards(self) -> dict:
+        """Shard topology: shard count, gangs per shard, per-shard WAL
+        replay wall time."""
+        return self._call("/fleet/shards")
+
+    def gang_directive(self, gang_id: str) -> Optional[dict]:
+        """The gang's oldest pending remediation directive, or None —
+        what the elastic-resume path polls before picking a world size."""
+        from urllib.parse import quote
+
+        out = self._call(f"/g/{quote(str(gang_id), safe='')}/directive")
+        return out.get("directive")
+
+    def ack_directive(self, gang_id: str, directive_id: int) -> bool:
+        """Acknowledge a directive once acted on (clears the scheduler
+        view's remediation-pending marker)."""
+        from urllib.parse import quote
+
+        out = self._call(
+            f"/g/{quote(str(gang_id), safe='')}/directive/ack",
+            {"id": int(directive_id)},
+        )
+        return bool(out.get("ok"))
+
 
 def publish_engine_plan(
     fleet: FleetClient, ddp, meta: Optional[dict] = None,
@@ -305,7 +351,7 @@ def publish_engine_plan(
 
 def adopt_fleet_plan(
     fleet: FleetClient, ddp, telemetry=None,
-    wire_precision: Optional[str] = None,
+    wire_precision: Optional[str] = None, gang: Optional[str] = None,
 ) -> Optional[str]:
     """Step-0 warm start from the cross-gang plan cache.
 
@@ -314,10 +360,12 @@ def adopt_fleet_plan(
     ``"fleet"`` — the ``plan_source`` value generalizing the resilience
     manifest's ``"carried"``.  Returns None on a miss, an unreachable
     fleet, or a payload that no longer fits (all advisory: the gang just
-    runs its fresh plan)."""
+    runs its fresh plan).  With a ``gang`` identity the adoption is
+    journaled on the control plane and canary gating applies — a plan
+    still proving itself is withheld from gangs outside its cohort."""
     key = engine_plan_key(ddp, wire_precision=wire_precision)
     try:
-        entry = fleet.lookup_plan(**key)
+        entry = fleet.lookup_plan(gang=gang, **key)
     except (OSError, ConnectionError) as e:
         logger.warning("fleet plan lookup failed (advisory): %s", e)
         return None

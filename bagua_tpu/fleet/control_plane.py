@@ -12,9 +12,12 @@ cross-gang plan cache.
 Durability tiers (what the WAL covers):
 
 * **durable** — membership/assignment/epoch, KV, blobs, gang set, the plan
-  cache.  Every mutation is journaled before the request is acknowledged;
-  a killed-and-restarted server replays to the exact pre-crash state
-  (:meth:`FleetControlPlane.dump` is the bitwise witness).
+  cache, and the remediation tier (plan adoptions, quarantine/canary
+  status, gang directives).  Every mutation is journaled before the
+  request is acknowledged; a killed-and-restarted server replays to the
+  exact pre-crash state (:meth:`FleetControlPlane.dump` is the bitwise
+  witness — remediation state included, so SIGKILL+replay reproduces
+  every quarantine and directive the pre-crash engine issued).
 * **advisory** — autotune tuning state.  Gangs re-register on reconnect
   (``register_tensors`` already handles restarted gangs), and the part
   worth keeping across jobs — the *winning plan* — is exactly what the
@@ -264,6 +267,7 @@ class FleetControlPlane:
         rdzv_kwargs: Optional[dict] = None,
         autotune_kwargs: Optional[dict] = None,
         clock: Callable[[], float] = time.monotonic,
+        canary_n: int = 2,
     ):
         from bagua_tpu.env import (
             get_fleet_burst, get_fleet_lease_ttl_s, get_fleet_rate_limit,
@@ -299,6 +303,13 @@ class FleetControlPlane:
         self._decision_counts: Dict[str, int] = {}
         self.plan_hits = 0
         self.plan_misses = 0
+        # -- remediation tier (durable): plan adoption/quarantine/canary
+        # status + per-gang directives.  Journaled like the plan cache so
+        # SIGKILL+replay reproduces the same remediation state bitwise.
+        self.canary_n = max(1, int(canary_n))
+        self._rem: dict = {"plans": {}, "directives": {}, "actions": {}}
+        #: wall time of the last WAL replay (volatile; /fleet/metrics gauge)
+        self.wal_replay_ms = 0.0
         self.wal = WriteAheadLog(wal_dir, compact_every=compact_every, fsync=fsync) if wal_dir else None
         if self.wal is not None:
             self._replay()
@@ -325,10 +336,15 @@ class FleetControlPlane:
         return True
 
     def _snapshot_state(self) -> dict:
+        import json as _json
+
         with self._lock:
             gangs = dict(self._gangs)
             plans = {k: dict(v) for k, v in self._plans.items()}
-        state = {"plans": plans, "gangs": {}}
+            # deep copy via JSON round-trip: the snapshot must not alias
+            # live remediation dicts a concurrent sweep keeps mutating
+            remediation = _json.loads(_json.dumps(self._rem))
+        state = {"plans": plans, "gangs": {}, "remediation": remediation}
         for gang_id, ns in sorted(gangs.items()):
             st = ns.rendezvous
             with st._lock:
@@ -345,12 +361,20 @@ class FleetControlPlane:
         return state
 
     def _replay(self) -> None:
+        t0 = time.perf_counter()
         snapshot, records = self.wal.load()
         self._replaying = True
         try:
             if snapshot:
                 for key, entry in snapshot.get("plans", {}).items():
                     self._plans[key] = dict(entry)
+                rem = snapshot.get("remediation")
+                if isinstance(rem, dict):
+                    self._rem = {
+                        "plans": dict(rem.get("plans", {})),
+                        "directives": dict(rem.get("directives", {})),
+                        "actions": dict(rem.get("actions", {})),
+                    }
                 for gang_id, gs in snapshot.get("gangs", {}).items():
                     ns = self._ensure_gang(gang_id)
                     ns.rendezvous.replay_membership(gs.get("rdzv", {}))
@@ -362,11 +386,18 @@ class FleetControlPlane:
                 self._apply(rec)
         finally:
             self._replaying = False
+        self.wal_replay_ms = round((time.perf_counter() - t0) * 1e3, 3)
         if snapshot or records:
             logger.info(
-                "WAL replay: %d gangs, %d cached plans, %d records past snapshot",
+                "WAL replay: %d gangs, %d cached plans, %d records past "
+                "snapshot (%.1f ms)",
                 len(self._gangs), len(self._plans), len(records),
+                self.wal_replay_ms,
             )
+
+    #: WAL ops owned by the remediation tier (dispatched to _rem_apply)
+    _REM_OPS = ("adopt", "quarantine", "canary", "plan_status",
+                "directive", "directive_ack")
 
     def _apply(self, rec: dict) -> None:
         op = rec.get("op")
@@ -376,6 +407,7 @@ class FleetControlPlane:
             self._gangs.pop(rec["gang"], None)
             self._leases.pop(rec["gang"], None)
             self._buckets.pop(rec["gang"], None)
+            self._rem["directives"].pop(rec["gang"], None)
         elif op == "rdzv":
             self._ensure_gang(rec["gang"]).rendezvous.replay_membership(rec["state"])
         elif op == "kv":
@@ -386,6 +418,9 @@ class FleetControlPlane:
             )
         elif op == "plan":
             self._plans[rec["key"]] = dict(rec["entry"])
+            self._rem_plan_init(rec["key"], rec["entry"])
+        elif op in self._REM_OPS:
+            self._rem_apply(rec)
         else:
             logger.warning("WAL replay: unknown op %r (skipped)", op)
 
@@ -452,6 +487,9 @@ class FleetControlPlane:
                     self._gangs.pop(gang_id, None)
                     self._leases.pop(gang_id, None)
                     self._buckets.pop(gang_id, None)
+                    # pending directives die with the namespace (same fate
+                    # on replay: _apply("gang_gc") pops the same key)
+                    self._rem["directives"].pop(gang_id, None)
                     self.gangs_gcd += 1
                     # Journal inside the removal's critical section (the WAL
                     # lock is a leaf, so this is deadlock-free): journaling
@@ -493,24 +531,265 @@ class FleetControlPlane:
         with self._lock:
             self._plans[key] = entry
             self.journal({"op": "plan", "key": key, "entry": entry})
+            self._rem_plan_init(key, entry)
         logger.info("plan cache: stored %s", key)
         return key
 
     def plan_get(
-        self, fingerprint: str, topology: str, algorithm: str, wire_precision: str
+        self,
+        fingerprint: str,
+        topology: str,
+        algorithm: str,
+        wire_precision: str,
+        gang: Optional[str] = None,
     ) -> Optional[dict]:
+        """Cache lookup.  With a ``gang`` identity the remediation tier
+        gates the entry (a quarantined plan is never served; a canary plan
+        is served only to its cohort until it graduates) and the adoption
+        is journaled — the correlation record the :class:`RemediationEngine`
+        sweeps.  ``gang=None`` is the legacy read-only path: no adoption is
+        recorded and canary gating does not apply (quarantine still does)."""
         key = plan_cache_key(fingerprint, topology, algorithm, wire_precision)
         with self._lock:
             entry = self._plans.get(key)
-            if entry is not None:
-                self.plan_hits += 1
-            else:
+            if entry is None:
                 self.plan_misses += 1
-            return dict(entry) if entry is not None else None
+                return None
+            rec = self._rem["plans"].get(key)
+            if rec is not None:
+                if rec["status"] == "quarantined":
+                    self.plan_misses += 1
+                    return None
+                if gang is not None:
+                    if (
+                        rec["status"] == "canary"
+                        and gang not in rec["cohort"]
+                        and len(rec["cohort"]) >= self.canary_n
+                    ):
+                        # cohort is full: withheld until the canaries report
+                        # clean windows and the plan graduates to default
+                        self.plan_misses += 1
+                        return None
+                    if gang not in rec["adopters"]:
+                        self._rem_record({
+                            "op": "adopt",
+                            "key": key,
+                            "gang": str(gang),
+                            "plan_version": rec["plan_version"],
+                            "cohort_add": bool(
+                                rec["status"] == "canary"
+                                and gang not in rec["cohort"]
+                            ),
+                        })
+            self.plan_hits += 1
+            return dict(entry)
 
     def plan_count(self) -> int:
         with self._lock:
             return len(self._plans)
+
+    # -- remediation tier (durable) ---------------------------------------------
+
+    def _rem_record(self, rec: dict) -> None:
+        """Journal one remediation op, then apply it — the single mutation
+        path shared by the live API and WAL replay (``journal`` is a no-op
+        while replaying), so both produce identical state."""
+        self.journal(rec)
+        self._rem_apply(rec)
+
+    def _rem_plan_init(self, key: str, entry: dict) -> None:
+        """(Re)published plan: a fresh ``plan_version`` starts its canary
+        lifecycle; republishing the same version keeps the current status —
+        a quarantined version cannot launder itself by republication."""
+        plan_version = int((entry.get("meta") or {}).get("plan_version", 0))
+        rec = self._rem["plans"].get(key)
+        if rec is None or rec.get("plan_version") != plan_version:
+            self._rem["plans"][key] = {
+                "status": "canary",
+                "plan_version": plan_version,
+                "adopters": {},
+                "cohort": [],
+                "clean": [],
+            }
+
+    def _rem_apply(self, rec: dict) -> None:
+        op = rec["op"]
+        if op == "adopt":
+            plan = self._rem["plans"].get(rec["key"])
+            if plan is not None:
+                plan["adopters"][rec["gang"]] = rec["plan_version"]
+                if rec.get("cohort_add") and rec["gang"] not in plan["cohort"]:
+                    plan["cohort"].append(rec["gang"])
+        elif op == "quarantine":
+            plan = self._rem["plans"].get(rec["key"])
+            if plan is not None and plan["status"] != "quarantined":
+                plan["status"] = "quarantined"
+                plan["cites"] = list(rec.get("cites", []))
+                actions = self._rem["actions"]
+                actions["quarantine"] = actions.get("quarantine", 0) + 1
+        elif op == "canary":
+            plan = self._rem["plans"].get(rec["key"])
+            if plan is not None and rec["gang"] not in plan["clean"]:
+                plan["clean"].append(rec["gang"])
+        elif op == "plan_status":
+            plan = self._rem["plans"].get(rec["key"])
+            if plan is not None and plan["status"] != rec["status"]:
+                if plan["status"] == "canary" and rec["status"] == "default":
+                    actions = self._rem["actions"]
+                    actions["canary_graduate"] = actions.get("canary_graduate", 0) + 1
+                plan["status"] = rec["status"]
+        elif op == "directive":
+            lst = self._rem["directives"].setdefault(rec["gang"], [])
+            lst.append(dict(rec["directive"]))
+            action = rec["directive"].get("action", "unknown")
+            actions = self._rem["actions"]
+            actions[action] = actions.get(action, 0) + 1
+        elif op == "directive_ack":
+            for d in self._rem["directives"].get(rec["gang"], []):
+                if d["id"] == rec["id"]:
+                    d["acked"] = True
+
+    def plan_statuses(self) -> Dict[str, dict]:
+        """Deep copy of every plan's remediation record (status,
+        plan_version, adopters, canary cohort, clean reporters)."""
+        import json as _json
+
+        with self._lock:
+            return _json.loads(_json.dumps(self._rem["plans"]))
+
+    def mark_plan_quarantined(self, key: str, cites) -> bool:
+        """Quarantine one cached plan (idempotent; False when the key is
+        unknown or already quarantined).  ``cites`` are the indicting
+        incidents' trace_ids — journaled with the quarantine so the
+        evidence chain survives SIGKILL+replay."""
+        with self._lock:
+            rec = self._rem["plans"].get(key)
+            if rec is None or rec["status"] == "quarantined":
+                return False
+            self._rem_record({
+                "op": "quarantine", "key": key,
+                "cites": [str(t) for t in cites],
+            })
+        logger.warning("plan cache: QUARANTINED %s (cited: %s)", key, list(cites))
+        return True
+
+    def record_canary_clean(self, key: str, gang: str) -> Optional[str]:
+        """One canary adopter reported a clean window.  Returns ``"clean"``
+        (recorded), ``"graduated"`` (this report met ``canary_n`` and the
+        plan was promoted to default), or None (not a canary adopter /
+        already counted)."""
+        with self._lock:
+            rec = self._rem["plans"].get(key)
+            if (
+                rec is None or rec["status"] != "canary"
+                or gang not in rec["cohort"] or gang in rec["clean"]
+            ):
+                return None
+            self._rem_record({"op": "canary", "key": key, "gang": str(gang)})
+            if len(rec["clean"]) >= self.canary_n:
+                self._rem_record({"op": "plan_status", "key": key,
+                                  "status": "default"})
+                logger.info("plan cache: %s graduated canary -> default", key)
+                return "graduated"
+            return "clean"
+
+    def issue_directive(
+        self, gang_id: str, action: str, reason: str = "",
+        detail: Optional[dict] = None,
+    ) -> dict:
+        """Durably direct one gang (``rollback_plan``, ``resize``, ...).
+        The gang polls ``GET /g/<gang>/directive`` and acks; unacked
+        directives surface as the scheduler view's remediation-pending
+        marker."""
+        with self._lock:
+            lst = self._rem["directives"].get(gang_id, [])
+            directive = {
+                "id": 1 + max((d["id"] for d in lst), default=0),
+                "action": str(action),
+                "reason": str(reason),
+                "acked": False,
+            }
+            if detail:
+                directive["detail"] = dict(detail)
+            self._rem_record({"op": "directive", "gang": str(gang_id),
+                              "directive": directive})
+            return dict(directive)
+
+    def directive(self, gang_id: str) -> Optional[dict]:
+        """The gang's oldest pending (unacked) directive, or None."""
+        with self._lock:
+            for d in self._rem["directives"].get(gang_id, []):
+                if not d["acked"]:
+                    return dict(d)
+            return None
+
+    def ack_directive(self, gang_id: str, directive_id: int) -> bool:
+        with self._lock:
+            for d in self._rem["directives"].get(gang_id, []):
+                if d["id"] == int(directive_id) and not d["acked"]:
+                    self._rem_record({"op": "directive_ack",
+                                      "gang": str(gang_id),
+                                      "id": int(directive_id)})
+                    return True
+            return False
+
+    def pending_directives(self, gang_id: str) -> List[dict]:
+        with self._lock:
+            return [dict(d) for d in self._rem["directives"].get(gang_id, [])
+                    if not d["acked"]]
+
+    def remediation_summary(self) -> dict:
+        """Deep copy of the whole durable remediation tier (the
+        ``GET /fleet/remediation`` route)."""
+        import json as _json
+
+        with self._lock:
+            out = _json.loads(_json.dumps(self._rem))
+        out["canary_n"] = self.canary_n
+        return out
+
+    def flight_digests(self, gang_id: str) -> List[dict]:
+        """The gang's pushed flight digests for its most-advanced attempt —
+        the pseudo-dumps the RemediationEngine joins through
+        ``build_hang_report`` when the gang goes ``wedged``."""
+        with self._lock:
+            ns = self._gangs.get(gang_id)
+        if ns is None:
+            return []
+        st = ns.rendezvous
+        by_attempt: Dict[str, List[dict]] = {}
+        for key in st.kv_keys():
+            parts = key.split("/")
+            if key.startswith("bagua/flight/") and len(parts) == 4:
+                digest = st.kv_get(key)
+                if isinstance(digest, dict):
+                    by_attempt.setdefault(parts[2], []).append(digest)
+        if not by_attempt:
+            return []
+        def _advance(attempt: str) -> int:
+            return max(
+                (d["last_seq"] for d in by_attempt[attempt]
+                 if isinstance(d.get("last_seq"), int)),
+                default=-1,
+            )
+        return by_attempt[max(by_attempt, key=_advance)]
+
+    def remediate(self, **knobs) -> dict:
+        """Run one RemediationEngine sweep over this plane (the
+        ``POST /fleet/remediate`` route)."""
+        from bagua_tpu.fleet.remediation import RemediationEngine
+
+        return RemediationEngine(self, **knobs).sweep()
+
+    def shard_info(self) -> dict:
+        """Shard topology view (one unsharded plane = one shard)."""
+        with self._lock:
+            n_gangs = len(self._gangs)
+        return {
+            "n_shards": 1,
+            "gangs_per_shard": [n_gangs],
+            "wal_replay_ms": [self.wal_replay_ms],
+        }
 
     # -- scheduler view ---------------------------------------------------------
 
@@ -530,6 +809,10 @@ class FleetControlPlane:
             leases = dict(self._leases)
             incidents_by_gang = {g: list(ring) for g, ring in self._incidents.items()}
             decisions_by_gang = {g: list(ring) for g, ring in self._decisions.items()}
+            pending_by_gang = {
+                g: [dict(d) for d in lst if not d["acked"]]
+                for g, lst in self._rem["directives"].items()
+            }
         view = {"gangs": {}, "n_gangs": len(gangs)}
         for gang_id, ns in sorted(gangs.items()):
             st = ns.rendezvous
@@ -608,6 +891,15 @@ class FleetControlPlane:
                     if isinstance(last_dec, dict) else None
                 ),
                 "decisions": len(decisions),
+                # remediation-pending marker: the engine already directed
+                # this gang and the directive is not yet acked.  A marker,
+                # not a verdict rung — the ladder above is unchanged.
+                "remediation": (
+                    {"pending": len(pending_by_gang[gang_id]),
+                     "action": pending_by_gang[gang_id][0]["action"],
+                     "id": pending_by_gang[gang_id][0]["id"]}
+                    if pending_by_gang.get(gang_id) else None
+                ),
                 "flight_ranks": sorted(flight_ranks),
                 "ranks_reporting": len(summaries),
                 "max_step": max((s.step for s in summaries), default=-1),
@@ -938,6 +1230,33 @@ class FleetControlPlane:
                 help=f"seconds until gang {gang_id}'s lease expires",
             ).set(round(max(0.0, remaining), 3))
         return r
+
+    def metrics_text(self) -> str:
+        """The full ``/fleet/metrics`` exposition: the registry above plus
+        the labeled shard/remediation families the registry's label-less
+        instruments cannot express (composed by hand — same format)."""
+        text = self.metrics_registry().to_prometheus()
+        with self._lock:
+            actions = dict(self._rem["actions"])
+        lines = [
+            "# HELP bagua_fleet_shard_count control-plane shards serving this fleet",
+            "# TYPE bagua_fleet_shard_count gauge",
+            "bagua_fleet_shard_count 1",
+        ]
+        if self.wal is not None:
+            lines += [
+                "# HELP bagua_wal_replay_ms wall time of the last WAL replay per shard",
+                "# TYPE bagua_wal_replay_ms gauge",
+                f'bagua_wal_replay_ms{{shard="0"}} {self.wal_replay_ms}',
+            ]
+        if actions:
+            lines += [
+                "# HELP bagua_remediations_total remediation actions journaled, by action",
+                "# TYPE bagua_remediations_total counter",
+            ]
+            for action, n in sorted(actions.items()):
+                lines.append(f'bagua_remediations_total{{action="{action}"}} {n}')
+        return text + "\n".join(lines) + "\n"
 
     # -- durable-state witness --------------------------------------------------
 

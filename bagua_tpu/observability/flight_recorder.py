@@ -266,6 +266,11 @@ class FlightRecorder:
                  ("seq", "step", "label", "bucket", "phase", "plan_version")}
                 if last else None
             ),
+            # the newest few full records: enough for the fleet's
+            # RemediationEngine to synthesize a pseudo-dump per rank and
+            # run build_hang_report's first-desync join server-side, even
+            # when every dump file died with its host
+            "tail": [dict(r) for r in recs[-8:]],
             "mono": time.monotonic(),
         }
 

@@ -315,6 +315,35 @@ EVENT_PAYLOAD_FIELDS = {
         "to_config": dict,
         "verdict": str,
     },
+    # the fleet RemediationEngine quarantined a cached plan: its cache key
+    # and plan_version, the indicting incidents' trace_ids (cites), the
+    # regressed adopter gangs that indicted it, and the action taken
+    # (quarantine — rollback directives to every adopter ride as separate
+    # ``remediation`` events)
+    "plan_quarantine": {
+        "cache_key": str,
+        "plan_version": int,
+        "cites": list,
+        "gangs": list,
+        "action": str,
+    },
+    # one fleet remediation action directed at a gang (action: resize /
+    # rollback_plan / ...), with the hang/quarantine verdict that drove it
+    "remediation": {
+        "action": str,
+        "gang": str,
+        "reason": str,
+    },
+    # one canary-lifecycle transition for a cached plan (verdict: clean =
+    # an adopter reported a clean window; graduated = the plan was promoted
+    # to default after ``needed`` clean adopters)
+    "canary_verdict": {
+        "cache_key": str,
+        "plan_version": int,
+        "verdict": str,
+        "clean": list,
+        "needed": int,
+    },
 }
 
 #: the unified ``reason`` vocabulary every configuration switch

@@ -43,8 +43,11 @@ __all__ = [
     "FleetConfig",
     "FlakyClient",
     "KVFlap",
+    "KVFlapStorm",
     "Preemption",
+    "PreemptionStorm",
     "Straggler",
+    "churn_schedule",
     "run_fleet",
 ]
 
@@ -140,6 +143,61 @@ class KVFlap:
         return self.start_window <= window and (
             self.end_window is None or window < self.end_window
         )
+
+
+@dataclasses.dataclass(frozen=True)
+class PreemptionStorm:
+    """Fleet-scale churn profile: a seeded ``fraction`` of all gangs each
+    lose one rank at ``window`` (a zone reclaim hitting many tenants at
+    once).  :meth:`expand` materializes the concrete per-gang
+    :class:`Preemption` faults — deterministic under the seed, so a storm
+    at 1000 gangs diffs clean across runs."""
+
+    fraction: float = 0.1
+    window: int = 2
+    rank: int = 1
+
+    def expand(self, n_gangs: int, seed: int = 0) -> List[Preemption]:
+        rng = random.Random(1_000_033 * seed + 7)
+        hit = rng.sample(range(n_gangs), max(1, int(n_gangs * self.fraction)))
+        return [Preemption(gang=g, rank=self.rank, window=self.window)
+                for g in sorted(hit)]
+
+
+@dataclasses.dataclass(frozen=True)
+class KVFlapStorm:
+    """Fleet-scale churn profile: a seeded ``fraction`` of all gangs lose
+    their KV transport over ``[start, end)`` windows simultaneously (a
+    control-plane brownout as seen from the tenants)."""
+
+    fraction: float = 0.1
+    start_window: int = 1
+    end_window: Optional[int] = 2
+
+    def expand(self, n_gangs: int, seed: int = 0) -> List[KVFlap]:
+        rng = random.Random(1_000_037 * seed + 11)
+        hit = rng.sample(range(n_gangs), max(1, int(n_gangs * self.fraction)))
+        return [KVFlap(gang=g, start_window=self.start_window,
+                       end_window=self.end_window)
+                for g in sorted(hit)]
+
+
+def churn_schedule(
+    n_gangs: int,
+    seed: int = 0,
+    preempt_fraction: float = 0.1,
+    flap_fraction: float = 0.1,
+    windows: int = 3,
+) -> Tuple:
+    """The default storm mix the 1000-gang scale lane drives: a preemption
+    storm mid-run plus a KV-flap brownout in the first window (disjoint
+    RNG streams, so the two storms hit independent gang subsets).  Returns
+    a concrete fault tuple for :attr:`FleetConfig.faults`."""
+    storm = PreemptionStorm(
+        fraction=preempt_fraction, window=max(2, windows // 2 + 1)
+    )
+    flap = KVFlapStorm(fraction=flap_fraction, start_window=1, end_window=2)
+    return tuple(storm.expand(n_gangs, seed) + flap.expand(n_gangs, seed))
 
 
 class FlakyClient:

@@ -28,6 +28,7 @@ from bagua_tpu.resilience.retry import (
     CircuitOpenError,
     RetryPolicy,
     retry_call,
+    seed_backoff,
 )
 from bagua_tpu.resilience.snapshot import (
     MANIFEST_FILENAME,
@@ -50,4 +51,5 @@ __all__ = [
     "CircuitBreaker",
     "CircuitOpenError",
     "retry_call",
+    "seed_backoff",
 ]

@@ -65,6 +65,11 @@ class ElasticResumeCoordinator:
             from the fleet's cross-gang cache (or None on a miss) — e.g.
             ``lambda: fleet.lookup_plan(**key)["plan"]``.  Consulted by
             :meth:`fleet_warm_start` when there is no snapshot to resume.
+        fleet_directive_fn: optional zero-arg callable returning the gang's
+            oldest pending remediation directive (or None) — e.g.
+            ``lambda: fleet.gang_directive(gang_id)``.  Consulted by
+            :meth:`directed_world_size` so a RemediationEngine ``resize``
+            directive steers the re-formed gang's target world size.
     """
 
     def __init__(
@@ -75,6 +80,7 @@ class ElasticResumeCoordinator:
         telemetry=None,
         agreement_timeout_s: float = 30.0,
         fleet_plan_fn=None,
+        fleet_directive_fn=None,
     ):
         self.store = store if isinstance(store, SnapshotStore) else SnapshotStore(store)
         self.rendezvous_client = rendezvous_client
@@ -82,6 +88,7 @@ class ElasticResumeCoordinator:
         self.telemetry = telemetry
         self.agreement_timeout_s = agreement_timeout_s
         self.fleet_plan_fn = fleet_plan_fn
+        self.fleet_directive_fn = fleet_directive_fn
 
     # -- snapshot agreement --------------------------------------------------
 
@@ -289,6 +296,40 @@ class ElasticResumeCoordinator:
                 lost_steps=0,
             )
         return "fleet"
+
+    # -- fleet remediation directives -----------------------------------------
+
+    def fleet_directive(self) -> Optional[Dict[str, Any]]:
+        """The gang's oldest pending remediation directive, or None.
+        Advisory and exception-fenced: an unreachable fleet never blocks a
+        restart."""
+        if self.fleet_directive_fn is None:
+            return None
+        try:
+            directive = self.fleet_directive_fn()
+        except Exception as e:
+            logger.warning("fleet directive poll failed (advisory): %s", e)
+            return None
+        return directive if isinstance(directive, dict) else None
+
+    def directed_world_size(self, default: int) -> int:
+        """The world size the re-forming gang should target: a pending
+        ``resize`` directive's ``to_world_size`` when the RemediationEngine
+        diagnosed this gang (desync/host_wedge) and directed it smaller;
+        ``default`` (the launcher's own count) otherwise."""
+        directive = self.fleet_directive()
+        if not directive or directive.get("action") != "resize":
+            return int(default)
+        to_world = (directive.get("detail") or {}).get("to_world_size")
+        if isinstance(to_world, int) and to_world >= 1:
+            logger.warning(
+                "fleet resize directive #%s (%s): targeting world size %d "
+                "instead of %d",
+                directive.get("id"), directive.get("reason"), to_world,
+                int(default),
+            )
+            return to_world
+        return int(default)
 
     def _adopt_plan(self, ddp, payload: Optional[Dict[str, Any]]) -> bool:
         """Re-adopt the snapshot's bucket plan (no planner cold-start).  Best

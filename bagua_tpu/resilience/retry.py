@@ -29,9 +29,23 @@ __all__ = [
     "CircuitBreaker",
     "RetryPolicy",
     "retry_call",
+    "seed_backoff",
     "set_retry_observer",
     "get_retry_observer",
 ]
+
+#: process-wide backoff jitter source.  Every seedless :class:`RetryPolicy`
+#: draws from this one RNG (instead of re-seeding a private ``Random`` per
+#: call site), so a test can pin the whole process's retry timing with one
+#: :func:`seed_backoff` call.  An explicit ``RetryPolicy(seed=...)`` still
+#: gets its own isolated stream.
+_backoff_rng = random.Random()
+
+
+def seed_backoff(seed: Optional[int]) -> None:
+    """Re-seed the process-wide backoff jitter RNG (deterministic retry
+    timing for tests; ``None`` re-seeds from the OS)."""
+    _backoff_rng.seed(seed)
 
 
 #: process-wide retry observer: ``observer(endpoint, attempt, delay_s,
@@ -218,7 +232,9 @@ class RetryPolicy:
         self.retries = get_rpc_retries() if retries is None else retries
         self.base_s = get_rpc_backoff_base_s() if base_s is None else base_s
         self.max_s = get_rpc_backoff_max_s() if max_s is None else max_s
-        self._rng = random.Random(seed)
+        # seedless policies share the module-level RNG (seed_backoff pins
+        # it); an explicit seed keeps a private, isolated stream
+        self._rng = _backoff_rng if seed is None else random.Random(seed)
 
     def backoff_s(self, attempt: int) -> float:
         return self._rng.uniform(0.0, min(self.max_s, self.base_s * (2 ** attempt)))

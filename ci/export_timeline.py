@@ -32,7 +32,13 @@ mapping:
   "decision"`` timeline rows) → ``i`` instants named
   ``plan_decision:<decision>`` with the from/to configuration, verdict and
   the triggering incident's ``trace_id`` in ``args`` — incident and
-  response visible on the same canvas.
+  response visible on the same canvas;
+* fleet remediation events — ``plan_quarantine`` / ``remediation`` /
+  ``canary_verdict`` rows the remediation engine pushed into gang
+  timelines — → ``i`` instants named ``plan_quarantine:v<version>``,
+  ``remediation:<action>`` and ``canary_verdict:<verdict>`` (cat
+  ``remediation``), carrying the indicting incidents' ``cites``, the
+  rolled-back gangs and the canary cohort progress in ``args``.
 
 :func:`validate_chrome_trace` schema-checks the output — the CI tracing
 lane gates on it.  Stdlib only.
@@ -104,7 +110,13 @@ def load_timeline(payload: dict) -> "tuple[List[dict], List[dict]]":
 
 
 #: metrics-JSONL event kinds that render as timeline instants
-_ANNOTATION_EVENTS = ("perf_regression", "plan_decision")
+_ANNOTATION_EVENTS = (
+    "perf_regression",
+    "plan_decision",
+    "plan_quarantine",
+    "remediation",
+    "canary_verdict",
+)
 
 
 def load_metrics_incidents(path: str) -> List[dict]:
@@ -259,6 +271,21 @@ def spans_to_trace_events(
             # from/to configs + verdict + citing trace_id ride in args
             name = f"plan_decision:{ev.get('decision') or 'unknown'}"
             cat = "decision"
+        elif name == "plan_quarantine":
+            # fleet remediation verdicts render like autopilot decisions:
+            # the quarantined plan version headlines, the indicting
+            # incidents' trace_ids (cites) + rolled-back gangs ride in args
+            name = f"plan_quarantine:v{ev.get('plan_version')}"
+            cat = "remediation"
+        elif name == "remediation":
+            # per-gang remediation actions (rollback_plan / resize / ...)
+            name = f"remediation:{ev.get('action') or 'unknown'}"
+            cat = "remediation"
+        elif name == "canary_verdict":
+            # canary cohort progress: clean adopter windows and the
+            # graduation instant, joined to the plan by plan_version
+            name = f"canary_verdict:{ev.get('verdict') or 'unknown'}"
+            cat = "remediation"
         pid, tid = tracks.resolve("events", name)
         out.append({
             "ph": "i", "name": name,

@@ -3573,6 +3573,18 @@ def main():
             tempfile.mkdtemp(prefix="bagua_fleet_load_"),
             args.out + "_fleet_load.json",
         )
+    # Fleet scale gate: the sharded async control plane + remediation engine
+    # under a thundering herd, preemption/flap storms, and a SIGKILL with
+    # per-shard bitwise WAL replay — the quick (120-gang) variant here; the
+    # standalone lane defaults to 1000 gangs.
+    fleet_scale_result = None
+    if args.algo is None and args.wire is None:
+        import fleet_scale
+
+        fleet_scale_result = fleet_scale.run_lane(
+            tempfile.mkdtemp(prefix="bagua_fleet_scale_"),
+            args.out + "_fleet_scale.json",
+        )
     fsdp_result = None if args.ddp_only else audit_fsdp()[0]
 
     trace = load_trace_overlap()
@@ -3594,7 +3606,8 @@ def main():
              "straggler_tolerance": straggler_result,
              "axis_attribution": axis_attribution_result,
              "resilience": resilience_result,
-             "fleet_load": fleet_load_result},
+             "fleet_load": fleet_load_result,
+             "fleet_scale": fleet_scale_result},
             f, indent=1,
         )
     with open(args.out + ".md", "w") as f:

@@ -31,6 +31,14 @@ Usage::
     python ci/perf_doctor.py --metrics metrics.jsonl --step 1200
     python ci/perf_doctor.py --metrics metrics.jsonl \
         --spans spans.jsonl --flight-dir dumps --out incident.json
+    python ci/perf_doctor.py --metrics metrics.jsonl --quarantine
+
+``--quarantine`` flips the doctor to the fleet remediation side: instead
+of one incident it joins the latest ``plan_quarantine`` verdict back to
+the ``perf_regression`` incidents it cites (by ``trace_id``), the fleet
+plan adoptions that exposed gangs to the bad plan, the per-gang
+``rollback_plan`` remediation rows, and the plan's ``canary_verdict``
+history — the full indict-then-remediate chain in one screen.
 """
 
 import argparse
@@ -54,6 +62,9 @@ __all__ = [
     "select_incident",
     "build_incident_report",
     "render_report",
+    "select_quarantine",
+    "build_quarantine_report",
+    "render_quarantine_report",
 ]
 
 #: how many steps on each side of the incident count as "around it"
@@ -223,6 +234,117 @@ def build_incident_report(
     return report
 
 
+def select_quarantine(events: List[dict]) -> Optional[dict]:
+    """The ``plan_quarantine`` event to diagnose (the latest)."""
+    quarantines = [e for e in events if e.get("event") == "plan_quarantine"]
+    return quarantines[-1] if quarantines else None
+
+
+def build_quarantine_report(
+    quarantine: dict, events: List[dict]
+) -> dict:
+    """Join one fleet ``plan_quarantine`` verdict back to its evidence.
+
+    The quarantine event names the indicting incidents by ``trace_id``
+    (``cites``) and the quarantined plan by ``plan_version`` — this walks
+    the same metrics stream and recovers the full causal chain:
+
+    * the ``perf_regression`` incidents whose ``trace_id`` the quarantine
+      cites — the indictment itself, with each incident's budget verdict;
+    * the fleet-plan adoptions that exposed gangs to the bad plan:
+      ``restart`` events with ``plan_source == "fleet"``;
+    * the remediation engine's response: per-gang ``remediation`` rows
+      whose reason carries this quarantine's ``plan_version``
+      (``rollback_plan``), plus any other remediation actions nearby;
+    * the plan's canary history: ``canary_verdict`` rows for the same
+      ``plan_version`` — whether the plan graduated before it went bad.
+    """
+    plan_version = quarantine.get("plan_version")
+    cites = set(quarantine.get("cites") or [])
+    incidents = [
+        e for e in events
+        if e.get("event") == "perf_regression" and e.get("trace_id") in cites
+    ]
+    uncited = sorted(
+        cites - {e.get("trace_id") for e in incidents}
+    )  # cited but not in these metrics files — name them, don't hide them
+    adoptions = [
+        e for e in events
+        if e.get("event") == "restart" and e.get("plan_source") == "fleet"
+    ]
+    version_tag = f"v{plan_version}"
+    rollbacks = [
+        e for e in events
+        if e.get("event") == "remediation"
+        and e.get("action") == "rollback_plan"
+        and version_tag in str(e.get("reason") or "")
+    ]
+    other_remediations = [
+        e for e in events
+        if e.get("event") == "remediation" and e not in rollbacks
+    ]
+    canary = [
+        e for e in events
+        if e.get("event") == "canary_verdict"
+        and e.get("plan_version") == plan_version
+    ]
+    return {
+        "quarantine": quarantine,
+        "cache_key": quarantine.get("cache_key"),
+        "plan_version": plan_version,
+        "cites": sorted(cites),
+        "uncited_trace_ids": uncited,
+        "incidents": incidents,
+        "adoptions": adoptions,
+        "rollbacks": rollbacks,
+        "other_remediations": other_remediations,
+        "canary_history": canary,
+        "rolled_back_gangs": sorted(quarantine.get("gangs") or []),
+    }
+
+
+def render_quarantine_report(report: dict) -> str:
+    """The human one-screen answer to "why was this plan quarantined"."""
+    q = report["quarantine"]
+    lines = [
+        f"perf_doctor: plan {report.get('cache_key')} v"
+        f"{report.get('plan_version')} was quarantined fleet-wide",
+        f"  indicted by {len(report.get('cites') or [])} incident(s); "
+        f"{len(report.get('rolled_back_gangs') or [])} adopter gang(s) "
+        f"rolled back: {report.get('rolled_back_gangs')}",
+    ]
+    for inc in report.get("incidents") or []:
+        lines.append(
+            f"  incident {inc.get('trace_id')}: step {inc.get('step')} "
+            f"regressed, dominant {inc.get('dominant')} "
+            f"({_fmt_ms(inc.get('residual_ms'))} residual) under "
+            f"plan_version {inc.get('plan_version')}"
+        )
+    for tid in report.get("uncited_trace_ids") or []:
+        lines.append(f"  incident {tid}: cited by the quarantine but not "
+                     "present in the given metrics files")
+    for ad in report.get("adoptions") or []:
+        lines.append(
+            f"  adoption: restart at step {ad.get('step')} took the fleet "
+            f"plan (world {ad.get('old_world_size')} -> "
+            f"{ad.get('new_world_size')})"
+        )
+    for rb in report.get("rollbacks") or []:
+        lines.append(
+            f"  rollback directed at gang {rb.get('gang')} "
+            f"[{rb.get('reason')}]"
+        )
+    for cv in report.get("canary_history") or []:
+        lines.append(
+            f"  canary history: {cv.get('verdict')} "
+            f"({len(cv.get('clean') or [])}/{cv.get('needed')} clean) at "
+            f"step {cv.get('step')}"
+        )
+    if q.get("ts"):
+        lines.append(f"  quarantine recorded at ts {q['ts']}")
+    return "\n".join(lines)
+
+
 def _fmt_ms(v) -> str:
     return f"{float(v):.3f} ms" if isinstance(v, (int, float)) else "n/a"
 
@@ -339,6 +461,9 @@ def main(argv=None) -> int:
     ap.add_argument("--flight-glob", default=None,
                     help="explicit glob for flight dumps (overrides "
                     "--flight-dir)")
+    ap.add_argument("--quarantine", action="store_true",
+                    help="diagnose the latest fleet plan_quarantine verdict "
+                    "instead of a perf_regression incident")
     ap.add_argument("--out", default=None,
                     help="write the joined incident report JSON here")
     args = ap.parse_args(argv)
@@ -348,6 +473,22 @@ def main(argv=None) -> int:
         print("perf_doctor: no valid events in the given metrics files",
               file=sys.stderr)
         return 2
+    if args.quarantine:
+        quarantine = select_quarantine(events)
+        if quarantine is None:
+            print("perf_doctor: no plan_quarantine events found "
+                  "(did the remediation engine sweep?)", file=sys.stderr)
+            return 2
+        report = build_quarantine_report(quarantine, events)
+        if args.out:
+            tmp = f"{args.out}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(report, indent=2, sort_keys=True) + "\n")
+            os.replace(tmp, args.out)
+            print(f"perf_doctor: report written to {args.out}",
+                  file=sys.stderr)
+        print(render_quarantine_report(report))
+        return 0
     incident = select_incident(events, args.step)
     if incident is None:
         print("perf_doctor: no perf_regression incidents found "

@@ -47,6 +47,7 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert "bench modeled lane passed" in proc.stderr
     assert "fleet sim lane passed" in proc.stderr
     assert "fleet load lane passed" in proc.stderr
+    assert "fleet scale lane passed" in proc.stderr
     assert "regression attribution lane passed" in proc.stderr
     assert "autopilot lane passed" in proc.stderr
     assert "axis attribution lane passed" in proc.stderr
@@ -233,6 +234,38 @@ def test_perf_audit_quick_overlap_census(tmp_path):
     assert fl["plan_adoption"]["plan_source"] == "fleet"
     assert fl["plan_adoption"]["published_before_kill"] is True
     assert audit["fleet_load"] == fl
+
+    # The 1000-gang scale lane's quick variant: a sharded selector-loop
+    # control plane absorbed the thundering herd with the canary gate
+    # holding every non-cohort gang, held the p99 latency and scheduler
+    # staleness gates under a preemption storm + KV flap (real 429s drawn),
+    # closed all three remediation arcs — exact-correlation quarantine with
+    # zero false positives and fleet-wide rollback, wedged-gang hang
+    # diagnosis -> resize directive, canary graduation — and replayed every
+    # per-shard WAL to the bitwise dump after a SIGKILL.
+    with open(str(out) + "_fleet_scale.json") as f:
+        fs = json.load(f)
+    assert fs["n_gangs"] >= 100 and fs["server"]["shards"] == 4
+    assert fs["herd"]["gangs"] == fs["n_gangs"]
+    assert fs["herd"]["withheld_by_canary_gate"] >= fs["n_gangs"] - 2
+    assert all(n > 0 for n in fs["herd"]["gangs_per_shard"])
+    assert fs["churn"]["flap_429"] >= 1
+    assert fs["latency"]["p99_ms"] <= fs["latency"]["gate_ms"]
+    assert fs["staleness"]["observed_s"] <= fs["staleness"]["gate_s"]
+    rem = fs["remediation"]
+    assert rem["false_quarantines"] == 0
+    assert len(rem["quarantined"]) == 1 and rem["quarantine_cites"]
+    assert rem["rollback_gangs"] == ["b0", "b1"]
+    assert rem["resize"]["verdict"] == "desync"
+    assert rem["resize"]["to_world_size"] == 1
+    assert rem["idempotent_resweep"] is True and rem["graduated"]
+    assert fs["sigkill"]["dump_bitwise_identical"] is True
+    assert fs["sigkill"]["remediation_state_survived"] is True
+    assert all(
+        0 < ms <= fs["sigkill"]["replay_gate_ms"]
+        for ms in fs["sigkill"]["wal_replay_ms"]
+    )
+    assert audit["fleet_scale"] == fs
 
     # The regression-attribution lane's artifact: a clean 200-step sentinel-on
     # run emitted zero perf_regression incidents while exporting every

@@ -213,16 +213,23 @@ def test_shift_one_odd_world_construction_fence():
             exchange_size=intra * inter,
         )
 
-    with pytest.raises(ValueError, match="even number"):
+    # the fence must name the failing peer count AND suggest both remedies
+    # (resize to an even world, or fall back to peer_selection_mode='all')
+    with pytest.raises(ValueError, match="even number") as exc:
         DecentralizedAlgorithmImpl(
             fake_group(1, 3), hierarchical=False,
             peer_selection_mode="shift_one",
         )
-    with pytest.raises(ValueError, match="even number"):
+    msg = str(exc.value)
+    assert "3 peers" in msg
+    assert "e.g. 2 or 4" in msg
+    assert "peer_selection_mode='all'" in msg
+    with pytest.raises(ValueError, match="even number") as exc:
         DecentralizedAlgorithmImpl(
             fake_group(4, 3), hierarchical=True,
             peer_selection_mode="shift_one",
         )
+    assert "3 peers" in str(exc.value)
     # even peers (flat 8, and hierarchical inter=2) construct fine
     DecentralizedAlgorithmImpl(
         fake_group(1, 8), hierarchical=False, peer_selection_mode="shift_one"

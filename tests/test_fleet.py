@@ -19,6 +19,7 @@ Pins the PR-13 contract end to end:
   gang's published plan at step 0 with ``plan_source="fleet"``.
 """
 
+import http.client
 import json
 import os
 import subprocess
@@ -40,6 +41,9 @@ from bagua_tpu.env import get_rpc_timeout_s
 from bagua_tpu.fleet import (
     FleetClient,
     FleetControlPlane,
+    HashRing,
+    RemediationEngine,
+    ShardedControlPlane,
     TokenBucket,
     WriteAheadLog,
     adopt_fleet_plan,
@@ -48,6 +52,7 @@ from bagua_tpu.fleet import (
     model_fingerprint,
     plan_cache_key,
     publish_engine_plan,
+    start_async_fleet_server,
     start_fleet_server,
 )
 from bagua_tpu.models.mlp import init_mlp, mse_loss
@@ -1022,3 +1027,321 @@ def test_fleet_axis_incident_and_decision_round_trip():
         assert "axis" not in old["autopilot"]
     finally:
         server.shutdown()
+
+
+# ---------------- remediation engine: the verdict-driven fleet loop -----------
+
+
+def _push_summary(plane, gang, rank, p50, step=5, attempt="0"):
+    plane.gang(gang).rendezvous.kv_set(
+        gang_kv_key(attempt, rank),
+        StepSummary(rank=rank, step=step, p50_ms=p50).payload(),
+    )
+
+
+def _flight_digest(rank, label_at_2):
+    tail = []
+    for seq in range(3):
+        label = label_at_2 if seq == 2 else f"allreduce:b{seq}"
+        tail.append({
+            "seq": seq, "step": seq, "label": label, "algo": "allreduce",
+            "bucket": seq, "phase": "wire", "precision": "fp32",
+            "nbytes": 1 << 20, "plan_version": 1, "variant": "sync",
+            "t_enqueue": 1.0 + seq, "t_retire": 1.5 + seq,
+        })
+    return {"rank": rank, "last_seq": 2, "tail": tail, "mono": 120.0,
+            "unretired": 0}
+
+
+PLAN_DIMS = {"topology": "cpu:8", "algorithm": "gradient_allreduce",
+             "wire_precision": "fp32"}
+
+
+def test_remediation_quarantine_exact_correlation_and_wal_replay(tmp_path):
+    """Arc 1 end to end: incidents citing the adopted plan_version quarantine
+    the plan and roll back EVERY adopter; a regressed gang whose incidents
+    name a different version indicts nothing (zero false quarantines); every
+    action replays bitwise from the WAL, and the labeled remediation metric
+    families count what the journal counted."""
+    wal_dir = str(tmp_path / "wal")
+    plane = FleetControlPlane(wal_dir=wal_dir, rdzv_kwargs=RDZV_FAST)
+    bad_key = plane.plan_put("bad", plan={"buckets": [["w"]]},
+                             meta={"plan_version": 2}, **PLAN_DIMS)
+    good_key = plane.plan_put("good", plan={"buckets": [["w"]]},
+                              meta={"plan_version": 1}, **PLAN_DIMS)
+    for gang in ("b0", "b1"):
+        assert plane.plan_get("bad", gang=gang, **PLAN_DIMS) is not None
+        _push_summary(plane, gang, 0, 10.0)
+    assert plane.plan_get("good", gang="h0", **PLAN_DIMS) is not None
+    _push_summary(plane, "h0", 0, 10.0)
+
+    # b0/b1 indict version 2 by trace; h0 regresses on an UNRELATED version
+    for i, gang in enumerate(("b0", "b1")):
+        plane.ingest_incidents(gang, [{
+            "step": 5, "dominant": "wire_slowdown", "stream": "step_wall",
+            "plan_version": 2, "trace_id": f"bad-trace-{i}",
+        }])
+    plane.ingest_incidents("h0", [{
+        "step": 5, "dominant": "wire_slowdown", "stream": "step_wall",
+        "plan_version": 999, "trace_id": "noise-trace",
+    }])
+
+    summary = RemediationEngine(plane).sweep()
+    assert summary["quarantined"] == [bad_key]
+    assert sorted(r["gang"] for r in summary["rollbacks"]) == ["b0", "b1"]
+    statuses = plane.plan_statuses()
+    assert statuses[bad_key]["status"] == "quarantined"
+    assert statuses[bad_key]["cites"] == ["bad-trace-0", "bad-trace-1"]
+    assert statuses[good_key]["status"] != "quarantined"  # no false positive
+    # a quarantined plan is never served again — not even to a fresh gang
+    assert plane.plan_get("bad", gang="b9", **PLAN_DIMS) is None
+    # republication of the same version cannot launder the quarantine
+    plane.plan_put("bad", plan={"buckets": [["w"]]},
+                   meta={"plan_version": 2}, **PLAN_DIMS)
+    assert plane.plan_get("bad", gang="b9", **PLAN_DIMS) is None
+
+    (quarantine_ev,) = [e for e in summary["events"]
+                        if e["event"] == "plan_quarantine"]
+    assert quarantine_ev["cites"] == ["bad-trace-0", "bad-trace-1"]
+    assert quarantine_ev["gangs"] == ["b0", "b1"]
+
+    d = plane.directive("b0")
+    assert d["action"] == "rollback_plan"
+    assert d["reason"] == "plan_quarantine:v2"
+    assert d["detail"]["cache_key"] == bad_key
+    assert plane.ack_directive("b0", d["id"])
+    # the unacked rollback surfaces as b1's remediation-pending marker
+    gangs = plane.scheduler_view()["gangs"]
+    assert gangs["b1"]["remediation"] == {
+        "pending": 1, "action": "rollback_plan",
+        "id": plane.directive("b1")["id"],
+    }
+    assert gangs["b0"]["remediation"] is None
+
+    text = plane.metrics_text()
+    assert "bagua_fleet_shard_count 1" in text
+    assert 'bagua_wal_replay_ms{shard="0"}' in text
+    assert 'bagua_remediations_total{action="quarantine"} 1' in text
+    assert 'bagua_remediations_total{action="rollback_plan"} 2' in text
+
+    # crash + replay: the whole remediation tier is bitwise-identical, live
+    pre = plane.dump()
+    plane2 = FleetControlPlane(wal_dir=wal_dir, rdzv_kwargs=RDZV_FAST)
+    assert _canon(plane2.dump()) == _canon(pre)
+    assert plane2.wal_replay_ms > 0
+    assert plane2.plan_get("bad", gang="b9", **PLAN_DIMS) is None
+    assert plane2.directive("b0") is None          # the ack survived
+    assert plane2.directive("b1")["action"] == "rollback_plan"
+    assert 'bagua_remediations_total{action="quarantine"} 1' in plane2.metrics_text()
+
+
+def test_remediation_canary_gate_and_graduation():
+    """Arc 3: a fresh plan_version serves only its first ``canary_n``
+    adopters; once every cohort member reports a healthy window the plan
+    graduates to default and the withheld gang is finally served."""
+    plane = FleetControlPlane(rdzv_kwargs=RDZV_FAST, canary_n=2)
+    key = plane.plan_put("cand", plan={"buckets": [["w"]]},
+                         meta={"plan_version": 3}, **PLAN_DIMS)
+    assert plane.plan_get("cand", gang="c0", **PLAN_DIMS) is not None
+    assert plane.plan_get("cand", gang="c1", **PLAN_DIMS) is not None
+    # cohort full: a third gang is withheld, but the legacy gang-less read
+    # (no adoption, no canary exposure) still sees the cache entry
+    assert plane.plan_get("cand", gang="c2", **PLAN_DIMS) is None
+    assert plane.plan_get("cand", **PLAN_DIMS) is not None
+    assert plane.plan_statuses()[key]["cohort"] == ["c0", "c1"]
+
+    for gang in ("c0", "c1"):
+        _push_summary(plane, gang, 0, 10.0)
+        _push_summary(plane, gang, 1, 11.0)
+    summary = RemediationEngine(plane).sweep()
+    assert [c["gang"] for c in summary["clean"]] == ["c0", "c1"]
+    assert summary["graduated"] == [key]
+    assert summary["quarantined"] == [] and summary["resized"] == []
+    rec = plane.plan_statuses()[key]
+    assert rec["status"] == "default" and rec["clean"] == ["c0", "c1"]
+    assert plane.plan_get("cand", gang="c2", **PLAN_DIMS) is not None
+    verdicts = [e["verdict"] for e in summary["events"]
+                if e["event"] == "canary_verdict"]
+    assert verdicts == ["clean", "clean", "graduated"]
+    # idempotent: a graduated plan produces no further canary traffic
+    again = RemediationEngine(plane).sweep()
+    assert again["clean"] == [] and again["graduated"] == []
+
+
+def test_remediation_wedged_resize_directive_over_async_http():
+    """Arc 2 over the selector-loop server: pushed flight digests whose
+    tails first diverge at one seq join to a ``desync`` hang report; the
+    sweep directs a resize shedding the divergent rank, re-sweeping while
+    the directive is pending is a no-op, and the gang fetches + acks the
+    directive over HTTP."""
+    plane = FleetControlPlane(rdzv_kwargs=RDZV_FAST)
+    server = start_async_fleet_server(plane, 0, host="127.0.0.1")
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        fc = FleetClient(base)
+        rc = fc.rendezvous_client("w0", 0)
+        rc.kv_set(flight_kv_key("0", 0), _flight_digest(0, "allreduce:b2"))
+        rc.kv_set(flight_kv_key("0", 1), _flight_digest(1, "allgather:bX"))
+        assert fc.scheduler_view()["gangs"]["w0"]["verdict"] == "wedged"
+
+        sweep = fc.remediate()
+        (resized,) = sweep["resized"]
+        assert resized == {"gang": "w0", "verdict": "desync",
+                           "to_world_size": 1}
+        # pending directive -> the next sweep must not double-direct
+        assert fc.remediate()["resized"] == []
+        assert fc.scheduler_view()["gangs"]["w0"]["remediation"]["action"] == "resize"
+
+        d = fc.gang_directive("w0")
+        assert d["action"] == "resize" and d["reason"] == "hang:desync"
+        assert d["detail"]["to_world_size"] == 1
+        assert d["detail"]["implicated_ranks"] == [1]
+        assert fc.ack_directive("w0", d["id"])
+        assert fc.gang_directive("w0") is None
+        rem = fc.remediation()
+        assert rem["actions"]["resize"] == 1
+    finally:
+        server.shutdown()
+
+
+def test_scheduler_view_verdict_races_with_remediation_marker():
+    """The remediation-pending marker is a marker, not a verdict rung: it
+    rides straggler/regressed/wedged/healthy rows without moving them on
+    the ladder, always names the OLDEST pending directive, and clears only
+    when the last directive is acked."""
+    plane = FleetControlPlane(rdzv_kwargs=RDZV_FAST)
+    incident = {"step": 3, "dominant": "wire_slowdown", "stream": "step_wall"}
+
+    # race: straggler spread AND a regression incident AND a directive
+    _push_summary(plane, "race", 0, 10.0, step=3)
+    _push_summary(plane, "race", 1, 40.0, step=3)
+    plane.ingest_incidents("race", [incident])
+    first = plane.issue_directive("race", "rollback_plan", reason="q:v2")
+    second = plane.issue_directive("race", "resize", reason="hang:desync")
+    row = plane.scheduler_view()["gangs"]["race"]
+    assert row["verdict"] == "straggler"      # the marker did not outrank
+    assert row["regressed"] is True           # the losing fact survives
+    assert row["remediation"] == {"pending": 2, "action": "rollback_plan",
+                                  "id": first["id"]}
+    # acking the oldest promotes the next-oldest into the marker
+    assert plane.ack_directive("race", first["id"])
+    row = plane.scheduler_view()["gangs"]["race"]
+    assert row["verdict"] == "straggler"
+    assert row["remediation"] == {"pending": 1, "action": "resize",
+                                  "id": second["id"]}
+    assert plane.ack_directive("race", second["id"])
+    assert plane.scheduler_view()["gangs"]["race"]["remediation"] is None
+
+    # a healthy gang under direction stays healthy; wedged stays wedged
+    _push_summary(plane, "ok", 0, 10.0)
+    _push_summary(plane, "ok", 1, 11.0)
+    plane.issue_directive("ok", "rollback_plan", reason="q:v9")
+    plane.gang("wedge").rendezvous.kv_set(flight_kv_key("0", 0),
+                                          _flight_digest(0, "allreduce:b2"))
+    plane.issue_directive("wedge", "resize", reason="hang:host_wedge")
+    gangs = plane.scheduler_view()["gangs"]
+    assert gangs["ok"]["verdict"] == "healthy"
+    assert gangs["ok"]["remediation"]["action"] == "rollback_plan"
+    assert gangs["wedge"]["verdict"] == "wedged"
+    assert gangs["wedge"]["remediation"]["action"] == "resize"
+
+
+# ---------------- sharded control plane ---------------------------------------
+
+
+def test_sharded_plane_routing_fanout_merge_and_replay(tmp_path):
+    """Consistent-hash sharding: routing is deterministic across ring
+    rebuilds, every shard takes load, fleet-wide reads merge all shards,
+    plan ops route by plan key (one authoritative shard), and a restart
+    on the same WAL dirs replays every shard to the bitwise dump."""
+    keys = [f"gang:g{i}" for i in range(200)]
+    ring = HashRing(4)
+    assert [ring.shard_for(k) for k in keys] == [
+        HashRing(4).shard_for(k) for k in keys
+    ]
+    assert {ring.shard_for(k) for k in keys} == {0, 1, 2, 3}
+
+    wal_dir = str(tmp_path / "wal")
+    fleet = ShardedControlPlane(n_shards=4, wal_dir=wal_dir,
+                                rdzv_kwargs=RDZV_FAST)
+    gangs = [f"g{i}" for i in range(12)]
+    for i, gang in enumerate(gangs):
+        fleet.gang(gang).rendezvous.kv_set("warm", i)
+    assert fleet.gang_ids() == sorted(gangs)
+    info = fleet.shard_info()
+    assert info["n_shards"] == 4
+    assert sum(info["gangs_per_shard"]) == 12
+    assert len(info["wal_replay_ms"]) == 4
+    # isolation across the ring: one gang's key reads nothing elsewhere
+    assert fleet.gang("g0").rendezvous.kv_get("warm") == 0
+    assert fleet.gang("g1").rendezvous.kv_get("nope") is None
+
+    key = fleet.plan_put("fp", plan={"buckets": [["w"]]},
+                         meta={"plan_version": 1}, **PLAN_DIMS)
+    owners = [s for s in fleet.shards if s.plan_count() == 1]
+    assert len(owners) == 1                      # exactly one authoritative shard
+    assert owners[0] is fleet.shard_for_plan_key(key)
+    # a gang living on ANY shard adopts through the facade
+    assert fleet.plan_get("fp", gang="g0", **PLAN_DIMS) is not None
+    assert "g0" in fleet.plan_statuses()[key]["adopters"]
+
+    fleet.issue_directive("g3", "resize", reason="hang:desync")
+    assert fleet.directive("g3")["action"] == "resize"
+    assert fleet.scheduler_view()["n_gangs"] == 12
+
+    text = fleet.metrics_text()
+    assert "bagua_fleet_shard_count 4" in text
+    for shard in range(4):
+        assert f'bagua_wal_replay_ms{{shard="{shard}"}}' in text
+
+    pre = fleet.dump()
+    assert pre["n_shards"] == 4 and len(pre["shards"]) == 4
+    fleet2 = ShardedControlPlane(n_shards=4, wal_dir=wal_dir,
+                                 rdzv_kwargs=RDZV_FAST)
+    assert _canon(fleet2.dump()) == _canon(pre)
+    assert fleet2.gang("g0").rendezvous.kv_get("warm") == 0
+    assert fleet2.directive("g3")["action"] == "resize"
+    assert all(ms > 0 for ms in fleet2.shard_info()["wal_replay_ms"])
+
+
+def test_async_server_keepalive_pipelined_requests_and_404():
+    """The selector-loop server speaks persistent HTTP/1.1: many requests
+    ride one connection (GET and POST), an unknown route answers 404
+    without killing the connection, and shutdown closes the listener."""
+    plane = FleetControlPlane(rdzv_kwargs=RDZV_FAST)
+    server = start_async_fleet_server(plane, 0, host="127.0.0.1")
+    port = server.server_address[1]
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        for _ in range(3):
+            conn.request("GET", "/fleet/health")
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 200 and body["status"] == "ok"
+        payload = json.dumps({**PLAN_DIMS, "fingerprint": "fp",
+                              "plan": {"buckets": [["w"]]},
+                              "meta": {"plan_version": 1}}).encode()
+        conn.request("POST", "/fleet/plan/publish", body=payload,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200 and json.loads(resp.read())["ok"]
+        conn.request("GET", "/nope")
+        resp = conn.getresponse()
+        assert resp.status == 404
+        resp.read()
+        # the 404 was an answer, not a hangup: the connection still serves
+        conn.request("GET", "/fleet/shards")
+        resp = conn.getresponse()
+        assert resp.status == 200 and json.loads(resp.read())["n_shards"] == 1
+    finally:
+        conn.close()
+        server.shutdown()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        try:
+            _get_json(f"http://127.0.0.1:{port}/fleet/health", timeout=0.5)
+            time.sleep(0.05)
+        except OSError:
+            break
+    else:
+        raise AssertionError("async server still answering after shutdown")

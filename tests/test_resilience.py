@@ -38,6 +38,7 @@ from bagua_tpu.resilience import (
     clear_resumable_marker,
     read_resumable_marker,
     retry_call,
+    seed_backoff,
     write_resumable_marker,
 )
 
@@ -228,6 +229,42 @@ def test_retry_policy_env_knobs_and_backoff_bounds(monkeypatch):
     for attempt in range(6):
         for _ in range(20):  # full jitter: uniform(0, min(max, base * 2^i))
             assert 0.0 <= p.backoff_s(attempt) <= min(4.0, 2.0 ** attempt)
+
+
+def test_seed_backoff_pins_the_shared_jitter_stream():
+    """Seedless policies draw from ONE module-level RNG: ``seed_backoff(n)``
+    makes every subsequent backoff sequence reproducible across all of them
+    (the repro knob for flaky-network lanes), while an explicit
+    ``RetryPolicy(seed=...)`` keeps its own isolated stream that a later
+    ``seed_backoff`` call cannot disturb."""
+    seed_backoff(7)
+    a = [RetryPolicy(retries=3, base_s=1.0, max_s=4.0).backoff_s(i)
+         for i in range(5)]
+    seed_backoff(7)
+    b = [RetryPolicy(retries=3, base_s=1.0, max_s=4.0).backoff_s(i)
+         for i in range(5)]
+    assert a == b  # shared stream, bitwise-reproducible after re-seeding
+    seed_backoff(8)
+    c = [RetryPolicy(retries=3, base_s=1.0, max_s=4.0).backoff_s(i)
+         for i in range(5)]
+    assert a != c  # a different seed is a different schedule
+
+    # two seedless policies interleave on the SAME stream: re-seeding and
+    # drawing through either order reproduces the one global sequence
+    seed_backoff(7)
+    p1, p2 = RetryPolicy(base_s=1.0, max_s=4.0), RetryPolicy(base_s=1.0, max_s=4.0)
+    interleaved = [p1.backoff_s(0), p2.backoff_s(0), p1.backoff_s(1)]
+    seed_backoff(7)
+    assert interleaved == [RetryPolicy(base_s=1.0, max_s=4.0).backoff_s(i)
+                           for i in (0, 0, 1)]
+
+    # an explicitly seeded policy is immune to the module knob
+    iso1 = RetryPolicy(retries=3, base_s=1.0, max_s=4.0, seed=0)
+    seed_backoff(12345)
+    iso2 = RetryPolicy(retries=3, base_s=1.0, max_s=4.0, seed=0)
+    assert [iso1.backoff_s(i) for i in range(5)] == [
+        iso2.backoff_s(i) for i in range(5)
+    ]
 
 
 def test_retry_call_recovers_exhausts_and_passes_through():
